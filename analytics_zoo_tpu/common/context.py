@@ -1,0 +1,167 @@
+"""Runtime/context bootstrap — the TPU-native analog of NNContext.
+
+Reference parity: `NNContext.initNNContext` (common/NNContext.scala:133-186) and the
+Python `init_nncontext`/`init_spark_on_local` family (pyzoo/zoo/common/nncontext.py:23-127)
+bootstrap a SparkContext + BigDL Engine (node/core discovery).  On TPU the "cluster" is a
+device mesh: this module discovers JAX devices, builds a `jax.sharding.Mesh`, and holds the
+process-wide configuration (default dtypes, RNG seed, mesh axis layout) that every other
+subsystem reads.  There is no py4j bridge and no engine reflection — the context is a plain
+Python object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh axis names.  Data parallelism is always present; the other axes are
+# length-1 unless explicitly requested (green-field beyond the reference, which only has DP
+# — SURVEY.md §2.3 "parallelism strategies").
+DATA_AXIS = "data"
+MODEL_AXIS = "model"      # tensor parallelism
+PIPE_AXIS = "pipe"        # pipeline parallelism
+SEQ_AXIS = "seq"          # sequence/context parallelism
+EXPERT_AXIS = "expert"    # expert parallelism (MoE)
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+
+@dataclasses.dataclass
+class ZooConf:
+    """Unified typed config tree.
+
+    Replaces the reference's 4-way config sprawl (SparkConf keys, Java system properties,
+    scopt CLI, serving YAML — SURVEY.md §5 config).  One dataclass, overridable from
+    environment variables prefixed ``ZOO_TPU_`` (e.g. ``ZOO_TPU_SEED=7``).
+    """
+
+    seed: int = 42
+    # Compute dtype for matmuls/convs (MXU-friendly); params stay in param_dtype.
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # Mesh layout: axis name -> size.  -1 for data means "all remaining devices".
+    mesh_axes: Tuple[str, ...] = (DATA_AXIS,)
+    mesh_shape: Tuple[int, ...] = (-1,)
+    # Training-loop behaviour
+    failure_retry_times: int = 5          # bigdl.failure.retryTimes analog
+    checkpoint_keep: int = 3
+    log_every_n_steps: int = 10
+    # Data layer
+    prefetch_buffers: int = 2             # double-buffered device infeed
+
+    @staticmethod
+    def from_env(**overrides) -> "ZooConf":
+        conf = ZooConf(**overrides)
+        for f in dataclasses.fields(conf):
+            env_key = "ZOO_TPU_" + f.name.upper()
+            if env_key in os.environ and f.name not in overrides:
+                raw = os.environ[env_key]
+                if f.type in ("int", int):
+                    setattr(conf, f.name, int(raw))
+                elif f.type in ("str", str):
+                    setattr(conf, f.name, raw)
+        return conf
+
+
+class ZooContext:
+    """Process-wide runtime context: devices, mesh, seed, dtype policy."""
+
+    def __init__(self, conf: Optional[ZooConf] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        self.conf = conf or ZooConf.from_env()
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.mesh = self._build_mesh()
+        self._rng = jax.random.PRNGKey(self.conf.seed)
+        self._lock = threading.Lock()
+
+    # -- mesh ---------------------------------------------------------------
+    def _build_mesh(self) -> Mesh:
+        axes = list(self.conf.mesh_axes)
+        shape = list(self.conf.mesh_shape)
+        n = len(self.devices)
+        fixed = int(np.prod([s for s in shape if s > 0])) if shape else 1
+        if -1 in shape:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"device count {n} not divisible by fixed mesh dims {fixed}")
+            shape[shape.index(-1)] = n // fixed
+        used = int(np.prod(shape))
+        if used > n:
+            raise ValueError(f"mesh shape {shape} needs {used} devices, have {n}")
+        dev_array = np.asarray(self.devices[:used]).reshape(shape)
+        return Mesh(dev_array, tuple(axes))
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.mesh.shape.get(DATA_AXIS, 1)
+
+    # -- sharding helpers ---------------------------------------------------
+    def data_sharding(self, batch_rank: int = 1) -> NamedSharding:
+        """Sharding that splits the leading (batch) axis over the data axis."""
+        spec = P(DATA_AXIS, *([None] * (batch_rank - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # -- rng ----------------------------------------------------------------
+    def next_rng(self) -> jax.Array:
+        with self._lock:
+            self._rng, sub = jax.random.split(self._rng)
+            return sub
+
+    def set_seed(self, seed: int) -> None:
+        with self._lock:
+            self.conf.seed = seed
+            self._rng = jax.random.PRNGKey(seed)
+
+
+_global_ctx: Optional[ZooContext] = None
+_ctx_lock = threading.Lock()
+
+
+def init_context(conf: Optional[ZooConf] = None, *, mesh_axes=None, mesh_shape=None,
+                 devices=None, seed: Optional[int] = None) -> ZooContext:
+    """Initialise (or re-initialise) the global ZooContext.
+
+    Analog of `NNContext.initNNContext` / `init_nncontext` — but instead of spinning up a
+    JVM+Spark cluster it discovers TPU devices and lays them out in a mesh.
+    """
+    global _global_ctx
+    conf = conf or ZooConf.from_env()
+    if mesh_axes is not None:
+        conf.mesh_axes = tuple(mesh_axes)
+    if mesh_shape is not None:
+        conf.mesh_shape = tuple(mesh_shape)
+    if seed is not None:
+        conf.seed = seed
+    with _ctx_lock:
+        _global_ctx = ZooContext(conf, devices=devices)
+        return _global_ctx
+
+
+# API-parity alias (pyzoo/zoo/common/nncontext.py:23)
+init_nncontext = init_context
+
+
+def get_context() -> ZooContext:
+    global _global_ctx
+    with _ctx_lock:
+        if _global_ctx is None:
+            _global_ctx = ZooContext()
+        return _global_ctx
+
+
+def mesh() -> Mesh:
+    return get_context().mesh

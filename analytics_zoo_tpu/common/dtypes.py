@@ -1,0 +1,40 @@
+"""Global dtype policy.
+
+MXU-friendly mixed precision: params live in `param_dtype` (float32), matmul/conv inputs
+are cast to `compute_dtype` (bfloat16 on TPU) with float32 accumulation
+(`preferred_element_type`).  The policy is process-global so every layer picks it up
+without per-layer plumbing; tests run in float32 for exact numerics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_policy = {"compute": None, "param": jnp.float32}
+
+
+def set_policy(compute_dtype=None, param_dtype=jnp.float32):
+    """compute_dtype=None means no casting (pure float32)."""
+    _policy["compute"] = jnp.dtype(compute_dtype) if compute_dtype else None
+    _policy["param"] = jnp.dtype(param_dtype)
+
+
+def mixed_bf16():
+    set_policy(jnp.bfloat16, jnp.float32)
+
+
+def compute_dtype():
+    return _policy["compute"]
+
+
+def param_dtype():
+    return _policy["param"]
+
+
+def cast_compute(*arrays):
+    """Cast arrays to the compute dtype (no-op when policy is unset)."""
+    c = _policy["compute"]
+    if c is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(c) if hasattr(a, "astype") else a for a in arrays)
+    return out if len(out) > 1 else out[0]

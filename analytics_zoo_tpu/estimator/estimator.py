@@ -1,0 +1,257 @@
+"""Estimator — the distributed training/eval engine.
+
+Reference parity: `Estimator.train/evaluate` (pipeline/estimator/Estimator.scala:118-176)
+driving `InternalDistriOptimizer` (Topology.scala:1070-1454).  The reference's hot loop is
+two Spark jobs per iteration: threaded forward/backward on model replicas, then a
+BlockManager-shuffle all-reduce with per-slice optimizer updates (AllReduceParameter,
+wp-bigdl.md:113-160).
+
+TPU-native redesign: the *entire* iteration — forward, backward, gradient all-reduce,
+optimizer update — is ONE jitted XLA program laid out over the device mesh.  Batches are
+sharded along the `data` axis; params/optimizer state are replicated; the cross-device
+gradient psum is inserted automatically by GSPMD because the weighted-mean loss is global
+program semantics.  BigDL's reduce-scatter + per-shard update + all-gather scheme is what
+XLA emits anyway when beneficial; no shuffle, no reflection, no second job.
+
+Batches are fixed-shape (padded with zero-weight rows), so one compilation serves every
+step — no dynamic-shape recompiles.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet, FeatureSet
+from analytics_zoo_tpu.nn import metrics as metrics_lib
+from analytics_zoo_tpu.nn import objectives as objectives_lib
+from analytics_zoo_tpu.nn import optimizers as optimizers_lib
+from analytics_zoo_tpu.nn.module import Layer
+
+
+class History:
+    """fit() return value: per-epoch scalars (Keras History parity)."""
+
+    def __init__(self):
+        self.history: Dict[str, List[float]] = {}
+
+    def append(self, key: str, value: float):
+        self.history.setdefault(key, []).append(float(value))
+
+    def __repr__(self):
+        return f"History({self.history})"
+
+
+def _as_feature_set(x, y) -> FeatureSet:
+    if isinstance(x, FeatureSet):
+        return x
+    return ArrayFeatureSet(x, y)
+
+
+class Estimator:
+    """Uniform train/evaluate/predict facade over the pjit'd step."""
+
+    def __init__(self, model: Layer, optimizer=None, loss=None, metrics=(),
+                 ctx=None, clip_norm: Optional[float] = None,
+                 clip_value: Optional[float] = None):
+        self.model = model
+        self.ctx = ctx or get_context()
+        opt = optimizers_lib.get(optimizer) if optimizer is not None else None
+        if opt is not None and (clip_norm or clip_value):
+            opt = optimizers_lib.with_gradient_clipping(opt, clip_norm, clip_value)
+        self.optimizer = opt
+        self.loss = objectives_lib.get(loss) if loss is not None else None
+        self.metrics = [metrics_lib.get(m) for m in metrics]
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.global_step = 0
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._listeners = []   # step-end callbacks: fn(step, loss)
+
+    # -- initialisation -------------------------------------------------------
+    def _ensure_init(self, sample_x):
+        if self.params is not None:
+            return
+        shape = (jax.tree.map(lambda a: a.shape[1:], list(sample_x))
+                 if isinstance(sample_x, (list, tuple))
+                 else sample_x.shape[1:])
+        rng = self.ctx.next_rng()
+        params, state = self.model.init(rng, shape)
+        repl = self.ctx.replicated_sharding()
+        self.params = jax.device_put(params, repl)
+        self.state = jax.device_put(state, repl)
+        if self.optimizer is not None:
+            self.opt_state = jax.device_put(self.optimizer.init(self.params), repl)
+
+    def _shard(self, *arrays):
+        """Place batch arrays sharded along the mesh data axis."""
+        out = []
+        for a in arrays:
+            if a is None:
+                out.append(None)
+                continue
+            out.append(jax.tree.map(
+                lambda v: jax.device_put(
+                    jnp.asarray(v), self.ctx.data_sharding(np.ndim(v))),
+                a, is_leaf=lambda v: isinstance(v, (np.ndarray, jnp.ndarray))))
+        return out
+
+    # -- compiled steps -------------------------------------------------------
+    def _build_train_step(self):
+        model, loss_fn, opt = self.model, self.loss, self.optimizer
+
+        def step(params, opt_state, state, x, y, w, rng):
+            def loss_of(p):
+                y_pred, new_state = model.apply(p, state, x, training=True, rng=rng)
+                per = loss_fn(y_pred, y)
+                per = per.reshape(per.shape[0], -1).mean(axis=-1)
+                l = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-8)
+                return l, new_state
+            (l, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, l
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_eval_step(self):
+        model, loss_fn, metric_objs = self.model, self.loss, self.metrics
+
+        def step(params, state, accs, x, y, w):
+            y_pred, _ = model.apply(params, state, x, training=False, rng=None)
+            new_accs = []
+            for m, acc in zip(metric_objs, accs):
+                new_accs.append(m.update(acc, y_pred, y, w))
+            if loss_fn is not None:
+                per = loss_fn(y_pred, y)
+                per = per.reshape(per.shape[0], -1).mean(axis=-1)
+                lsum = jnp.sum(per * w)
+            else:
+                lsum = jnp.zeros(())
+            return new_accs, lsum, jnp.sum(w)
+
+        return jax.jit(step)
+
+    def _build_predict_step(self):
+        model = self.model
+
+        def step(params, state, x):
+            y, _ = model.apply(params, state, x, training=False, rng=None)
+            return y
+
+        return jax.jit(step)
+
+    # -- public API -----------------------------------------------------------
+    def fit(self, x, y=None, *, batch_size=32, epochs=1, validation_data=None,
+            shuffle=True, verbose=True, log_every: Optional[int] = None) -> History:
+        if self.optimizer is None or self.loss is None:
+            raise RuntimeError("Estimator needs optimizer and loss to fit")
+        data = _as_feature_set(x, y)
+        dp = self.ctx.data_parallel_size
+        if batch_size % dp != 0:
+            batch_size = int(np.ceil(batch_size / dp) * dp)
+        hist = History()
+        np_rng = np.random.default_rng(self.ctx.conf.seed)
+        log_every = log_every or self.ctx.conf.log_every_n_steps
+
+        first = next(iter(data.batches(batch_size)))
+        self._ensure_init(first[0])
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+
+        for epoch in range(epochs):
+            t0 = time.time()
+            losses, seen = [], 0
+            for bx, by, bw in data.batches(batch_size, shuffle=shuffle,
+                                           rng=np_rng, pad_final=True):
+                sx, sy, sw = self._shard(bx, by, bw)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(self.ctx.conf.seed), self.global_step)
+                self.params, self.opt_state, self.state, l = self._train_step(
+                    self.params, self.opt_state, self.state, sx, sy, sw, rng)
+                self.global_step += 1
+                losses.append(l)
+                seen += int(bw.sum())
+                for fn in self._listeners:
+                    fn(self.global_step, l)
+            mean_loss = float(jnp.mean(jnp.stack([jnp.asarray(v) for v in losses])))
+            dt = time.time() - t0
+            hist.append("loss", mean_loss)
+            hist.append("throughput", seen / max(dt, 1e-9))
+            msg = (f"Epoch {epoch + 1}/{epochs} - loss {mean_loss:.4f} "
+                   f"- {seen / max(dt, 1e-9):.0f} samples/s")
+            if validation_data is not None:
+                val = self.evaluate(*self._val_tuple(validation_data),
+                                    batch_size=batch_size)
+                for k, v in val.items():
+                    hist.append("val_" + k, v)
+                msg += " - " + " ".join(f"val_{k} {v:.4f}" for k, v in val.items())
+            if verbose:
+                print(msg)
+        return hist
+
+    @staticmethod
+    def _val_tuple(validation_data):
+        if isinstance(validation_data, FeatureSet):
+            return validation_data, None
+        return validation_data[0], (validation_data[1]
+                                    if len(validation_data) > 1 else None)
+
+    def evaluate(self, x, y=None, *, batch_size=32) -> Dict[str, float]:
+        data = _as_feature_set(x, y)
+        dp = self.ctx.data_parallel_size
+        if batch_size % dp != 0:
+            batch_size = int(np.ceil(batch_size / dp) * dp)
+        first = next(iter(data.batches(batch_size)))
+        self._ensure_init(first[0])
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        accs = [m.init() for m in self.metrics]
+        loss_sum, w_sum = 0.0, 0.0
+        for bx, by, bw in data.batches(batch_size, pad_final=True):
+            sx, sy, sw = self._shard(bx, by, bw)
+            accs, lsum, wsum = self._eval_step(self.params, self.state, accs,
+                                               sx, sy, sw)
+            loss_sum += float(lsum)
+            w_sum += float(wsum)
+        out = {m.name: m.result(acc) for m, acc in zip(self.metrics, accs)}
+        if self.loss is not None and w_sum > 0:
+            out["loss"] = loss_sum / w_sum
+        return out
+
+    def predict(self, x, *, batch_size=128) -> np.ndarray:
+        data = _as_feature_set(x, None)
+        dp = self.ctx.data_parallel_size
+        if batch_size % dp != 0:
+            batch_size = int(np.ceil(batch_size / dp) * dp)
+        first = next(iter(data.batches(batch_size)))
+        self._ensure_init(first[0])
+        if self._predict_step is None:
+            self._predict_step = self._build_predict_step()
+        outs = []
+        n_left = data.size()
+        for bx, _, bw in data.batches(batch_size, pad_final=True):
+            (sx,) = self._shard(bx)
+            yb = self._predict_step(self.params, self.state, sx)
+            take = min(n_left, int(bw.shape[0]))
+            outs.append(jax.tree.map(lambda a: np.asarray(a)[:take], yb))
+            n_left -= take
+        if isinstance(outs[0], (list, tuple)):
+            return [np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))]
+        return np.concatenate(outs)
+
+    # -- reference-named aliases ---------------------------------------------
+    def train(self, train_set: FeatureSet, *, batch_size=32, end_epoch=1,
+              validation_set: Optional[FeatureSet] = None, **kw) -> History:
+        """Estimator.train parity (Estimator.scala:118-155)."""
+        return self.fit(train_set, batch_size=batch_size, epochs=end_epoch,
+                        validation_data=validation_set, **kw)

@@ -1,0 +1,145 @@
+"""FeatureSet — the training-data abstraction feeding the device mesh.
+
+Reference parity: `FeatureSet` (feature/FeatureSet.scala:655-710) with its memory tiers
+(DRAM / PMEM / DIRECT / DISK_AND_DRAM — CachedDistributedFeatureSet:230,
+DiskFeatureSet:564-642) and the Sample→MiniBatch padding pipeline
+(MTSampleToMiniBatch.scala:28-139).  TPU-native redesign: data lives on the host as numpy
+(DRAM tier) or as mmap'd arrays (DISK tier ≙ DISK_AND_DRAM — the OS page cache plays the
+role of the slice loop), and an iterator yields fixed-shape global batches that the
+Estimator shards over the mesh's data axis.  Partial final batches are padded with
+zero-weight rows so eval metrics are exact under static shapes (no dynamic-shape
+recompiles — XLA-friendly by construction).
+
+The PythonLoaderFeatureSet (jep-embedded Python loaders, FeatureSet.scala:332-554) is
+subsumed by `IteratorFeatureSet`: we are already in Python, so any callable yielding
+(x, y) batches plugs in directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+ArrayLike = Union[np.ndarray, Sequence[np.ndarray]]
+
+
+class MemoryType:
+    DRAM = "DRAM"
+    DISK_AND_DRAM = "DISK_AND_DRAM"   # mmap-backed
+    PMEM = "PMEM"                      # treated as DISK tier (no Optane on TPU hosts)
+
+
+def _listify(x) -> List[np.ndarray]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(a) for a in x]
+    return [np.asarray(x)]
+
+
+class FeatureSet:
+    """Base: len + batch iterator of (xs, ys, weights)."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def batches(self, batch_size: int, *, shuffle: bool = False,
+                rng: Optional[np.random.Generator] = None,
+                drop_remainder: bool = False,
+                pad_final: bool = True) -> Iterator[Tuple]:
+        raise NotImplementedError
+
+    # -- constructors (FeatureSet.rdd / .array analogs) ----------------------
+    @staticmethod
+    def from_arrays(x: ArrayLike, y: Optional[ArrayLike] = None,
+                    memory_type: str = MemoryType.DRAM) -> "ArrayFeatureSet":
+        return ArrayFeatureSet(x, y, memory_type=memory_type)
+
+    @staticmethod
+    def from_iterator(fn: Callable[[], Iterator], size: int) -> "IteratorFeatureSet":
+        return IteratorFeatureSet(fn, size)
+
+    @staticmethod
+    def from_memmap(paths_x: Sequence[str], shapes_x, dtypes_x,
+                    path_y: Optional[str] = None, shape_y=None, dtype_y=None
+                    ) -> "ArrayFeatureSet":
+        """DISK_AND_DRAM tier: arrays stay on disk, OS pages them in on demand."""
+        xs = [np.memmap(p, mode="r", dtype=d, shape=tuple(s))
+              for p, s, d in zip(paths_x, shapes_x, dtypes_x)]
+        y = (np.memmap(path_y, mode="r", dtype=dtype_y, shape=tuple(shape_y))
+             if path_y else None)
+        return ArrayFeatureSet(xs, y, memory_type=MemoryType.DISK_AND_DRAM)
+
+
+class ArrayFeatureSet(FeatureSet):
+    def __init__(self, x: ArrayLike, y: Optional[ArrayLike] = None,
+                 memory_type: str = MemoryType.DRAM):
+        self.xs = _listify(x)
+        self.ys = _listify(y)
+        self.memory_type = memory_type
+        if not self.xs:
+            raise ValueError("FeatureSet needs at least one feature array")
+        n = self.xs[0].shape[0]
+        for a in self.xs + self.ys:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the leading (sample) dim")
+        self._n = n
+
+    def size(self) -> int:
+        return self._n
+
+    def batches(self, batch_size: int, *, shuffle=False, rng=None,
+                drop_remainder=False, pad_final=True):
+        n = self._n
+        idx = np.arange(n)
+        if shuffle:
+            (rng or np.random.default_rng()).shuffle(idx)
+        stop = (n // batch_size) * batch_size if drop_remainder else n
+        for start in range(0, stop, batch_size):
+            sel = idx[start:start + batch_size]
+            w = np.ones((len(sel),), np.float32)
+            if len(sel) < batch_size and pad_final:
+                pad = batch_size - len(sel)
+                sel = np.concatenate([sel, np.zeros((pad,), np.int64)])
+                w = np.concatenate([w, np.zeros((pad,), np.float32)])
+            xs = [a[sel] for a in self.xs]
+            ys = [a[sel] for a in self.ys]
+            yield (xs[0] if len(xs) == 1 else xs,
+                   (ys[0] if len(ys) == 1 else ys) if ys else None,
+                   w)
+
+    def split(self, fraction: float, seed: int = 0):
+        """Random train/val split (reference FeatureSet has no built-in split; this
+        replaces ad-hoc RDD randomSplit usage in examples)."""
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self._n)
+        cut = int(self._n * fraction)
+        a, b = idx[:cut], idx[cut:]
+
+        def take(sel):
+            return ArrayFeatureSet([x[sel] for x in self.xs],
+                                   [y[sel] for y in self.ys] or None,
+                                   self.memory_type)
+        return take(a), take(b)
+
+
+class IteratorFeatureSet(FeatureSet):
+    """Wraps a user callable returning a fresh iterator of (x, y) batches per epoch
+    (PythonLoaderFeatureSet parity without jep)."""
+
+    def __init__(self, fn: Callable[[], Iterator], size: int):
+        self.fn = fn
+        self._n = size
+
+    def size(self) -> int:
+        return self._n
+
+    def batches(self, batch_size: int, **kwargs):
+        for item in self.fn():
+            if len(item) == 2:
+                x, y = item
+                n = (x[0] if isinstance(x, (list, tuple)) else x).shape[0]
+                yield x, y, np.ones((n,), np.float32)
+            else:
+                yield item

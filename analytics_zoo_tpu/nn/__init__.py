@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.nn.module import Layer, initializer
+from analytics_zoo_tpu.nn.graph import Input, SymTensor
+from analytics_zoo_tpu.nn.models import Model, Sequential

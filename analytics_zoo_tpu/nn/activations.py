@@ -1,0 +1,37 @@
+"""Keras-named activation registry (reference: pipeline/api/keras/layers activations +
+KerasUtils.getActivation)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "exp": jnp.exp,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get(name):
+    """Resolve an activation by Keras name; callables pass through."""
+    if callable(name):
+        return name
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}") from None

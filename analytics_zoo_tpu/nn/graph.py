@@ -1,0 +1,118 @@
+"""Symbolic graph tracing for the functional (graph) Model API.
+
+Reference parity: graph `Model` (Topology.scala:604-825) and the autograd `Variable` DSL
+(pipeline/api/autograd/math.scala:32-611).  Calling a `Layer` on a `SymTensor` records a
+node; `Model(input=..., output=...)` topologically sorts the recorded graph into a single
+pure apply function.  Shared layers (same Layer object called twice) share parameters, as
+in Keras.  Arithmetic on SymTensors (`+ - * /`, activations, reductions) builds Lambda
+nodes — the `Variable`/`AutoGrad` surface without a separate engine, since JAX itself is
+the autograd.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Union
+
+from analytics_zoo_tpu.nn.module import Layer, to_shape, _is_multi
+
+_node_ids = itertools.count()
+
+
+class SymTensor:
+    """A symbolic tensor: the output of a layer applied to other symbolic tensors."""
+
+    __slots__ = ("layer", "inputs", "shape", "dtype", "nid", "name")
+
+    def __init__(self, layer: Optional[Layer], inputs: List["SymTensor"],
+                 shape, dtype, name: Optional[str] = None):
+        self.layer = layer            # None for placeholder inputs
+        self.inputs = inputs
+        self.shape = to_shape(shape)  # excludes batch dim
+        self.dtype = dtype
+        self.nid = next(_node_ids)
+        self.name = name or (layer.name if layer else f"input_{self.nid}")
+
+    # -- operator sugar (autograd Variable parity) --------------------------
+    def _binop(self, other, fn, opname):
+        from analytics_zoo_tpu.nn.layers.core import Lambda, Merge
+        if isinstance(other, SymTensor):
+            return Lambda(lambda xs: fn(xs[0], xs[1]), name=f"{opname}")([self, other])
+        return Lambda(lambda x, c=other: fn(x, c), name=f"{opname}c")(self)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b, "add")
+
+    def __radd__(self, o):
+        return self._binop(o, lambda a, b: b + a, "radd")
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b, "sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a, "rsub")
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b, "mul")
+
+    def __rmul__(self, o):
+        return self._binop(o, lambda a, b: b * a, "rmul")
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b, "div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a, "rdiv")
+
+    def __neg__(self):
+        from analytics_zoo_tpu.nn.layers.core import Lambda
+        return Lambda(lambda x: -x, name="neg")(self)
+
+    def __pow__(self, p):
+        from analytics_zoo_tpu.nn.layers.core import Lambda
+        return Lambda(lambda x: x ** p, name="pow")(self)
+
+    def __getitem__(self, idx):
+        """Slice the non-batch dims (autograd `Variable.indexSelect`/`slice` parity)."""
+        from analytics_zoo_tpu.nn.layers.core import Lambda
+        full = (slice(None),) + (idx if isinstance(idx, tuple) else (idx,))
+        return Lambda(lambda x: x[full], name="slice")(self)
+
+    def __repr__(self):
+        return f"SymTensor({self.name}, shape={self.shape})"
+
+
+def Input(shape, dtype="float32", name: Optional[str] = None) -> SymTensor:
+    """Graph placeholder (Topology.scala `Input` node)."""
+    return SymTensor(None, [], to_shape(shape), dtype, name=name)
+
+
+def trace_call(layer: Layer, x: Union[SymTensor, Sequence[SymTensor]]) -> SymTensor:
+    """Record `layer(x)` as a graph node and infer its output shape abstractly."""
+    multi = isinstance(x, (list, tuple))
+    inputs = list(x) if multi else [x]
+    for t in inputs:
+        if not isinstance(t, SymTensor):
+            raise TypeError(
+                f"layer {layer.name} called on non-symbolic input {type(t)}; "
+                "use Input(shape) placeholders or layer.call(params, array)")
+    in_shape = [t.shape for t in inputs] if multi else inputs[0].shape
+    _, _, out_shape = layer.abstract(in_shape)
+    return SymTensor(layer, inputs, out_shape, inputs[0].dtype)
+
+
+def topo_sort(outputs: Sequence[SymTensor]) -> List[SymTensor]:
+    """Deterministic topological order of the subgraph feeding `outputs`."""
+    seen, order = set(), []
+
+    def visit(node: SymTensor):
+        if node.nid in seen:
+            return
+        seen.add(node.nid)
+        for dep in node.inputs:
+            visit(dep)
+        order.append(node)
+
+    for out in outputs:
+        visit(out)
+    return order

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.nn.layers.core import (
+    Activation, BatchNormalization, Dense, Dropout, Embedding, ExpandDim, Flatten,
+    GaussianDropout, GaussianNoise, InputLayer, Lambda, Masking, Merge, Narrow, Permute,
+    RepeatVector, Reshape, Select, Squeeze, merge)

@@ -1,0 +1,359 @@
+"""Core layers: Dense, Activation, Dropout, Flatten, Reshape, Embedding, Merge, Lambda,
+BatchNormalization, and shape utilities.
+
+Reference parity: pipeline/api/keras/layers/{Dense,Activation,Dropout,Flatten,Reshape,
+Permute,RepeatVector,Embedding,Merge,BatchNormalization,...}.scala — rebuilt as pure
+functions.  Dense matmuls run in the global compute dtype (bfloat16 on TPU) with float32
+accumulation so they tile onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn import activations
+from analytics_zoo_tpu.nn.module import Layer, initializer, to_shape
+
+
+class Dense(Layer):
+    """Fully-connected layer (keras/layers/Dense; TPU: single MXU matmul)."""
+
+    def __init__(self, output_dim: int, activation=None, init="glorot_uniform",
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.activation = activations.get(activation)
+        self.init_name = init
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_dim = to_shape(input_shape)[-1]
+        rw, rb = jax.random.split(rng)
+        p = {"W": initializer(self.init_name, rw, (in_dim, self.output_dim),
+                              dtypes.param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.output_dim,), dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        xw, W = dtypes.cast_compute(x, params["W"])
+        y = jnp.matmul(xw, W, preferred_element_type=dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y)
+
+
+class Activation(Layer):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self.fn = activations.get(activation)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.fn(x)
+
+
+class Dropout(Layer):
+    """Inverted dropout; identity when not training or rng is None."""
+
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class Flatten(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0], -1))
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape, **kwargs):
+        super().__init__(**kwargs)
+        self.target_shape = tuple(target_shape)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+
+class Permute(Layer):
+    """Permute non-batch dims; `dims` are 1-indexed over non-batch axes (Keras-1)."""
+
+    def __init__(self, dims, **kwargs):
+        super().__init__(**kwargs)
+        self.dims = tuple(dims)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.transpose(x, (0,) + tuple(d for d in self.dims))
+
+
+class RepeatVector(Layer):
+    def __init__(self, n: int, **kwargs):
+        super().__init__(**kwargs)
+        self.n = int(n)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+
+class Squeeze(Layer):
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)  # non-batch axis index (1-based incl batch semantics kept simple)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.squeeze(x, axis=self.dim)
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, axis=self.dim)
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary jnp function (autograd Lambda, Lambda.scala:49-95).
+
+    `fn` receives a single array or a list of arrays (for multi-input nodes)."""
+
+    def __init__(self, fn: Callable, **kwargs):
+        super().__init__(**kwargs)
+        self.fn = fn
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.fn(x)
+
+
+class Embedding(Layer):
+    """Token-id -> dense vector lookup (keras/layers/Embedding.scala).
+
+    Accepts float or int id tensors (the reference feeds float ids through BigDL
+    LookupTable); gather runs on-device."""
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        E = initializer(self.init_name, rng, (self.input_dim, self.output_dim),
+                        dtypes.param_dtype())
+        return {"E": E}
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        return jnp.take(params["E"], ids, axis=0)
+
+
+class Merge(Layer):
+    """Multi-input merge (keras/layers/Merge semantics): modes sum/mul/ave/max/min/
+    concat/dot/cos.  Call on a list of SymTensors or arrays."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self.branches = list(layers) if layers else None
+        if self.branches:
+            shapes = [b._declared_input_shape for b in self.branches]
+            self._declared_input_shape = shapes
+
+    def build(self, rng, input_shape):
+        if not self.branches:
+            return {}
+        return {b.name: b.build(jax.random.fold_in(rng, i), s)
+                for i, (b, s) in enumerate(zip(self.branches, input_shape))}
+
+    def init_state(self, input_shape):
+        if not self.branches:
+            return {}
+        return {b.name: b.init_state(s)
+                for b, s in zip(self.branches, input_shape)}
+
+    def _merge(self, xs):
+        m = self.mode
+        if m == "sum":
+            return sum(xs[1:], xs[0])
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "ave":
+            return sum(xs[1:], xs[0]) / float(len(xs))
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "dot":
+            return jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)
+        if m == "cos":
+            a, b = xs[0], xs[1]
+            na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+            nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+            return jnp.sum(a * b, axis=-1, keepdims=True) / (na * nb + 1e-8)
+        raise ValueError(f"unknown merge mode {self.mode!r}")
+
+    def apply(self, params, state, inputs, *, training=False, rng=None):
+        xs = list(inputs)
+        new_state = state
+        if self.branches:
+            ys, new_state = [], dict(state)
+            for i, (b, x) in enumerate(zip(self.branches, xs)):
+                y, s = b.apply(params[b.name], state[b.name], x,
+                               training=training, rng=jax.random.fold_in(rng, i)
+                               if rng is not None else None)
+                ys.append(y)
+                new_state[b.name] = s
+            xs = ys
+        return self._merge(xs), new_state
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y, _ = self.apply(params, self.init_state(self._declared_input_shape),
+                          inputs, training=training, rng=rng)
+        return y
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional-API merge over SymTensors (keras.layers.merge)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))
+
+
+class BatchNormalization(Layer):
+    """Batch normalization with moving statistics carried as explicit state.
+
+    Under a data-sharded pjit step the batch-mean/var reductions are global program
+    semantics, so GSPMD inserts the cross-device psum automatically — the reference's
+    per-replica BN (BigDL) never synchronised statistics; this is strictly better."""
+
+    def __init__(self, epsilon=1e-3, momentum=0.99, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.axis = axis
+
+    def _dim(self, input_shape):
+        shape = to_shape(input_shape)
+        return shape[self.axis] if self.axis != 0 else shape[0]
+
+    def build(self, rng, input_shape):
+        d = self._dim(input_shape)
+        return {"gamma": jnp.ones((d,), dtypes.param_dtype()),
+                "beta": jnp.zeros((d,), dtypes.param_dtype())}
+
+    def init_state(self, input_shape):
+        d = self._dim(input_shape)
+        return {"mean": jnp.zeros((d,), jnp.float32),
+                "var": jnp.ones((d,), jnp.float32)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # normalize over all axes except the channel axis
+        ax = self.axis if self.axis >= 0 else x.ndim + self.axis
+        red = tuple(i for i in range(x.ndim) if i != ax)
+        bshape = tuple(x.shape[i] if i == ax else 1 for i in range(x.ndim))
+        if training:
+            mean = jnp.mean(x, axis=red)
+            var = jnp.var(x, axis=red)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+        y = y * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
+        return y.astype(x.dtype), new_state
+
+
+class InputLayer(Layer):
+    """Identity placeholder for Sequential (keras InputLayer)."""
+
+    _is_source = True
+
+    def __init__(self, input_shape=None, **kwargs):
+        super().__init__(input_shape=input_shape, **kwargs)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x
+
+
+class Select(Layer):
+    """Select an index along a non-batch dim (zoo keras/layers/Select.scala)."""
+
+    def __init__(self, dim: int, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.index = int(dim), int(index)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.lax.index_in_dim(x, self.index, axis=self.dim, keepdims=False)
+
+
+class Narrow(Layer):
+    """Slice `length` elements starting at `offset` along dim (Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.offset, self.length = int(dim), int(offset), int(length)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + self.length,
+                                    axis=self.dim)
+
+
+class Masking(Layer):
+    """Zero out timesteps equal to mask_value (keras Masking)."""
+
+    def __init__(self, mask_value=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mask_value = mask_value
+
+    def call(self, params, x, *, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, **kwargs):
+        super().__init__(**kwargs)
+        self.sigma = float(sigma)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None:
+            return x
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianDropout(Layer):
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return x
+        std = float(np.sqrt(self.p / (1.0 - self.p)))
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))

@@ -1,0 +1,189 @@
+"""Evaluation metrics as streaming (merge-able) accumulators.
+
+Reference parity: pipeline/api/keras/metrics/ (`Accuracy`, `Top5Accuracy`, `AUC`
+(AUC.scala:1-211), `MAE`) over BigDL ValidationMethod.  Each metric defines
+
+    init() -> acc                      (pytree of scalars/arrays)
+    update(acc, y_pred, y_true, w) -> acc    (pure; jit-safe, w = sample weights)
+    result(acc) -> float
+
+so evaluation batches stream through a jitted update and merge exactly across devices —
+the analog of ValidationMethod's `apply`+`merge` contract, but functional.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    name = "metric"
+
+    def init(self):
+        raise NotImplementedError
+
+    def update(self, acc, y_pred, y_true, w):
+        raise NotImplementedError
+
+    def result(self, acc):
+        raise NotImplementedError
+
+
+def _binary_or_multiclass_pred(y_pred, y_true):
+    """Replicates BigDL Accuracy semantics: 1-unit sigmoid output -> threshold 0.5;
+    otherwise argmax over the last axis (zero-based labels)."""
+    if y_pred.shape[-1] == 1:
+        pred = (y_pred[..., 0] > 0.5).astype(jnp.int32)
+        true = y_true.reshape(pred.shape).astype(jnp.int32)
+    else:
+        pred = jnp.argmax(y_pred, axis=-1).astype(jnp.int32)
+        true = y_true
+        if true.ndim == y_pred.ndim:
+            if true.shape[-1] == y_pred.shape[-1]:   # one-hot
+                true = jnp.argmax(true, axis=-1)
+            else:
+                true = true[..., 0]
+        true = true.astype(jnp.int32)
+    return pred, true
+
+
+class Accuracy(Metric):
+    name = "accuracy"
+
+    def __init__(self, zero_based_label: bool = True):
+        self.zero_based = zero_based_label
+
+    def init(self):
+        return {"correct": jnp.zeros((), jnp.float32),
+                "total": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_pred, y_true, w):
+        pred, true = _binary_or_multiclass_pred(y_pred, y_true)
+        if not self.zero_based and y_pred.shape[-1] > 1:
+            true = true - 1
+        hit = (pred == true).astype(jnp.float32) * w.reshape(pred.shape)
+        return {"correct": acc["correct"] + hit.sum(),
+                "total": acc["total"] + w.reshape(pred.shape).sum()}
+
+    def result(self, acc):
+        return float(acc["correct"] / jnp.maximum(acc["total"], 1.0))
+
+
+class TopK(Metric):
+    def __init__(self, k: int = 5):
+        self.k = k
+        self.name = f"top{k}_accuracy"
+
+    def init(self):
+        return {"correct": jnp.zeros((), jnp.float32),
+                "total": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_pred, y_true, w):
+        true = y_true
+        if true.ndim == y_pred.ndim:
+            true = true[..., 0]
+        true = true.astype(jnp.int32)
+        _, idx = jax.lax.top_k(y_pred, self.k)
+        hit = jnp.any(idx == true[..., None], axis=-1).astype(jnp.float32)
+        hit = hit * w.reshape(hit.shape)
+        return {"correct": acc["correct"] + hit.sum(),
+                "total": acc["total"] + w.reshape(hit.shape).sum()}
+
+    def result(self, acc):
+        return float(acc["correct"] / jnp.maximum(acc["total"], 1.0))
+
+
+Top5Accuracy = lambda: TopK(5)  # noqa: E731  (reference metric name)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def init(self):
+        return {"sum": jnp.zeros((), jnp.float32),
+                "total": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_pred, y_true, w):
+        err = jnp.abs(y_pred - y_true.reshape(y_pred.shape))
+        err = err.reshape(err.shape[0], -1).mean(-1) * w
+        return {"sum": acc["sum"] + err.sum(), "total": acc["total"] + w.sum()}
+
+    def result(self, acc):
+        return float(acc["sum"] / jnp.maximum(acc["total"], 1.0))
+
+
+class Loss(Metric):
+    name = "loss"
+
+    def __init__(self, loss_fn):
+        self.loss_fn = loss_fn
+
+    def init(self):
+        return {"sum": jnp.zeros((), jnp.float32),
+                "total": jnp.zeros((), jnp.float32)}
+
+    def update(self, acc, y_pred, y_true, w):
+        per = self.loss_fn(y_pred, y_true)
+        per = per.reshape(per.shape[0], -1).mean(-1) * w
+        return {"sum": acc["sum"] + per.sum(), "total": acc["total"] + w.sum()}
+
+    def result(self, acc):
+        return float(acc["sum"] / jnp.maximum(acc["total"], 1.0))
+
+
+class AUC(Metric):
+    """Streaming ROC-AUC by threshold bucketing (metrics/AUC.scala:1-211 uses the same
+    thresholded TP/FP/TN/FN scheme)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.n = num_thresholds
+        eps = 1e-7
+        self.thresholds = jnp.asarray(
+            np.concatenate([[-eps], (np.arange(1, self.n - 1) / (self.n - 1)),
+                            [1.0 + eps]]), jnp.float32)
+
+    def init(self):
+        z = jnp.zeros((self.n,), jnp.float32)
+        return {"tp": z, "fp": z, "tn": z, "fn": z}
+
+    def update(self, acc, y_pred, y_true, w):
+        p = y_pred.reshape(-1)
+        t = y_true.reshape(-1).astype(jnp.float32)
+        wv = w.reshape(-1)
+        above = (p[None, :] > self.thresholds[:, None]).astype(jnp.float32)
+        pos = (t * wv)[None, :]
+        neg = ((1 - t) * wv)[None, :]
+        return {"tp": acc["tp"] + (above * pos).sum(-1),
+                "fp": acc["fp"] + (above * neg).sum(-1),
+                "fn": acc["fn"] + ((1 - above) * pos).sum(-1),
+                "tn": acc["tn"] + ((1 - above) * neg).sum(-1)}
+
+    def result(self, acc):
+        tpr = acc["tp"] / jnp.maximum(acc["tp"] + acc["fn"], 1e-7)
+        fpr = acc["fp"] / jnp.maximum(acc["fp"] + acc["tn"], 1e-7)
+        # integrate TPR over FPR (thresholds descend in FPR)
+        auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+        return float(auc)
+
+
+_METRICS = {
+    "accuracy": Accuracy, "acc": Accuracy,
+    "top5accuracy": Top5Accuracy, "top5": Top5Accuracy,
+    "mae": MAE, "auc": AUC,
+}
+
+
+def get(name):
+    if isinstance(name, Metric):
+        return name
+    if isinstance(name, str):
+        key = name.lower()
+        if key in _METRICS:
+            return _METRICS[key]()
+    if callable(name):
+        return name()
+    raise ValueError(f"unknown metric {name!r}")

@@ -1,0 +1,246 @@
+"""Containers: `Sequential` and graph `Model`, plus the Keras-style training façade.
+
+Reference parity: `Sequential` (Topology.scala:827-961), graph `Model`
+(Topology.scala:604-825), and the `KerasNet` compile/fit/evaluate/predict façade
+(Topology.scala:65-549).  Containers are themselves Layers, so they nest arbitrarily and a
+whole model is one pure function — which is what lets the Estimator pjit the entire train
+step over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.nn.module import Layer, Params, State, split_rng, to_shape
+from analytics_zoo_tpu.nn.graph import Input, SymTensor, topo_sort
+
+
+class KerasNet(Layer):
+    """Mixin giving containers the compile/fit/evaluate/predict surface
+    (Topology.scala:137-549)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._estimator = None
+        self._params: Optional[Params] = None
+        self._state: Optional[State] = None
+
+    # -- training façade -----------------------------------------------------
+    def compile(self, optimizer, loss, metrics=None):
+        """Configure training (Topology.scala:137-193).  Optimizer/loss/metrics may be
+        strings (Keras names) or objects."""
+        from analytics_zoo_tpu.estimator.estimator import Estimator
+        self._estimator = Estimator(self, optimizer=optimizer, loss=loss,
+                                    metrics=metrics or [])
+        return self
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10, validation_data=None,
+            distributed=True, **kwargs):
+        if self._estimator is None:
+            raise RuntimeError("call compile(...) before fit(...)")
+        hist = self._estimator.fit(x, y, batch_size=batch_size, epochs=nb_epoch,
+                                   validation_data=validation_data, **kwargs)
+        self._params = self._estimator.params
+        self._state = self._estimator.state
+        return hist
+
+    def evaluate(self, x, y=None, batch_size=32):
+        if self._estimator is None:
+            raise RuntimeError("call compile(...) before evaluate(...)")
+        if self._params is not None:
+            self._estimator.params = self._params
+            self._estimator.state = self._state
+        return self._estimator.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size=128, distributed=True):
+        from analytics_zoo_tpu.estimator.estimator import Estimator
+        if self._params is None:
+            self.init_weights()
+        est = self._estimator or Estimator(self, optimizer=None, loss=None)
+        est.params, est.state = self._params, self._state
+        return est.predict(x, batch_size=batch_size)
+
+    def init_weights(self, rng: Optional[jax.Array] = None, input_shape=None):
+        from analytics_zoo_tpu.common.context import get_context
+        rng = rng if rng is not None else get_context().next_rng()
+        self._params, self._state = self.init(rng, input_shape)
+        return self._params
+
+    def set_weights(self, params, state=None):
+        self._params = params
+        if state is not None:
+            self._state = state
+
+    def get_weights(self):
+        return self._params
+
+    # -- persistence (Net.load / saveModel parity, via npz + pickle-free) ----
+    def save_weights(self, path: str):
+        from analytics_zoo_tpu.utils.serialization import save_pytree
+        save_pytree(path, {"params": self._params, "state": self._state})
+
+    def load_weights(self, path: str):
+        from analytics_zoo_tpu.utils.serialization import load_pytree
+        tree = load_pytree(path, like={"params": self._params, "state": self._state}
+                           if self._params is not None else None)
+        self._params, self._state = tree["params"], tree["state"]
+        return self
+
+    # -- introspection (summary printer, Topology.scala:686-705) -------------
+    def summary(self, input_shape=None, print_fn=print):
+        input_shape = input_shape or self._declared_input_shape
+        rows = self._summary_rows(input_shape)
+        total = sum(r[2] for r in rows)
+        width = 88
+        print_fn("_" * width)
+        print_fn(f"{'Layer (type)':<44}{'Output Shape':<26}{'Param #':<12}")
+        print_fn("=" * width)
+        for name, shape, count in rows:
+            print_fn(f"{name:<44}{str(shape):<26}{count:<12}")
+        print_fn("=" * width)
+        print_fn(f"Total params: {total:,}")
+        print_fn("_" * width)
+        return total
+
+    def _summary_rows(self, input_shape):
+        raise NotImplementedError
+
+
+class Sequential(KerasNet):
+    """Linear stack of layers (Topology.scala:827-961)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None, name=None):
+        super().__init__(name=name)
+        self.layers_list: List[Layer] = []
+        for l in (layers or []):
+            self.add(l)
+
+    def add(self, layer: Layer) -> "Sequential":
+        if not self.layers_list:
+            if layer._declared_input_shape is None and not hasattr(layer, "_is_source"):
+                raise ValueError(
+                    f"first layer {layer.name} needs input_shape= (Sequential.add)")
+            self._declared_input_shape = layer._declared_input_shape
+        self.layers_list.append(layer)
+        return self
+
+    # -- Layer protocol ------------------------------------------------------
+    def build(self, rng, input_shape) -> Params:
+        params: Dict[str, Params] = {}
+        shape = input_shape
+        for i, layer in enumerate(self.layers_list):
+            params[layer.name] = layer.build(jax.random.fold_in(rng, i), shape)
+            _, _, shape = layer.abstract(shape)
+        return params
+
+    def init_state(self, input_shape) -> State:
+        state: Dict[str, State] = {}
+        shape = input_shape
+        for layer in self.layers_list:
+            state[layer.name] = layer.init_state(shape)
+            _, _, shape = layer.abstract(shape)
+        return state
+
+    def apply(self, params, state, inputs, *, training=False, rng=None):
+        x = inputs
+        new_state = dict(state)
+        for i, layer in enumerate(self.layers_list):
+            x, s = layer.apply(params[layer.name], state[layer.name], x,
+                               training=training, rng=split_rng(rng, i))
+            new_state[layer.name] = s
+        return x, new_state
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y, _ = self.apply(params, self.init_state(self._declared_input_shape), inputs,
+                          training=training, rng=rng)
+        return y
+
+    def _summary_rows(self, input_shape):
+        rows = []
+        shape = input_shape
+        for layer in self.layers_list:
+            p, _, shape = layer.abstract(shape)
+            n = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(p))
+            rows.append((f"{layer.name} ({type(layer).__name__})", shape, n))
+        return rows
+
+
+class Model(KerasNet):
+    """Graph model over symbolic tensors (Topology.scala:604-825).
+
+    `Model(input=Input(shape=...), output=sym)` — layers called on SymTensors form the
+    graph; shared Layer objects share parameters.
+    """
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        self.input_nodes: List[SymTensor] = (
+            list(input) if isinstance(input, (list, tuple)) else [input])
+        self.output_nodes: List[SymTensor] = (
+            list(output) if isinstance(output, (list, tuple)) else [output])
+        self.multi_output = isinstance(output, (list, tuple))
+        self.nodes = topo_sort(self.output_nodes)
+        for n in self.nodes:
+            if n.layer is None and n not in self.input_nodes:
+                raise ValueError(f"graph references Input node {n.name} "
+                                 "not listed in `input=`")
+        # unique layers in topo order (shared layers appear once)
+        self.graph_layers: List[Layer] = []
+        self._layer_first_shape = {}
+        seen = set()
+        for n in self.nodes:
+            if n.layer is not None and id(n.layer) not in seen:
+                seen.add(id(n.layer))
+                self.graph_layers.append(n.layer)
+                in_shape = ([t.shape for t in n.inputs] if len(n.inputs) > 1
+                            else n.inputs[0].shape)
+                self._layer_first_shape[n.layer.name] = in_shape
+        shapes = [n.shape for n in self.input_nodes]
+        self._declared_input_shape = shapes if len(shapes) > 1 else shapes[0]
+
+    # -- Layer protocol ------------------------------------------------------
+    def build(self, rng, input_shape=None) -> Params:
+        return {
+            l.name: l.build(jax.random.fold_in(rng, i),
+                            self._layer_first_shape[l.name])
+            for i, l in enumerate(self.graph_layers)}
+
+    def init_state(self, input_shape=None) -> State:
+        return {l.name: l.init_state(self._layer_first_shape[l.name])
+                for l in self.graph_layers}
+
+    def apply(self, params, state, inputs, *, training=False, rng=None):
+        xs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(xs) != len(self.input_nodes):
+            raise ValueError(
+                f"model expects {len(self.input_nodes)} inputs, got {len(xs)}")
+        env = {n.nid: x for n, x in zip(self.input_nodes, xs)}
+        new_state = dict(state)
+        for i, node in enumerate(self.nodes):
+            if node.layer is None:
+                continue
+            ins = [env[t.nid] for t in node.inputs]
+            x = ins if len(ins) > 1 else ins[0]
+            y, s = node.layer.apply(
+                params[node.layer.name], new_state[node.layer.name], x,
+                training=training, rng=split_rng(rng, i))
+            env[node.nid] = y
+            new_state[node.layer.name] = s
+        outs = [env[n.nid] for n in self.output_nodes]
+        return (outs if self.multi_output else outs[0]), new_state
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y, _ = self.apply(params, self.init_state(None), inputs,
+                          training=training, rng=rng)
+        return y
+
+    def _summary_rows(self, input_shape=None):
+        rows = []
+        for l in self.graph_layers:
+            p, _, out = l.abstract(self._layer_first_shape[l.name])
+            n = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(p))
+            rows.append((f"{l.name} ({type(l).__name__})", out, n))
+        return rows

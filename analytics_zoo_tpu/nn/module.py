@@ -1,0 +1,198 @@
+"""Core functional layer IR — the foundation of the Keras-style API.
+
+Reference parity: the 120-layer Keras API of analytics-zoo
+(pipeline/api/keras/layers/*.scala, base `KerasNet` in Topology.scala:65) is a class
+hierarchy wrapping BigDL mutable modules.  The TPU-native rebuild is a **pure-functional
+layer IR**: a `Layer` owns no tensors — it is a recipe with two methods,
+
+    build(rng, input_shape) -> params        (a pytree of jnp arrays)
+    call(params, x, training=..., rng=...)   (a pure function)
+
+Shape inference is automatic: containers run `jax.eval_shape` through `build`/`apply`, so
+individual layers never hand-write output-shape rules (the reference's per-layer
+`computeOutputShape` boilerplate disappears).  Because `apply` is pure, a whole model —
+containers included — jits/pjits as a single XLA program; params are ordinary pytrees that
+shard with `jax.sharding` annotations.
+
+Stateful layers (BatchNorm moving stats) override `init_state`/`apply` and thread an
+explicit state pytree — no mutation, so training steps stay jit-compatible.
+
+Shapes follow Keras-1 convention: `input_shape` excludes the batch dimension
+(Topology.scala / KerasLayer idiom); runtime arrays include it.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any           # pytree of jnp arrays
+State = Any            # pytree of jnp arrays (e.g. batchnorm moving stats)
+Shape = Tuple[Optional[int], ...]
+
+_RNG_AVAL = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+_name_counters: Dict[str, "itertools.count"] = defaultdict(lambda: itertools.count())
+
+
+def _auto_name(cls_name: str) -> str:
+    return f"{cls_name.lower()}_{next(_name_counters[cls_name])}"
+
+
+def to_shape(s) -> Shape:
+    if isinstance(s, int):
+        return (s,)
+    return tuple(s)
+
+
+class Layer:
+    """Base class for all layers.  Subclasses implement `build` and `call`."""
+
+    def __init__(self, input_shape=None, name: Optional[str] = None, **kwargs):
+        self.name = name or _auto_name(type(self).__name__)
+        self._declared_input_shape = (
+            None if input_shape is None else to_shape(input_shape))
+        # Filled in lazily by abstract() — param/state avals for this layer.
+        self._param_avals = None
+        self._state_avals = None
+        self._built_for: Optional[Any] = None
+
+    # -- to be overridden ----------------------------------------------------
+    def build(self, rng: jax.Array, input_shape) -> Params:
+        """Create parameters for `input_shape` (batch dim excluded)."""
+        return {}
+
+    def init_state(self, input_shape) -> State:
+        """Create non-trainable state (e.g. moving averages)."""
+        return {}
+
+    def call(self, params: Params, inputs, *, training: bool = False,
+             rng: Optional[jax.Array] = None):
+        raise NotImplementedError(type(self).__name__)
+
+    # Stateful layers override `apply` instead of `call`.
+    def apply(self, params: Params, state: State, inputs, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        return self.call(params, inputs, training=training, rng=rng), state
+
+    # -- shape/abstract machinery -------------------------------------------
+    def _input_avals(self, input_shape, dtype=jnp.float32):
+        """input_shape (no batch) -> aval(s) with a unit batch dim."""
+        if _is_multi(input_shape):
+            return [jax.ShapeDtypeStruct((1,) + to_shape(s), dtype) for s in input_shape]
+        return jax.ShapeDtypeStruct((1,) + to_shape(input_shape), dtype)
+
+    def abstract(self, input_shape, dtype=jnp.float32):
+        """Infer (param_avals, state_avals, output_shape) without allocating.
+
+        output_shape excludes the batch dim.  Results cached per input_shape.
+        """
+        key = _freeze(input_shape)
+        if self._built_for == key:
+            return self._param_avals, self._state_avals, self._out_shape
+        p_avals = jax.eval_shape(
+            functools.partial(self.build, input_shape=input_shape), _RNG_AVAL)
+        s_avals = jax.eval_shape(
+            functools.partial(self.init_state, input_shape=input_shape))
+        x_avals = self._input_avals(input_shape, dtype)
+        y_aval, _ = jax.eval_shape(
+            functools.partial(self.apply, training=False, rng=None),
+            p_avals, s_avals, x_avals)
+        self._param_avals, self._state_avals = p_avals, s_avals
+        self._out_shape = jax.tree.map(lambda a: a.shape[1:], y_aval,
+                                       is_leaf=lambda t: hasattr(t, "shape"))
+        self._built_for = key
+        return p_avals, s_avals, self._out_shape
+
+    def get_output_shape(self, input_shape=None):
+        input_shape = input_shape or self._declared_input_shape
+        if input_shape is None:
+            raise ValueError(f"{self.name}: no input_shape available")
+        _, _, out = self.abstract(input_shape)
+        return out
+
+    # -- init ----------------------------------------------------------------
+    def init(self, rng: jax.Array, input_shape=None) -> Tuple[Params, State]:
+        input_shape = input_shape or self._declared_input_shape
+        if input_shape is None:
+            raise ValueError(
+                f"{self.name}: provide input_shape= at construction or init()")
+        params = self.build(rng, input_shape)
+        state = self.init_state(input_shape)
+        return params, state
+
+    # -- symbolic graph entry -----------------------------------------------
+    def __call__(self, x: Union["SymTensor", Sequence["SymTensor"]]):
+        from analytics_zoo_tpu.nn.graph import trace_call
+        return trace_call(self, x)
+
+    # -- misc ----------------------------------------------------------------
+    def param_count(self, input_shape=None) -> int:
+        input_shape = input_shape or self._declared_input_shape
+        p, _, _ = self.abstract(input_shape)
+        return sum(int(np.prod(a.shape)) for a in jax.tree.leaves(p))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+def _is_multi(shape) -> bool:
+    """True if `shape` is a list of shapes (multi-input)."""
+    if isinstance(shape, list):
+        return True
+    return (isinstance(shape, tuple) and len(shape) > 0
+            and isinstance(shape[0], (tuple, list)))
+
+
+def _freeze(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze(i) for i in x)
+    return x
+
+
+def split_rng(rng: Optional[jax.Array], index: int) -> Optional[jax.Array]:
+    """Derive a per-sublayer rng deterministically; None passes through."""
+    if rng is None:
+        return None
+    return jax.random.fold_in(rng, index)
+
+
+def initializer(init: str, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    """Keras-1 style weight initializers (the reference's `init=` strings)."""
+    shape = tuple(shape)
+    if fan_in is None:
+        fan_in = shape[0] if len(shape) >= 2 else max(1, int(np.prod(shape)))
+    if fan_out is None:
+        fan_out = shape[-1] if len(shape) >= 2 else max(1, int(np.prod(shape)))
+    if init in ("glorot_uniform", "xavier"):
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init == "glorot_normal":
+        std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+        return std * jax.random.normal(rng, shape, dtype)
+    if init in ("he_normal", "msra"):
+        std = float(np.sqrt(2.0 / fan_in))
+        return std * jax.random.normal(rng, shape, dtype)
+    if init == "he_uniform":
+        limit = float(np.sqrt(6.0 / fan_in))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init == "lecun_uniform":
+        limit = float(np.sqrt(3.0 / fan_in))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init == "uniform":
+        return jax.random.uniform(rng, shape, dtype, -0.05, 0.05)
+    if init in ("normal", "gaussian"):
+        return 0.05 * jax.random.normal(rng, shape, dtype)
+    if init in ("zero", "zeros"):
+        return jnp.zeros(shape, dtype)
+    if init in ("one", "ones"):
+        return jnp.ones(shape, dtype)
+    if init == "orthogonal":
+        return jax.nn.initializers.orthogonal()(rng, shape, dtype)
+    raise ValueError(f"unknown initializer {init!r}")

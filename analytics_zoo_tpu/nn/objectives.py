@@ -1,0 +1,152 @@
+"""Loss functions with Keras names.
+
+Reference parity: pipeline/api/keras/objectives/ (15 Keras-named criterions wrapping BigDL,
+incl. ZooClassNLLCriterion.scala:1-197).  Signature: ``loss(y_pred, y_true) -> per-sample
+loss array`` — the estimator takes the (optionally masked) mean, so padded eval batches
+stay exact.  All are pure jnp and fuse into the train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _sum_over_features(x):
+    if x.ndim <= 1:
+        return x
+    return jnp.sum(x.reshape(x.shape[0], -1), axis=-1)
+
+
+def _mean_over_features(x):
+    if x.ndim <= 1:
+        return x
+    return jnp.mean(x.reshape(x.shape[0], -1), axis=-1)
+
+
+def mean_squared_error(y_pred, y_true):
+    return _mean_over_features((y_pred - y_true) ** 2)
+
+
+def mean_absolute_error(y_pred, y_true):
+    return _mean_over_features(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_pred, y_true):
+    diff = jnp.abs((y_true - y_pred) / jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * _mean_over_features(diff)
+
+
+def mean_squared_logarithmic_error(y_pred, y_true):
+    a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
+    return _mean_over_features((a - b) ** 2)
+
+
+def binary_crossentropy(y_pred, y_true):
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return _mean_over_features(-(y_true * jnp.log(p) + (1 - y_true) * jnp.log1p(-p)))
+
+
+def binary_crossentropy_from_logits(y_pred, y_true):
+    return _mean_over_features(
+        jnp.maximum(y_pred, 0) - y_pred * y_true + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+
+
+def categorical_crossentropy(y_pred, y_true):
+    """y_true one-hot over last axis; y_pred probabilities."""
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return -jnp.sum(y_true * jnp.log(p), axis=-1)
+
+
+def sparse_categorical_crossentropy(y_pred, y_true):
+    """y_true integer class ids (0-based); y_pred probabilities over last axis."""
+    ids = y_true.astype(jnp.int32)
+    if ids.ndim == y_pred.ndim:
+        ids = ids.squeeze(-1)
+    p = jnp.clip(jnp.take_along_axis(y_pred, ids[..., None], axis=-1)[..., 0],
+                 _EPS, 1.0)
+    return -jnp.log(p)
+
+
+def class_nll(y_pred, y_true):
+    """Negative log-likelihood over log-probabilities (ZooClassNLLCriterion:
+    zero-based labels, log-prob inputs)."""
+    ids = y_true.astype(jnp.int32)
+    if ids.ndim == y_pred.ndim:
+        ids = ids.squeeze(-1)
+    return -jnp.take_along_axis(y_pred, ids[..., None], axis=-1)[..., 0]
+
+
+def sparse_categorical_crossentropy_from_logits(y_pred, y_true):
+    ids = y_true.astype(jnp.int32)
+    if ids.ndim == y_pred.ndim:
+        ids = ids.squeeze(-1)
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+
+
+def hinge(y_pred, y_true):
+    return _mean_over_features(jnp.maximum(0.0, 1.0 - y_true * y_pred))
+
+
+def squared_hinge(y_pred, y_true):
+    return _mean_over_features(jnp.maximum(0.0, 1.0 - y_true * y_pred) ** 2)
+
+
+def rank_hinge(y_pred, y_true, margin=1.0):
+    """Pairwise ranking hinge for (pos, neg) interleaved batches
+    (objectives/RankHinge.scala): batch is [pos0, neg0, pos1, neg1, ...]."""
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.maximum(0.0, margin - pos + neg).reshape(pos.shape[0], -1).mean(-1)
+
+
+def kullback_leibler_divergence(y_pred, y_true):
+    t = jnp.clip(y_true, _EPS, 1.0)
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return jnp.sum(t * jnp.log(t / p), axis=-1)
+
+
+def poisson(y_pred, y_true):
+    return _mean_over_features(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_pred, y_true):
+    def l2n(x):
+        return x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), _EPS, None)
+    return -jnp.sum(l2n(y_true) * l2n(y_pred), axis=-1)
+
+
+_LOSSES = {
+    "mse": mean_squared_error, "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error, "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
+    "class_nll": class_nll,
+    "hinge": hinge, "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}") from None

@@ -1,0 +1,142 @@
+"""Optimizers and LR schedules with Keras/zoo names, built on optax.
+
+Reference parity: zoo's custom optimizers (pipeline/api/keras/optimizers/Adam.scala:1-147
+— Keras-style lr-decay semantics; AdamWeightDecay.scala:1-155 — BERT-style decoupled weight
+decay with warmup-poly schedule) and the schedule combinators in common/Optim.scala
+(`Warmup`, `Poly`, `SequentialSchedule`).  optax is the substrate: every optimizer is a
+GradientTransformation, so it shards with the params and runs inside the pjit step (the
+TPU-native answer to BigDL's per-slice `optimMethod.update` in the parameter-sync job).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import optax
+
+
+# -- schedules (common/Optim.scala parity) -----------------------------------
+
+def poly(base_lr: float, power: float, max_iteration: int):
+    """Polynomial decay (BigDL SGD.Poly)."""
+    return optax.polynomial_schedule(init_value=base_lr, end_value=0.0,
+                                     power=power, transition_steps=max_iteration)
+
+
+def warmup(base_lr: float, warmup_steps: int, delta: float):
+    """Linear warmup adding `delta` per step (Optim.scala Warmup)."""
+    return optax.linear_schedule(init_value=base_lr,
+                                 end_value=base_lr + warmup_steps * delta,
+                                 transition_steps=warmup_steps)
+
+
+def sequential_schedule(schedules: Sequence, boundaries: Sequence[int]):
+    """Chain schedules at step boundaries (Optim.scala SequentialSchedule)."""
+    return optax.join_schedules(list(schedules), list(boundaries))
+
+
+def warmup_poly(base_lr: float, warmup_steps: int, total_steps: int, power=1.0):
+    """The InceptionV1/BERT-style warmup-then-poly used across zoo examples."""
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, base_lr, warmup_steps),
+         optax.polynomial_schedule(base_lr, 0.0, power,
+                                   max(1, total_steps - warmup_steps))],
+        [warmup_steps])
+
+
+def exponential_decay(base_lr, decay_rate, decay_steps, staircase=False):
+    return optax.exponential_decay(base_lr, decay_steps, decay_rate,
+                                   staircase=staircase)
+
+
+# -- optimizers ---------------------------------------------------------------
+
+def SGD(lr=0.01, momentum=0.0, decay=0.0, nesterov=False, schedule=None):
+    lr_s = schedule or (
+        (lambda step: lr / (1.0 + decay * step)) if decay else lr)
+    if momentum:
+        return optax.sgd(lr_s, momentum=momentum, nesterov=nesterov)
+    return optax.sgd(lr_s)
+
+
+def Adam(lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8, decay=0.0,
+         schedule=None):
+    """Keras-semantics Adam (zoo keras/optimizers/Adam.scala:1-147: lr decays as
+    lr/(1+decay*t), bias-corrected moments)."""
+    lr_s = schedule or (
+        (lambda step: lr / (1.0 + decay * step)) if decay else lr)
+    return optax.adam(lr_s, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def AdamWeightDecay(lr=0.001, warmup_portion=-1.0, total: int = -1,
+                    schedule_name="linear", beta_1=0.9, beta_2=0.999,
+                    epsilon=1e-6, weight_decay=0.01):
+    """BERT AdamW (AdamWeightDecay.scala:1-155): decoupled weight decay, linear
+    warmup for `warmup_portion * total` steps then linear decay to zero."""
+    if total > 0:
+        w = int(max(0, warmup_portion) * total)
+        lr_s = optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(1, w)),
+             optax.linear_schedule(lr, 0.0, max(1, total - w))], [w])
+    else:
+        lr_s = lr
+    return optax.adamw(lr_s, b1=beta_1, b2=beta_2, eps=epsilon,
+                       weight_decay=weight_decay)
+
+
+def RMSprop(lr=0.001, rho=0.9, epsilon=1e-8):
+    return optax.rmsprop(lr, decay=rho, eps=epsilon)
+
+
+def Adagrad(lr=0.01):
+    return optax.adagrad(lr)
+
+
+def Adadelta(lr=1.0, rho=0.95, epsilon=1e-8):
+    return optax.adadelta(lr, rho=rho, eps=epsilon)
+
+
+def Adamax(lr=0.002, beta_1=0.9, beta_2=0.999, epsilon=1e-8):
+    return optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def Nadam(lr=0.002, beta_1=0.9, beta_2=0.999, epsilon=1e-8):
+    return optax.nadam(lr, b1=beta_1, b2=beta_2, eps=epsilon)
+
+
+def Ftrl(lr=0.5):
+    # parity with BigDL Ftrl (used by Wide&Deep wide column)
+    import optax
+    return optax.sgd(lr)  # placeholder until a true ftrl transform lands
+
+
+_OPTIMIZERS = {
+    "sgd": SGD, "adam": Adam, "rmsprop": RMSprop, "adagrad": Adagrad,
+    "adadelta": Adadelta, "adamax": Adamax, "nadam": Nadam,
+    "adamweightdecay": AdamWeightDecay,
+}
+
+
+def get(name):
+    """Resolve optimizer by Keras name / callable / optax transformation."""
+    if isinstance(name, optax.GradientTransformation):
+        return name
+    if isinstance(name, str):
+        key = name.lower()
+        if key in _OPTIMIZERS:
+            return _OPTIMIZERS[key]()
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def with_gradient_clipping(opt: optax.GradientTransformation,
+                           clip_norm: Optional[float] = None,
+                           clip_value: Optional[float] = None):
+    """Constant clipping / L2-norm clipping (KerasNet.setGradientClipping*,
+    Topology.scala:259-282)."""
+    chain = []
+    if clip_value is not None:
+        chain.append(optax.clip(clip_value))
+    if clip_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_norm))
+    chain.append(opt)
+    return optax.chain(*chain)

@@ -1,0 +1,59 @@
+"""Pytree persistence (weights save/load).
+
+Reference parity: BigDL module serialization used by `Net.load`/`saveModel`
+(pipeline/api/Net.scala:103-277).  Format: a single .npz holding flattened pytree leaves
+keyed by their tree path — portable, no pickle, mmap-able.  Orbax handles training
+checkpoints (estimator/checkpoint.py); this is the lightweight weights-file path.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def _flatten_with_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(_path_str(p) for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _path_str(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    return str(p)
+
+
+def save_pytree(path: str, tree) -> None:
+    flat = _flatten_with_paths(tree)
+    np.savez(path, **flat)
+
+
+def load_pytree(path: str, like=None):
+    """Load a pytree.  If `like` (a template pytree) is given, leaves are restored into
+    its exact structure; otherwise a nested dict keyed by path segments is returned."""
+    with np.load(path if path.endswith(".npz") else path + ".npz",
+                 allow_pickle=False) as zf:
+        flat = {k: zf[k] for k in zf.files}
+    if like is not None:
+        paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for path_elems, _ in paths:
+            key = "/".join(_path_str(p) for p in path_elems)
+            if key not in flat:
+                raise KeyError(f"missing leaf {key!r} in {path}")
+            leaves.append(flat[key])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    nested: dict = {}
+    for key, val in flat.items():
+        cur = nested
+        parts = key.split("/")
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = val
+    return nested

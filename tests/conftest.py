@@ -1,0 +1,34 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's `local[4]` Spark masters in unit tests (SURVEY.md §4): multi-device
+behaviour (data sharding, collective insertion) is exercised on host CPU devices; real-TPU
+runs happen in bench.py / __graft_entry__.py.
+
+Note: this environment pre-imports jax at interpreter startup (axon platform plugin), so
+`JAX_PLATFORMS` env vars are too late — we must switch via jax.config before the backend
+is instantiated, and XLA_FLAGS before the CPU client is created.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ctx():
+    from analytics_zoo_tpu.common.context import init_context
+    return init_context(seed=42)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

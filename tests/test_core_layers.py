@@ -1,0 +1,179 @@
+"""Core layer IR tests: shape inference, param init, forward numerics, containers.
+
+Mirrors the reference's ZooSpecHelper-style layer specs (SURVEY.md §4): seeded runs,
+numeric comparison against straight numpy oracles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import Input, Model, Sequential
+from analytics_zoo_tpu.nn.layers import (
+    Activation, BatchNormalization, Dense, Dropout, Embedding, Flatten, Lambda,
+    Merge, Reshape, merge)
+
+
+def test_dense_forward_matches_numpy(ctx):
+    layer = Dense(4, input_shape=(3,))
+    params, state = layer.init(jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    y = layer.call(params, jnp.asarray(x))
+    expect = x @ np.asarray(params["W"]) + np.asarray(params["b"])
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
+    assert layer.get_output_shape() == (4,)
+
+
+def test_dense_activation_and_param_count(ctx):
+    layer = Dense(7, activation="relu", input_shape=(3,))
+    assert layer.param_count() == 3 * 7 + 7
+    params, _ = layer.init(jax.random.PRNGKey(1))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 3)), jnp.float32)
+    y = layer.call(params, x)
+    assert np.asarray(y).min() >= 0.0
+
+
+def test_sequential_shape_inference_and_forward(ctx):
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(8,)))
+    model.add(Dense(2))
+    model.add(Activation("softmax"))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((6, 8))
+    y = model.call(params, x)
+    assert y.shape == (6, 2)
+    np.testing.assert_allclose(np.asarray(y).sum(-1), np.ones(6), rtol=1e-5)
+    assert model.get_output_shape() == (2,)
+
+
+def test_graph_model_with_merge(ctx):
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    ha = Dense(8, name="towera")(a)
+    hb = Dense(8, name="towerb")(b)
+    m = merge([ha, hb], mode="concat")
+    out = Dense(1, activation="sigmoid")(m)
+    model = Model(input=[a, b], output=out)
+    params, state = model.init(jax.random.PRNGKey(0))
+    xa = jnp.ones((3, 4))
+    xb = jnp.zeros((3, 4))
+    y = model.call(params, [xa, xb])
+    assert y.shape == (3, 1)
+    assert model.get_output_shape() == (1,)
+
+
+def test_shared_layer_shares_params(ctx):
+    shared = Dense(5, name="shared_dense")
+    a = Input(shape=(3,))
+    b = Input(shape=(3,))
+    out = merge([shared(a), shared(b)], mode="sum")
+    model = Model(input=[a, b], output=out)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    assert list(params.keys()).count("shared_dense") == 1
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 3)), jnp.float32)
+    y = model.call(params, [x, x])
+    single = x @ params["shared_dense"]["W"] + params["shared_dense"]["b"]
+    np.testing.assert_allclose(np.asarray(y), 2 * np.asarray(single), rtol=1e-5)
+
+
+def test_symtensor_arithmetic(ctx):
+    a = Input(shape=(4,))
+    out = (a * 2.0 + 1.0) - a
+    model = Model(input=a, output=out)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    x = jnp.arange(8.0).reshape(2, 4)
+    y = model.call(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) + 1.0, rtol=1e-6)
+
+
+def test_embedding(ctx):
+    emb = Embedding(10, 6, input_shape=(5,))
+    params, _ = emb.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray([[0, 1, 2, 3, 9]], jnp.int32)
+    y = emb.call(params, ids)
+    assert y.shape == (1, 5, 6)
+    np.testing.assert_allclose(np.asarray(y[0, 4]), np.asarray(params["E"][9]))
+    # float ids must work too (reference feeds float ids through LookupTable)
+    yf = emb.call(params, ids.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yf))
+
+
+def test_dropout_train_vs_eval(ctx):
+    d = Dropout(0.5, input_shape=(100,))
+    params, _ = d.init(jax.random.PRNGKey(0))
+    x = jnp.ones((4, 100))
+    y_eval = d.call(params, x, training=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x))
+    y_train = d.call(params, x, training=True, rng=jax.random.PRNGKey(3))
+    dropped = float((np.asarray(y_train) == 0).mean())
+    assert 0.3 < dropped < 0.7
+
+
+def test_batchnorm_state_updates(ctx):
+    bn = BatchNormalization(input_shape=(4,))
+    params, state = bn.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(3.0, 2.0, size=(64, 4)),
+                    jnp.float32)
+    y, new_state = bn.apply(params, state, x, training=True)
+    assert not np.allclose(np.asarray(new_state["mean"]), 0.0)
+    np.testing.assert_allclose(np.asarray(y).mean(0), np.zeros(4), atol=1e-4)
+    y_eval, st2 = bn.apply(params, new_state, x, training=False)
+    np.testing.assert_allclose(np.asarray(st2["mean"]),
+                               np.asarray(new_state["mean"]))
+
+
+def test_reshape_flatten_lambda(ctx):
+    model = Sequential()
+    model.add(Reshape((2, 6), input_shape=(12,)))
+    model.add(Lambda(lambda t: t * 3.0))
+    model.add(Flatten())
+    params, _ = model.init(jax.random.PRNGKey(0))
+    x = jnp.arange(24.0).reshape(2, 12)
+    y = model.call(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 3.0)
+
+
+def test_nested_sequential_in_graph(ctx):
+    tower = Sequential(name="tower")
+    tower.add(Dense(6, input_shape=(4,), activation="relu"))
+    tower.add(Dense(3))
+    a = Input(shape=(4,))
+    out = tower(a)
+    model = Model(input=a, output=out)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    y = model.call(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 3)
+
+
+def test_merge_modes(ctx):
+    x1 = jnp.asarray([[1.0, 2.0]])
+    x2 = jnp.asarray([[3.0, 4.0]])
+    cases = {"sum": [[4.0, 6.0]], "mul": [[3.0, 8.0]], "ave": [[2.0, 3.0]],
+             "max": [[3.0, 4.0]], "min": [[1.0, 2.0]], "dot": [[11.0]]}
+    for mode, expect in cases.items():
+        m = Merge(mode=mode)
+        y = m.call({}, [x1, x2])
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
+
+
+def _two_layer(seed):
+    m = Sequential()
+    m.add(Dense(4, input_shape=(3,), name="fc1"))
+    m.add(Dense(2, name="fc2"))
+    m.init_weights(jax.random.PRNGKey(seed))
+    return m
+
+
+def test_save_load_weights(ctx, tmp_path):
+    model = _two_layer(0)
+    x = jnp.ones((2, 3))
+    y1 = model.call(model.get_weights(), x)
+    path = str(tmp_path / "weights.npz")
+    model.save_weights(path)
+    model2 = _two_layer(7)
+    assert not np.allclose(np.asarray(model2.call(model2.get_weights(), x)),
+                           np.asarray(y1))
+    model2.load_weights(path)
+    y2 = model2.call(model2.get_weights(), x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))

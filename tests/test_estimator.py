@@ -1,0 +1,107 @@
+"""Distributed Estimator tests — fit/evaluate/predict over the 8-device CPU mesh.
+
+Mirrors the reference's DistriEstimatorSpec (local[4] + synthetic XOR-style data,
+SURVEY.md §4): the train step is pjit'd over the data axis; loss must drop and metrics
+must be exact despite zero-weight padding rows.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet, FeatureSet
+from analytics_zoo_tpu.nn import Input, Model, Sequential
+from analytics_zoo_tpu.nn.layers import Dense, merge
+
+
+def _blobs(n=512, d=8, seed=0):
+    """Two gaussian blobs, linearly separable."""
+    g = np.random.default_rng(seed)
+    half = n // 2
+    x = np.concatenate([g.normal(-1.0, 1.0, (half, d)),
+                        g.normal(1.0, 1.0, (n - half, d))]).astype(np.float32)
+    y = np.concatenate([np.zeros(half), np.ones(n - half)]).astype(np.float32)
+    idx = g.permutation(n)
+    return x[idx], y[idx][:, None]
+
+
+def test_fit_reduces_loss_and_evaluates(ctx):
+    x, y = _blobs()
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(8,)))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(optimizer="adam", loss="binary_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, batch_size=64, nb_epoch=5, verbose=False)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    res = model.evaluate(x, y, batch_size=64)
+    assert res["accuracy"] > 0.9
+
+
+def test_predict_shape_and_padding(ctx):
+    x, y = _blobs(n=300)  # not a multiple of batch or mesh size
+    model = Sequential()
+    model.add(Dense(4, activation="relu", input_shape=(8,)))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(optimizer="sgd", loss="mse")
+    model.fit(x, y, batch_size=64, nb_epoch=1, verbose=False)
+    pred = model.predict(x, batch_size=64)
+    assert pred.shape == (300, 1)
+
+
+def test_multi_input_graph_training(ctx):
+    g = np.random.default_rng(1)
+    xa = g.normal(size=(256, 4)).astype(np.float32)
+    xb = g.normal(size=(256, 4)).astype(np.float32)
+    y = (xa.sum(-1, keepdims=True) > xb.sum(-1, keepdims=True)).astype(np.float32)
+    a, b = Input(shape=(4,)), Input(shape=(4,))
+    h = merge([Dense(8, activation="relu")(a), Dense(8, activation="relu")(b)],
+              mode="concat")
+    out = Dense(1, activation="sigmoid")(h)
+    model = Model(input=[a, b], output=out)
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    model.compile(optimizer=Adam(lr=0.01), loss="binary_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit([xa, xb], y, batch_size=32, nb_epoch=10, verbose=False)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    res = model.evaluate([xa, xb], y, batch_size=32)
+    assert res["accuracy"] > 0.8
+
+
+def test_estimator_train_featureset_api(ctx):
+    x, y = _blobs()
+    fs = FeatureSet.from_arrays(x, y)
+    train, val = fs.split(0.8)
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(8,)))
+    model.add(Dense(1, activation="sigmoid"))
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    est = Estimator(model, optimizer=Adam(lr=0.01), loss="binary_crossentropy",
+                    metrics=["accuracy"])
+    est.train(train, batch_size=64, end_epoch=5, verbose=False)
+    res = est.evaluate(val, batch_size=64)
+    assert res["accuracy"] > 0.85
+
+
+def test_eval_metrics_exact_under_padding(ctx):
+    """Padded rows (zero weight) must not pollute metrics: compare batch 64 vs 77."""
+    x, y = _blobs(n=331)
+    model = Sequential()
+    model.add(Dense(1, activation="sigmoid", input_shape=(8,)))
+    model.compile(optimizer="sgd", loss="binary_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, nb_epoch=1, verbose=False)
+    r1 = model.evaluate(x, y, batch_size=64)
+    r2 = model.evaluate(x, y, batch_size=128)
+    assert abs(r1["accuracy"] - r2["accuracy"]) < 1e-6
+    assert abs(r1["loss"] - r2["loss"]) < 1e-5
+
+
+def test_gradient_clipping(ctx):
+    x, y = _blobs(n=128)
+    model = Sequential()
+    model.add(Dense(1, activation="sigmoid", input_shape=(8,)))
+    est = Estimator(model, optimizer="sgd", loss="binary_crossentropy",
+                    clip_norm=0.01)
+    est.fit(x, y, batch_size=64, epochs=1, verbose=False)  # just must run
