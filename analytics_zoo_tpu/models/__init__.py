@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.models.common import ZooModel

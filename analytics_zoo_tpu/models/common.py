@@ -1,0 +1,58 @@
+"""ZooModel base — the model-zoo contract.
+
+Reference parity: `ZooModel` (models/common/ZooModel.scala:37-154): subclasses implement
+`build_model()`, and get the compile/fit/evaluate/predict + save/load surface by
+delegation to the inner container.  `Ranker`-style ranking evaluation lives in
+models/recommendation/evaluation.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from analytics_zoo_tpu.nn.models import KerasNet
+
+
+class ZooModel:
+    """Base for built-in zoo models; `self.model` is the inner Sequential/Model."""
+
+    def __init__(self):
+        self.model: KerasNet = self.build_model()
+
+    def build_model(self) -> KerasNet:
+        raise NotImplementedError
+
+    # -- delegation ----------------------------------------------------------
+    def compile(self, optimizer, loss, metrics=None):
+        self.model.compile(optimizer, loss, metrics)
+        return self
+
+    def fit(self, *args, **kwargs):
+        return self.model.fit(*args, **kwargs)
+
+    def evaluate(self, *args, **kwargs):
+        return self.model.evaluate(*args, **kwargs)
+
+    def predict(self, *args, **kwargs):
+        return self.model.predict(*args, **kwargs)
+
+    def init_weights(self, rng: Optional[jax.Array] = None):
+        return self.model.init_weights(rng)
+
+    def get_weights(self):
+        return self.model.get_weights()
+
+    def set_weights(self, params, state=None):
+        self.model.set_weights(params, state)
+
+    def save_weights(self, path: str):
+        self.model.save_weights(path)
+
+    def load_weights(self, path: str):
+        self.model.load_weights(path)
+        return self
+
+    def summary(self, **kw):
+        return self.model.summary(**kw)

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.models.recommendation.neuralcf import NeuralCF
+from analytics_zoo_tpu.models.recommendation.recommender import (
+    Recommender, UserItemFeature, UserItemPrediction, evaluate_ranking,
+    generate_negative_samples, hit_ratio, ndcg)

@@ -1,0 +1,79 @@
+"""Benchmark — NCF (MovieLens-1M scale) training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md); the north-star target is
+samples/sec/chip on NCF.  vs_baseline is computed against a fixed reference point of
+1e6 samples/s/chip (a strong CPU-cluster-era bound for this model size) so the number is
+comparable across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 1_000_000.0
+
+
+def main():
+    import jax
+
+    from analytics_zoo_tpu.common import dtypes
+    from analytics_zoo_tpu.common.context import init_context
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    dtypes.mixed_bf16()
+    ctx = init_context(seed=0)
+    n_dev = ctx.num_devices
+
+    # MovieLens-1M dimensions (the reference NCF example's dataset)
+    ncf = NeuralCF(user_count=6040, item_count=3706, class_num=2,
+                   user_embed=64, item_embed=64, hidden_layers=(128, 64, 32),
+                   mf_embed=64)
+    est = Estimator(ncf.model, optimizer=Adam(lr=0.001),
+                    loss="sparse_categorical_crossentropy", ctx=ctx)
+
+    batch = 8192 * n_dev
+    rng = np.random.default_rng(0)
+    users = rng.integers(1, 6041, (batch, 1)).astype(np.float32)
+    items = rng.integers(1, 3707, (batch, 1)).astype(np.float32)
+    labels = rng.integers(0, 2, (batch, 1)).astype(np.float32)
+
+    est._ensure_init([users, items])
+    step = est._build_train_step()
+    sx, sy, sw = est._shard([users, items], labels,
+                            np.ones((batch,), np.float32))
+    key = jax.random.PRNGKey(0)
+
+    params, opt_state, state = est.params, est.opt_state, est.state
+    # warmup / compile
+    for _ in range(3):
+        params, opt_state, state, loss = step(params, opt_state, state,
+                                              sx, sy, sw, key)
+    jax.block_until_ready(loss)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, state, loss = step(params, opt_state, state,
+                                              sx, sy, sw, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * iters / dt
+    per_chip = samples_per_sec / n_dev
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
