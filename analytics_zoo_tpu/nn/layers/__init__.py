@@ -2,3 +2,20 @@ from analytics_zoo_tpu.nn.layers.core import (
     Activation, BatchNormalization, Dense, Dropout, Embedding, ExpandDim, Flatten,
     GaussianDropout, GaussianNoise, InputLayer, Lambda, Masking, Merge, Narrow, Permute,
     RepeatVector, Reshape, Select, Squeeze, merge)
+from analytics_zoo_tpu.nn.layers.conv import (
+    AtrousConvolution1D, AtrousConvolution2D, Convolution1D, Convolution2D,
+    Convolution3D, Cropping1D, Cropping2D, Deconvolution2D, LocallyConnected1D,
+    SeparableConvolution2D, UpSampling1D, UpSampling2D, UpSampling3D, ZeroPadding1D,
+    ZeroPadding2D)
+from analytics_zoo_tpu.nn.layers.pooling import (
+    AveragePooling1D, AveragePooling2D, AveragePooling3D, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalAveragePooling3D, GlobalMaxPooling1D,
+    GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D, MaxPooling2D, MaxPooling3D)
+from analytics_zoo_tpu.nn.layers.recurrent import (
+    GRU, LSTM, Bidirectional, ConvLSTM2D, Highway, SimpleRNN, TimeDistributed)
+from analytics_zoo_tpu.nn.layers.advanced import (
+    ELU, LeakyReLU, MaxoutDense, PReLU, SReLU, SpatialDropout1D, SpatialDropout2D,
+    ThresholdedReLU, WithinChannelLRN2D)
+from analytics_zoo_tpu.nn.layers.attention import (
+    BERT, LayerNorm, MultiHeadAttention, PositionwiseFFN, TransformerBlock,
+    TransformerLayer)

@@ -1,0 +1,147 @@
+"""Advanced activations + misc parametric layers.
+
+Reference parity: pipeline/api/keras/layers/{LeakyReLU,PReLU,ELU,SReLU,ThresholdedReLU,
+MaxoutDense,SpatialDropout1D/2D/3D,WithinChannelLRN2D}.scala.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.module import Layer, initializer, to_shape
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha=0.3, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * x)
+
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * (jnp.exp(x) - 1.0))
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, theta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+class PReLU(Layer):
+    """Learnable per-channel leaky slope."""
+
+    def build(self, rng, input_shape):
+        d = to_shape(input_shape)[-1]
+        return {"alpha": 0.25 * jnp.ones((d,), dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x)
+
+
+class SReLU(Layer):
+    """S-shaped ReLU (SReLU.scala): piecewise linear with 4 learnable params/channel."""
+
+    def build(self, rng, input_shape):
+        d = to_shape(input_shape)[-1]
+        return {"t_left": jnp.zeros((d,), dtypes.param_dtype()),
+                "a_left": jnp.zeros((d,), dtypes.param_dtype()),
+                "t_right": jnp.ones((d,), dtypes.param_dtype()),
+                "a_right": jnp.ones((d,), dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(x < tl, tl + al * (x - tl), x)
+        return jnp.where(x > tr, tr + ar * (x - tr), y)
+
+
+class MaxoutDense(Layer):
+    """Max over `nb_feature` linear projections (MaxoutDense.scala)."""
+
+    def __init__(self, output_dim, nb_feature=4, bias=True,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.bias = bias
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        d = to_shape(input_shape)[-1]
+        p = {"W": initializer(self.init_name, rng,
+                              (self.nb_feature, d, self.output_dim),
+                              dtypes.param_dtype(), fan_in=d,
+                              fan_out=self.output_dim)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_feature, self.output_dim),
+                               dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        xw, W = dtypes.cast_compute(x, params["W"])
+        y = jnp.einsum("bd,fdo->bfo", xw, W,
+                       preferred_element_type=jnp.float32)
+        if self.bias:
+            y = y + params["b"]
+        return jnp.max(y, axis=1)
+
+
+class SpatialDropout1D(Layer):
+    """Drop whole channels (SpatialDropout1D.scala)."""
+
+    def __init__(self, p=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, x.shape[2]))
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SpatialDropout2D(Layer):
+    def __init__(self, p=0.5, dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return x
+        keep = 1.0 - self.p
+        shape = ((x.shape[0], x.shape[1], 1, 1) if self.dim_ordering == "th"
+                 else (x.shape[0], 1, 1, x.shape[3]))
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class WithinChannelLRN2D(Layer):
+    """Local response normalization within channels (WithinChannelLRN2D.scala)."""
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.size, self.alpha, self.beta = int(size), float(alpha), float(beta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        # channels-last: average x^2 over a size x size spatial window
+        sq = x * x
+        window = (1, self.size, self.size, 1)
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window,
+                                       (1, 1, 1, 1), "SAME")
+        norm = (1.0 + self.alpha * summed / (self.size ** 2)) ** self.beta
+        return x / norm

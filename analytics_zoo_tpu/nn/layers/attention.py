@@ -1,0 +1,294 @@
+"""Transformer/BERT layers.
+
+Reference parity: `TransformerLayer` (keras/layers/TransformerLayer.scala:56-279, GPT-style
+blocks with optional bidirectionality) and `BERT` (keras/layers/BERT.scala:66-402: word +
+position + token-type embeddings, N post-LN encoder blocks, attention-mask input, pooled
+first-token output).
+
+TPU-native: attention runs through ops.attention.dot_product_attention (XLA einsum or the
+Pallas flash kernel for long sequences); all projections are fused [B*T, 3H]-style matmuls
+on the MXU.  Sequence-parallel (ring) attention for contexts beyond one chip's HBM lives
+in parallel/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn import activations
+from analytics_zoo_tpu.nn.module import Layer, initializer, split_rng, to_shape
+from analytics_zoo_tpu.ops.attention import dot_product_attention
+
+
+class LayerNorm(Layer):
+    """Layer normalization over the last axis (TransformerLayer.scala gLNorm)."""
+
+    def __init__(self, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = float(epsilon)
+
+    def build(self, rng, input_shape):
+        d = to_shape(input_shape)[-1]
+        return {"gamma": jnp.ones((d,), dtypes.param_dtype()),
+                "beta": jnp.zeros((d,), dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + self.epsilon)
+        return y * params["gamma"] + params["beta"]
+
+
+def _dense_p(rng, d_in, d_out, std=0.02):
+    return {"W": std * jax.random.normal(rng, (d_in, d_out), dtypes.param_dtype()),
+            "b": jnp.zeros((d_out,), dtypes.param_dtype())}
+
+
+def _linear(p, x):
+    xw, W = dtypes.cast_compute(x, p["W"])
+    return jnp.matmul(xw, W, preferred_element_type=jnp.float32) + p["b"]
+
+
+class MultiHeadAttention(Layer):
+    """Self-attention with fused qkv projection.  Input (B, T, H); optional mask via
+    `call(..., mask=)` reaches it through TransformerBlock."""
+
+    def __init__(self, hidden_size: int, n_head: int, causal: bool = False,
+                 attn_drop: float = 0.0, resid_drop: float = 0.0,
+                 initializer_range: float = 0.02, **kwargs):
+        super().__init__(**kwargs)
+        assert hidden_size % n_head == 0
+        self.hidden_size = int(hidden_size)
+        self.n_head = int(n_head)
+        self.causal = causal
+        self.attn_drop = float(attn_drop)
+        self.resid_drop = float(resid_drop)
+        self.std = initializer_range
+
+    def build(self, rng, input_shape):
+        h = self.hidden_size
+        r1, r2 = jax.random.split(rng)
+        return {"qkv": _dense_p(r1, h, 3 * h, self.std),
+                "out": _dense_p(r2, h, h, self.std)}
+
+    def attend(self, params, x, mask=None, *, training=False, rng=None):
+        B, T, H = x.shape
+        nh, hd = self.n_head, H // self.n_head
+        qkv = _linear(params["qkv"], x)                     # (B, T, 3H)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return jnp.transpose(t.reshape(B, T, nh, hd), (0, 2, 1, 3))
+
+        y = dot_product_attention(heads(q), heads(k), heads(v), mask=mask,
+                                  causal=self.causal)
+        y = jnp.transpose(y, (0, 2, 1, 3)).reshape(B, T, H)
+        y = _linear(params["out"], y)
+        if training and rng is not None and self.resid_drop > 0:
+            keep = 1.0 - self.resid_drop
+            y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
+                          y / keep, 0.0)
+        return y
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.attend(params, x, mask=None, training=training, rng=rng)
+
+
+class PositionwiseFFN(Layer):
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 activation="gelu", initializer_range=0.02, **kwargs):
+        super().__init__(**kwargs)
+        self.h = int(hidden_size)
+        self.i = int(intermediate_size)
+        self.act = activations.get(activation)
+        self.std = initializer_range
+
+    def build(self, rng, input_shape):
+        r1, r2 = jax.random.split(rng)
+        return {"fc": _dense_p(r1, self.h, self.i, self.std),
+                "proj": _dense_p(r2, self.i, self.h, self.std)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return _linear(params["proj"], self.act(_linear(params["fc"], x)))
+
+
+class TransformerBlock(Layer):
+    """Post-LN transformer block (TransformerLayer.scala `block`)."""
+
+    def __init__(self, hidden_size: int, n_head: int, intermediate_size=None,
+                 causal=False, attn_drop=0.0, resid_drop=0.0,
+                 activation="gelu", initializer_range=0.02, **kwargs):
+        super().__init__(**kwargs)
+        inter = intermediate_size or 4 * hidden_size
+        self.attn = MultiHeadAttention(hidden_size, n_head, causal=causal,
+                                       attn_drop=attn_drop,
+                                       resid_drop=resid_drop,
+                                       initializer_range=initializer_range,
+                                       name=self.name + "_attn")
+        self.ffn = PositionwiseFFN(hidden_size, inter, activation=activation,
+                                   initializer_range=initializer_range,
+                                   name=self.name + "_ffn")
+        self.ln1 = LayerNorm(name=self.name + "_ln1")
+        self.ln2 = LayerNorm(name=self.name + "_ln2")
+
+    def build(self, rng, input_shape):
+        r1, r2, r3, r4 = jax.random.split(rng, 4)
+        return {"attn": self.attn.build(r1, input_shape),
+                "ffn": self.ffn.build(r2, input_shape),
+                "ln1": self.ln1.build(r3, input_shape),
+                "ln2": self.ln2.build(r4, input_shape)}
+
+    def forward(self, params, x, mask=None, *, training=False, rng=None):
+        a = self.attn.attend(params["attn"], x, mask=mask, training=training,
+                             rng=split_rng(rng, 0))
+        x = self.ln1.call(params["ln1"], x + a)
+        f = self.ffn.call(params["ffn"], x, training=training,
+                          rng=split_rng(rng, 1))
+        return self.ln2.call(params["ln2"], x + f)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.forward(params, x, mask=None, training=training, rng=rng)
+
+
+class TransformerLayer(Layer):
+    """GPT-style transformer over token ids (TransformerLayer.scala:56-279).
+
+    Input (B, T) word ids; output (B, T, hidden).  `bidirectional=False` applies the
+    causal mask (the reference's default GPT behaviour)."""
+
+    def __init__(self, vocab: int, hidden_size: int = 768, n_block: int = 12,
+                 n_head: int = 12, seq_len: int = 512, embedding_drop=0.0,
+                 attn_drop=0.0, resid_drop=0.0, bidirectional=False,
+                 initializer_range=0.02, output_all_block=False, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab = int(vocab)
+        self.hidden_size = int(hidden_size)
+        self.n_block = int(n_block)
+        self.seq_len = int(seq_len)
+        self.embedding_drop = float(embedding_drop)
+        self.bidirectional = bidirectional
+        self.output_all_block = output_all_block
+        self.std = initializer_range
+        self.blocks = [TransformerBlock(hidden_size, n_head,
+                                        causal=not bidirectional,
+                                        attn_drop=attn_drop,
+                                        resid_drop=resid_drop,
+                                        initializer_range=initializer_range,
+                                        name=f"{self.name}_block{i}")
+                       for i in range(self.n_block)]
+
+    def build(self, rng, input_shape):
+        T = to_shape(input_shape)[0]
+        rw, rp, *rb = jax.random.split(rng, 2 + self.n_block)
+        p = {"wte": self.std * jax.random.normal(
+                rw, (self.vocab, self.hidden_size), dtypes.param_dtype()),
+             "wpe": self.std * jax.random.normal(
+                rp, (self.seq_len, self.hidden_size), dtypes.param_dtype())}
+        h_shape = (T, self.hidden_size)
+        for blk, r in zip(self.blocks, rb):
+            p[blk.name] = blk.build(r, h_shape)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 3:
+            ids = ids[..., 0]
+        T = ids.shape[1]
+        h = jnp.take(params["wte"], ids, axis=0) + params["wpe"][:T]
+        if training and rng is not None and self.embedding_drop > 0:
+            keep = 1.0 - self.embedding_drop
+            h = jnp.where(jax.random.bernoulli(split_rng(rng, 999), keep,
+                                               h.shape), h / keep, 0.0)
+        outs = []
+        for i, blk in enumerate(self.blocks):
+            h = blk.forward(params[blk.name], h, training=training,
+                            rng=split_rng(rng, i))
+            outs.append(h)
+        if self.output_all_block:
+            return jnp.stack(outs, axis=1)
+        return h
+
+
+class BERT(Layer):
+    """BERT encoder (BERT.scala:66-402).
+
+    Inputs: [token_ids (B,T), token_type_ids (B,T), attention_mask (B,T)] — position ids
+    are implicit 0..T-1 (the reference takes them as a 4th input; pass-through parity is
+    kept by the optional 4-element input).  Output: sequence states (B, T, H); use
+    `pooled()` on the first token for classification heads."""
+
+    def __init__(self, vocab: int, hidden_size: int = 768, n_block: int = 12,
+                 n_head: int = 12, max_position_len: int = 512,
+                 intermediate_size: int = 3072, hidden_drop=0.1, attn_drop=0.1,
+                 initializer_range=0.02, output_all_block=False,
+                 type_vocab: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab = int(vocab)
+        self.hidden_size = int(hidden_size)
+        self.n_block = int(n_block)
+        self.max_position_len = int(max_position_len)
+        self.type_vocab = int(type_vocab)
+        self.std = initializer_range
+        self.output_all_block = output_all_block
+        self.blocks = [TransformerBlock(hidden_size, n_head,
+                                        intermediate_size=intermediate_size,
+                                        causal=False, attn_drop=attn_drop,
+                                        resid_drop=hidden_drop,
+                                        initializer_range=initializer_range,
+                                        name=f"{self.name}_block{i}")
+                       for i in range(self.n_block)]
+        self.emb_ln = LayerNorm(name=self.name + "_embln")
+
+    def build(self, rng, input_shape):
+        shapes = input_shape if isinstance(input_shape, list) else [input_shape]
+        T = to_shape(shapes[0])[0]
+        rw, rp, rt, rln, rpool, *rb = jax.random.split(rng, 5 + self.n_block)
+        H = self.hidden_size
+        p = {"word": self.std * jax.random.normal(rw, (self.vocab, H),
+                                                  dtypes.param_dtype()),
+             "pos": self.std * jax.random.normal(
+                 rp, (self.max_position_len, H), dtypes.param_dtype()),
+             "type": self.std * jax.random.normal(rt, (self.type_vocab, H),
+                                                  dtypes.param_dtype()),
+             "embln": self.emb_ln.build(rln, (T, H)),
+             "pooler": _dense_p(rpool, H, H, self.std)}
+        for blk, r in zip(self.blocks, rb):
+            p[blk.name] = blk.build(r, (T, H))
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        ids = xs[0].astype(jnp.int32)
+        if ids.ndim == 3:
+            ids = ids[..., 0]
+        T = ids.shape[1]
+        types = (xs[1].astype(jnp.int32) if len(xs) > 1
+                 else jnp.zeros_like(ids))
+        if types.ndim == 3:
+            types = types[..., 0]
+        mask = xs[2] if len(xs) > 2 else None
+        h = (jnp.take(params["word"], ids, axis=0)
+             + params["pos"][:T]
+             + jnp.take(params["type"], types, axis=0))
+        h = self.emb_ln.call(params["embln"], h)
+        attn_mask = None
+        if mask is not None:
+            m = mask.reshape(mask.shape[0], -1)
+            attn_mask = m[:, None, None, :]  # (B, 1, 1, Tk)
+        outs = []
+        for i, blk in enumerate(self.blocks):
+            h = blk.forward(params[blk.name], h, mask=attn_mask,
+                            training=training, rng=split_rng(rng, i))
+            outs.append(h)
+        if self.output_all_block:
+            return jnp.stack(outs, axis=1)
+        return h
+
+    def pooled(self, params, seq_out):
+        """tanh(W * first_token) — BERT pooler (BERT.scala pooler output)."""
+        return jnp.tanh(_linear(params["pooler"], seq_out[:, 0]))

@@ -1,0 +1,341 @@
+"""Convolution layers (1D/2D/3D, transposed, separable, dilated).
+
+Reference parity: pipeline/api/keras/layers/{Convolution1D,Convolution2D,Convolution3D,
+Deconvolution2D,SeparableConvolution2D,AtrousConvolution1D/2D,Cropping*,UpSampling*,
+ZeroPadding*}.scala.  TPU-native: all convs lower to `lax.conv_general_dilated` in NHWC
+layout (`dim_ordering="tf"` default — the MXU-friendly layout; "th"/NCHW inputs are
+transposed on entry), bfloat16 compute with float32 accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn import activations
+from analytics_zoo_tpu.nn.module import Layer, initializer, to_shape
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _pad_str(border_mode: str) -> str:
+    if border_mode in ("same", "SAME"):
+        return "SAME"
+    if border_mode in ("valid", "VALID"):
+        return "VALID"
+    raise ValueError(f"unknown border_mode {border_mode!r}")
+
+
+class _ConvND(Layer):
+    """Shared core for spatial convolutions; NHWC-family layouts."""
+
+    ndim = 2
+
+    def __init__(self, nb_filter: int, kernel_size, activation=None,
+                 border_mode="valid", subsample=1, dilation=1,
+                 init="glorot_uniform", bias: bool = True,
+                 dim_ordering: str = "tf", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _pair(kernel_size, self.ndim)
+        self.activation = activations.get(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample, self.ndim)
+        self.dilation = _pair(dilation, self.ndim)
+        self.init_name = init
+        self.bias = bias
+        self.dim_ordering = dim_ordering  # "tf"=channels_last, "th"=channels_first
+
+    def _dn(self):
+        spatial = "".join("DHW"[-self.ndim:])
+        lhs = "N" + spatial + "C"
+        rhs = spatial + "IO"
+        return jax.lax.conv_dimension_numbers(
+            (1,) * (self.ndim + 2), (1,) * (self.ndim + 2), (lhs, rhs, lhs))
+
+    def _to_tf(self, x):
+        if self.dim_ordering == "th":   # NC... -> N...C
+            perm = (0,) + tuple(range(2, x.ndim)) + (1,)
+            return jnp.transpose(x, perm)
+        return x
+
+    def _from_tf(self, y):
+        if self.dim_ordering == "th":
+            perm = (0, y.ndim - 1) + tuple(range(1, y.ndim - 1))
+            return jnp.transpose(y, perm)
+        return y
+
+    def _in_channels(self, input_shape):
+        s = to_shape(input_shape)
+        return s[0] if self.dim_ordering == "th" else s[-1]
+
+    def build(self, rng, input_shape):
+        cin = self._in_channels(input_shape)
+        rw, _ = jax.random.split(rng)
+        kshape = self.kernel_size + (cin, self.nb_filter)
+        fan_in = int(np.prod(self.kernel_size)) * cin
+        fan_out = int(np.prod(self.kernel_size)) * self.nb_filter
+        p = {"W": initializer(self.init_name, rw, kshape, dtypes.param_dtype(),
+                              fan_in=fan_in, fan_out=fan_out)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = self._to_tf(x)
+        xw, W = dtypes.cast_compute(x, params["W"])
+        y = jax.lax.conv_general_dilated(
+            xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode),
+            rhs_dilation=self.dilation, dimension_numbers=self._dn(),
+            preferred_element_type=dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        return self._from_tf(self.activation(y))
+
+
+class Convolution1D(_ConvND):
+    ndim = 1
+
+
+class Convolution2D(_ConvND):
+    ndim = 2
+
+
+class Convolution3D(_ConvND):
+    ndim = 3
+
+
+class AtrousConvolution1D(Convolution1D):
+    def __init__(self, nb_filter, kernel_size, atrous_rate=1, **kwargs):
+        super().__init__(nb_filter, kernel_size, dilation=atrous_rate, **kwargs)
+
+
+class AtrousConvolution2D(Convolution2D):
+    def __init__(self, nb_filter, kernel_size, atrous_rate=(1, 1), **kwargs):
+        super().__init__(nb_filter, kernel_size, dilation=atrous_rate, **kwargs)
+
+
+class Deconvolution2D(Layer):
+    """Transposed 2D convolution (Deconvolution2D.scala)."""
+
+    def __init__(self, nb_filter, kernel_size, activation=None, subsample=1,
+                 border_mode="valid", init="glorot_uniform", bias=True,
+                 dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _pair(kernel_size)
+        self.activation = activations.get(activation)
+        self.subsample = _pair(subsample)
+        self.border_mode = border_mode
+        self.init_name = init
+        self.bias = bias
+        self.dim_ordering = dim_ordering
+
+    def build(self, rng, input_shape):
+        s = to_shape(input_shape)
+        cin = s[0] if self.dim_ordering == "th" else s[-1]
+        kshape = self.kernel_size + (self.nb_filter, cin)  # OI order for transpose
+        p = {"W": initializer(self.init_name, rng, kshape, dtypes.param_dtype(),
+                              fan_in=int(np.prod(self.kernel_size)) * cin,
+                              fan_out=int(np.prod(self.kernel_size)) * self.nb_filter)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        th = self.dim_ordering == "th"
+        if th:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        xw, W = dtypes.cast_compute(x, params["W"])
+        y = jax.lax.conv_transpose(
+            xw, W, strides=self.subsample, padding=_pad_str(self.border_mode),
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            preferred_element_type=dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        y = self.activation(y)
+        return jnp.transpose(y, (0, 3, 1, 2)) if th else y
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise + pointwise conv (SeparableConvolution2D.scala)."""
+
+    def __init__(self, nb_filter, kernel_size, depth_multiplier=1,
+                 activation=None, subsample=1, border_mode="valid",
+                 init="glorot_uniform", bias=True, dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = _pair(kernel_size)
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = activations.get(activation)
+        self.subsample = _pair(subsample)
+        self.border_mode = border_mode
+        self.init_name = init
+        self.bias = bias
+        self.dim_ordering = dim_ordering
+
+    def build(self, rng, input_shape):
+        s = to_shape(input_shape)
+        cin = s[0] if self.dim_ordering == "th" else s[-1]
+        rd, rp = jax.random.split(rng)
+        p = {"depthwise": initializer(
+                self.init_name, rd,
+                self.kernel_size + (1, cin * self.depth_multiplier),
+                dtypes.param_dtype(),
+                fan_in=int(np.prod(self.kernel_size)),
+                fan_out=int(np.prod(self.kernel_size)) * self.depth_multiplier),
+             "pointwise": initializer(
+                self.init_name, rp,
+                (1, 1, cin * self.depth_multiplier, self.nb_filter),
+                dtypes.param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        th = self.dim_ordering == "th"
+        if th:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        cin = x.shape[-1]
+        dn = jax.lax.conv_dimension_numbers(x.shape, params["depthwise"].shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+        xw, dw, pw = dtypes.cast_compute(x, params["depthwise"],
+                                         params["pointwise"])
+        y = jax.lax.conv_general_dilated(
+            xw, dw, window_strides=self.subsample,
+            padding=_pad_str(self.border_mode), dimension_numbers=dn,
+            feature_group_count=cin, preferred_element_type=dtypes.param_dtype())
+        y = jax.lax.conv_general_dilated(
+            dtypes.cast_compute(y), pw, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                y.shape, params["pointwise"].shape, ("NHWC", "HWIO", "NHWC")),
+            preferred_element_type=dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        y = self.activation(y)
+        return jnp.transpose(y, (0, 3, 1, 2)) if th else y
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding=1, **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _pair(padding, 2) if isinstance(padding, (tuple, list)) \
+            else (int(padding), int(padding))
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.pad(x, ((0, 0), self.padding, (0, 0)))
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding=(1, 1), dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _pair(padding)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        ph, pw = self.padding
+        if self.dim_ordering == "th":
+            return jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = _pair(cropping, 2)
+
+    def call(self, params, x, *, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b, :]
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(int(i) for i in c) for c in cropping)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        if self.dim_ordering == "th":
+            return x[:, :, t:x.shape[2] - b, l:x.shape[3] - r]
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :]
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length=2, **kwargs):
+        super().__init__(**kwargs)
+        self.length = int(length)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.size = _pair(size)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        h_ax, w_ax = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        x = jnp.repeat(x, self.size[0], axis=h_ax)
+        return jnp.repeat(x, self.size[1], axis=w_ax)
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.size = _pair(size, 3)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        for ax, s in zip(axes, self.size):
+            x = jnp.repeat(x, s, axis=ax)
+        return x
+
+
+class LocallyConnected1D(Layer):
+    """Unshared-weights 1D conv (LocallyConnected1D.scala) — small windows, so an
+    unrolled einsum is fine."""
+
+    def __init__(self, nb_filter, filter_length, activation=None, bias=True,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        steps, cin = to_shape(input_shape)
+        out_steps = steps - self.filter_length + 1
+        p = {"W": initializer(self.init_name, rng,
+                              (out_steps, self.filter_length * cin,
+                               self.nb_filter), dtypes.param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((out_steps, self.nb_filter),
+                               dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        k = self.filter_length
+        out_steps = x.shape[1] - k + 1
+        # windows: (B, out_steps, k*C)
+        idx = jnp.arange(out_steps)[:, None] + jnp.arange(k)[None, :]
+        win = x[:, idx, :].reshape(x.shape[0], out_steps, -1)
+        y = jnp.einsum("bsk,sko->bso", *dtypes.cast_compute(win, params["W"]),
+                       preferred_element_type=dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y)

@@ -1,0 +1,116 @@
+"""Pooling layers (max/avg, 1D/2D/3D, global variants).
+
+Reference parity: pipeline/api/keras/layers/{MaxPooling1D/2D/3D,AveragePooling1D/2D/3D,
+GlobalMaxPooling1D/2D/3D,GlobalAveragePooling1D/2D/3D}.scala.  All lower to
+`lax.reduce_window` — XLA maps these straight onto the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _tuplize(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+class _PoolND(Layer):
+    ndim = 2
+    op = "max"
+
+    def __init__(self, pool_size=2, strides=None, border_mode="valid",
+                 dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _tuplize(pool_size, self.ndim)
+        self.strides = _tuplize(strides, self.ndim) if strides else self.pool_size
+        self.border_mode = border_mode.upper()
+        self.dim_ordering = dim_ordering
+
+    def _spatial_axes(self, rank):
+        if self.dim_ordering == "th":
+            return tuple(range(2, 2 + self.ndim))
+        return tuple(range(1, 1 + self.ndim))
+
+    def call(self, params, x, *, training=False, rng=None):
+        rank = x.ndim
+        window = [1] * rank
+        strides = [1] * rank
+        for ax, w, s in zip(self._spatial_axes(rank), self.pool_size, self.strides):
+            window[ax], strides[ax] = w, s
+        if self.op == "max":
+            init, fn = -jnp.inf, jax.lax.max
+            y = jax.lax.reduce_window(x, init, fn, window, strides,
+                                      self.border_mode)
+        else:
+            y = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                      self.border_mode)
+            y = y / float(np.prod(self.pool_size))
+        return y
+
+
+class MaxPooling1D(_PoolND):
+    ndim, op = 1, "max"
+
+
+class MaxPooling2D(_PoolND):
+    ndim, op = 2, "max"
+
+
+class MaxPooling3D(_PoolND):
+    ndim, op = 3, "max"
+
+
+class AveragePooling1D(_PoolND):
+    ndim, op = 1, "avg"
+
+
+class AveragePooling2D(_PoolND):
+    ndim, op = 2, "avg"
+
+
+class AveragePooling3D(_PoolND):
+    ndim, op = 3, "avg"
+
+
+class _GlobalPool(Layer):
+    ndim = 2
+    op = "max"
+
+    def __init__(self, dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        axes = (tuple(range(2, 2 + self.ndim)) if self.dim_ordering == "th"
+                else tuple(range(1, 1 + self.ndim)))
+        return jnp.max(x, axis=axes) if self.op == "max" else jnp.mean(x, axis=axes)
+
+
+class GlobalMaxPooling1D(_GlobalPool):
+    ndim, op = 1, "max"
+
+
+class GlobalMaxPooling2D(_GlobalPool):
+    ndim, op = 2, "max"
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    ndim, op = 3, "max"
+
+
+class GlobalAveragePooling1D(_GlobalPool):
+    ndim, op = 1, "avg"
+
+
+class GlobalAveragePooling2D(_GlobalPool):
+    ndim, op = 2, "avg"
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    ndim, op = 3, "avg"

@@ -1,0 +1,57 @@
+"""Attention compute cores.
+
+The single entry point `dot_product_attention` is used by every attention layer
+(TransformerLayer/BERT) and by the sequence-parallel ring attention in
+`parallel/ring_attention.py`.  Two implementations:
+
+- `_attention_xla`: plain jnp einsum softmax — XLA fuses this well for short sequences.
+- `flash_attention`: blockwise online-softmax Pallas TPU kernel for long sequences
+  (O(T) memory instead of O(T^2)); selected automatically on TPU when shapes allow.
+
+Reference note: the reference materialises full (T, T) attention matrices
+(TransformerLayer.scala:56-279); the flash path is the TPU-native upgrade that makes
+long-context work at all.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _attention_xla(q, k, v, mask=None, causal=False, scale=None):
+    """q,k,v: (B, H, T, D).  mask: broadcastable to (B, H, Tq, Tk), 1=keep."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        logits = jnp.where(cm, logits, -1e9)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e9)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False,
+                          scale: Optional[float] = None,
+                          use_flash: Optional[bool] = None):
+    """Multi-head attention core; picks the Pallas flash kernel on TPU for long
+    sequences, else the XLA path."""
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" and q.shape[-2] >= 512
+                     and mask is None and q.shape[-1] <= 256)
+    if use_flash:
+        try:
+            from analytics_zoo_tpu.ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)

@@ -1,0 +1,206 @@
+"""Layer-library sweep: conv/pooling/recurrent/advanced/attention.
+
+Numeric oracles follow the reference's KerasBaseSpec differential-testing approach
+(SURVEY.md §4) — here against straight numpy implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import (
+    BERT, GRU, LSTM, AveragePooling2D, Bidirectional, ConvLSTM2D, Convolution1D,
+    Convolution2D, Deconvolution2D, Dense, GlobalAveragePooling2D, GlobalMaxPooling1D,
+    Highway, LayerNorm, LeakyReLU, MaxoutDense, MaxPooling2D, MultiHeadAttention,
+    PReLU, SeparableConvolution2D, SimpleRNN, SReLU, TimeDistributed,
+    TransformerLayer, UpSampling2D, ZeroPadding2D)
+
+
+def _run(layer, x, rngk=0, **kw):
+    params, state = layer.init(jax.random.PRNGKey(rngk), x.shape[1:])
+    y, _ = layer.apply(params, state, jnp.asarray(x), **kw)
+    return params, np.asarray(y)
+
+
+def test_conv2d_shapes_and_numeric(ctx):
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, 3)).astype(np.float32)
+    layer = Convolution2D(5, 3, border_mode="valid")
+    params, y = _run(layer, x)
+    assert y.shape == (2, 6, 6, 5)
+    # numeric oracle at one output position
+    W, b = np.asarray(params["W"]), np.asarray(params["b"])
+    expect = (x[0, :3, :3, :, None] * W).sum((0, 1, 2)) + b
+    np.testing.assert_allclose(y[0, 0, 0], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_same_stride(ctx):
+    x = np.ones((1, 9, 9, 2), np.float32)
+    layer = Convolution2D(4, 3, border_mode="same", subsample=2)
+    _, y = _run(layer, x)
+    assert y.shape == (1, 5, 5, 4)
+
+
+def test_conv2d_th_ordering(ctx):
+    x = np.random.default_rng(0).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    layer = Convolution2D(5, 3, dim_ordering="th")
+    _, y = _run(layer, x)
+    assert y.shape == (2, 5, 6, 6)
+
+
+def test_conv1d(ctx):
+    x = np.random.default_rng(1).normal(size=(2, 10, 4)).astype(np.float32)
+    _, y = _run(Convolution1D(6, 3), x)
+    assert y.shape == (2, 8, 6)
+
+
+def test_deconv_and_separable(ctx):
+    x = np.random.default_rng(2).normal(size=(2, 5, 5, 3)).astype(np.float32)
+    _, y = _run(Deconvolution2D(4, 3, subsample=2), x)
+    assert y.shape[0] == 2 and y.shape[-1] == 4 and y.shape[1] > 5
+    _, y2 = _run(SeparableConvolution2D(6, 3), x)
+    assert y2.shape == (2, 3, 3, 6)
+
+
+def test_pooling(ctx):
+    x = np.arange(32, dtype=np.float32).reshape(1, 4, 4, 2)
+    _, y = _run(MaxPooling2D(2), x)
+    assert y.shape == (1, 2, 2, 2)
+    assert y[0, 0, 0, 0] == x[0, :2, :2, 0].max()
+    _, ya = _run(AveragePooling2D(2), x)
+    np.testing.assert_allclose(ya[0, 0, 0, 0], x[0, :2, :2, 0].mean(), rtol=1e-6)
+    _, yg = _run(GlobalAveragePooling2D(), x)
+    np.testing.assert_allclose(yg[0], x[0].mean((0, 1)), rtol=1e-6)
+    x1 = np.random.default_rng(0).normal(size=(2, 7, 3)).astype(np.float32)
+    _, ygm = _run(GlobalMaxPooling1D(), x1)
+    np.testing.assert_allclose(ygm, x1.max(1), rtol=1e-6)
+
+
+def test_padding_upsampling(ctx):
+    x = np.ones((1, 2, 2, 1), np.float32)
+    _, y = _run(ZeroPadding2D((1, 2)), x)
+    assert y.shape == (1, 4, 6, 1)
+    _, y2 = _run(UpSampling2D((2, 3)), x)
+    assert y2.shape == (1, 4, 6, 1)
+
+
+def test_simple_rnn_oracle(ctx):
+    """SimpleRNN against a hand-rolled numpy loop."""
+    B, T, D, H = 2, 4, 3, 5
+    x = np.random.default_rng(3).normal(size=(B, T, D)).astype(np.float32)
+    layer = SimpleRNN(H, activation="tanh", return_sequences=True)
+    params, y = _run(layer, x)
+    Wx, Wh, b = (np.asarray(params[k]) for k in ("Wx", "Wh", "b"))
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        h = np.tanh(x[:, t] @ Wx + h @ Wh + b)
+        np.testing.assert_allclose(y[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gru_shapes_and_final_state(ctx):
+    x = np.random.default_rng(4).normal(size=(3, 6, 4)).astype(np.float32)
+    _, y_seq = _run(LSTM(7, return_sequences=True), x)
+    assert y_seq.shape == (3, 6, 7)
+    layer = LSTM(7, return_sequences=False)
+    params, y_last = _run(layer, x)
+    y_seq2 = layer.__class__(7, return_sequences=True)
+    y_full, _ = y_seq2.apply(params, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y_full)[:, -1], y_last, rtol=1e-5)
+    _, g = _run(GRU(5), x)
+    assert g.shape == (3, 5)
+
+
+def test_bidirectional(ctx):
+    x = np.random.default_rng(5).normal(size=(2, 5, 3)).astype(np.float32)
+    _, y = _run(Bidirectional(LSTM(4, return_sequences=True)), x)
+    assert y.shape == (2, 5, 8)
+    _, y2 = _run(Bidirectional(GRU(4), merge_mode="sum"), x)
+    assert y2.shape == (2, 4)
+
+
+def test_time_distributed(ctx):
+    x = np.random.default_rng(6).normal(size=(2, 5, 3)).astype(np.float32)
+    layer = TimeDistributed(Dense(4))
+    params, y = _run(layer, x)
+    assert y.shape == (2, 5, 4)
+    W = np.asarray(params["inner"]["W"])
+    b = np.asarray(params["inner"]["b"])
+    np.testing.assert_allclose(y[1, 3], x[1, 3] @ W + b, rtol=1e-4, atol=1e-5)
+
+
+def test_convlstm2d(ctx):
+    x = np.random.default_rng(7).normal(size=(2, 3, 6, 6, 2)).astype(np.float32)
+    _, y = _run(ConvLSTM2D(4, 3), x)
+    assert y.shape == (2, 6, 6, 4)
+
+
+def test_advanced_activations(ctx):
+    x = np.asarray([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+    _, y = _run(LeakyReLU(0.1), x)
+    np.testing.assert_allclose(y, [[-0.2, -0.05, 0.5, 2.0]], rtol=1e-6)
+    _, yp = _run(PReLU(), x)
+    np.testing.assert_allclose(yp, [[-0.5, -0.125, 0.5, 2.0]], rtol=1e-6)
+    _, ys = _run(SReLU(), x)
+    assert ys.shape == x.shape
+    _, ym = _run(MaxoutDense(3, nb_feature=2), x)
+    assert ym.shape == (1, 3)
+    _, yh = _run(Highway(), x)
+    assert yh.shape == x.shape
+
+
+def test_layernorm(ctx):
+    x = np.random.default_rng(8).normal(2.0, 3.0, size=(4, 10)).astype(np.float32)
+    _, y = _run(LayerNorm(), x)
+    np.testing.assert_allclose(y.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_multihead_attention_causal(ctx):
+    """Causal attention: output at t must not depend on tokens > t."""
+    B, T, H = 1, 6, 8
+    x = np.random.default_rng(9).normal(size=(B, T, H)).astype(np.float32)
+    layer = MultiHeadAttention(H, 2, causal=True)
+    params, y = _run(layer, x)
+    x2 = x.copy()
+    x2[:, -1] += 100.0  # perturb last token
+    y2, _ = layer.apply(params, {}, jnp.asarray(x2))
+    np.testing.assert_allclose(y[:, :-1], np.asarray(y2)[:, :-1], atol=1e-4)
+    assert not np.allclose(y[:, -1], np.asarray(y2)[:, -1])
+
+
+def test_transformer_layer(ctx):
+    layer = TransformerLayer(vocab=50, hidden_size=16, n_block=2, n_head=2,
+                             seq_len=12)
+    ids = np.random.default_rng(10).integers(0, 50, (2, 12)).astype(np.float32)
+    params, y = _run(layer, ids)
+    assert y.shape == (2, 12, 16)
+
+
+def test_bert_with_mask(ctx):
+    bert = BERT(vocab=60, hidden_size=16, n_block=2, n_head=2,
+                max_position_len=10, intermediate_size=32)
+    B, T = 2, 8
+    g = np.random.default_rng(11)
+    ids = g.integers(0, 60, (B, T)).astype(np.float32)
+    segs = np.zeros((B, T), np.float32)
+    mask = np.ones((B, T), np.float32)
+    shapes = [(T,), (T,), (T,)]
+    params, state = bert.init(jax.random.PRNGKey(0), shapes)
+    y, _ = bert.apply(params, state, [jnp.asarray(ids), jnp.asarray(segs),
+                                      jnp.asarray(mask)])
+    assert y.shape == (B, T, 16)
+    pooled = bert.pooled(params, y)
+    assert np.asarray(pooled).shape == (B, 16)
+    # masked positions must not affect unmasked outputs
+    mask2 = mask.copy()
+    mask2[:, -1] = 0.0
+    ids2 = ids.copy()
+    ids2[:, -1] = 3
+    y_m1, _ = bert.apply(params, state, [jnp.asarray(ids2), jnp.asarray(segs),
+                                         jnp.asarray(mask2)])
+    ids3 = ids.copy()
+    ids3[:, -1] = 7
+    y_m2, _ = bert.apply(params, state, [jnp.asarray(ids3), jnp.asarray(segs),
+                                         jnp.asarray(mask2)])
+    np.testing.assert_allclose(np.asarray(y_m1)[:, :-1],
+                               np.asarray(y_m2)[:, :-1], atol=1e-4)
