@@ -1,1 +1,5 @@
 from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+from analytics_zoo_tpu.models.seq2seq import Seq2seq
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM, evaluate_map, evaluate_ndcg

@@ -2,3 +2,7 @@ from analytics_zoo_tpu.models.recommendation.neuralcf import NeuralCF
 from analytics_zoo_tpu.models.recommendation.recommender import (
     Recommender, UserItemFeature, UserItemPrediction, evaluate_ranking,
     generate_negative_samples, hit_ratio, ndcg)
+from analytics_zoo_tpu.models.recommendation.session_recommender import (
+    SessionRecommender)
+from analytics_zoo_tpu.models.recommendation.wide_and_deep import (
+    ColumnFeatureInfo, WideAndDeep)

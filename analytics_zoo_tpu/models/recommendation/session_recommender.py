@@ -1,0 +1,75 @@
+"""SessionRecommender — GRU session-based recommendation.
+
+Reference parity: models/recommendation/SessionRecommender.scala:45-209 — item-id session
+sequence → embedding → GRU → softmax over the item vocabulary; optionally a user-history
+MLP branch (`include_history`) whose multi-hot encoding is summed into the logits.
+`recommend_for_session` returns top-k (item, prob) pairs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.nn.graph import Input
+from analytics_zoo_tpu.nn.layers.core import Activation, Dense, Embedding, merge
+from analytics_zoo_tpu.nn.layers.recurrent import GRU
+from analytics_zoo_tpu.nn.models import Model
+
+
+class SessionRecommender(ZooModel):
+    def __init__(self, item_count: int, item_embed: int = 100,
+                 rnn_hidden_layers: Sequence[int] = (40, 20),
+                 session_length: int = 10, include_history: bool = False,
+                 mlp_hidden_layers: Sequence[int] = (40, 20),
+                 history_length: int = 5):
+        self.item_count = int(item_count)
+        self.item_embed = int(item_embed)
+        self.rnn_hidden_layers = tuple(rnn_hidden_layers)
+        self.session_length = int(session_length)
+        self.include_history = include_history
+        self.mlp_hidden_layers = tuple(mlp_hidden_layers)
+        self.history_length = int(history_length)
+        super().__init__()
+
+    def build_model(self) -> Model:
+        session = Input(shape=(self.session_length,), name="session_input")
+        h = Embedding(self.item_count + 1, self.item_embed,
+                      name="sr_item_embed")(session)
+        for i, width in enumerate(self.rnn_hidden_layers):
+            last = i == len(self.rnn_hidden_layers) - 1
+            h = GRU(width, return_sequences=not last, name=f"sr_gru{i}")(h)
+        rnn_logits = Dense(self.item_count + 1, name="sr_rnn_out")(h)
+        inputs = [session]
+        if self.include_history:
+            hist = Input(shape=(self.history_length,), name="history_input")
+            inputs.append(hist)
+            m = Embedding(self.item_count + 1, self.item_embed,
+                          name="sr_hist_embed")(hist)
+            from analytics_zoo_tpu.nn.layers.core import Lambda
+            import jax.numpy as jnp
+            m = Lambda(lambda t: jnp.mean(t, axis=1), name="sr_hist_mean")(m)
+            for i, width in enumerate(self.mlp_hidden_layers):
+                m = Dense(width, activation="relu", name=f"sr_mlp{i}")(m)
+            mlp_logits = Dense(self.item_count + 1, name="sr_mlp_out")(m)
+            logits = merge([rnn_logits, mlp_logits], mode="sum", name="sr_sum")
+        else:
+            logits = rnn_logits
+        out = Activation("softmax", name="sr_softmax")(logits)
+        return Model(input=inputs, output=out, name="SessionRecommender")
+
+    def recommend_for_session(self, sessions: np.ndarray, max_items: int = 5,
+                              history: np.ndarray = None,
+                              batch_size: int = 1024
+                              ) -> List[List[Tuple[int, float]]]:
+        x = [np.asarray(sessions, np.float32)]
+        if self.include_history:
+            x.append(np.asarray(history, np.float32))
+        probs = self.predict(x, batch_size=batch_size)
+        out = []
+        for row in probs:
+            top = np.argsort(-row)[:max_items]
+            out.append([(int(i), float(row[i])) for i in top])
+        return out

@@ -1,0 +1,187 @@
+"""Seq2seq — RNN encoder/decoder with bridge.
+
+Reference parity: models/seq2seq/Seq2seq.scala:50-302, RNNEncoder/RNNDecoder:1-205/212,
+Bridge.scala:1-156.  Encoder: stacked LSTM/GRU consuming (B, T_enc, D_in); its final
+states initialise the decoder (optionally adapted through a dense "bridge").  Training
+uses teacher forcing: model([enc_in, dec_in]) -> (B, T_dec, vocab) softmax.  `infer`
+runs the greedy decode loop.
+
+TPU-native: both rollouts are lax.scan programs; greedy decode is a scan carrying
+(states, token) so inference jits to a single XLA while-style program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.module import Layer, initializer, to_shape
+from analytics_zoo_tpu.nn.models import KerasNet
+
+
+class _LSTMCellStack:
+    """Functional stacked-LSTM helpers shared by encoder/decoder."""
+
+    @staticmethod
+    def build(rng, input_dim: int, hidden_sizes: Sequence[int], init_name: str):
+        params = []
+        d = input_dim
+        for i, h in enumerate(hidden_sizes):
+            r = jax.random.fold_in(rng, i)
+            r1, r2 = jax.random.split(r)
+            params.append({
+                "Wx": initializer(init_name, r1, (d, 4 * h),
+                                  dtypes.param_dtype(), fan_in=d, fan_out=h),
+                "Wh": initializer("orthogonal", r2, (h, 4 * h),
+                                  dtypes.param_dtype()),
+                "b": jnp.zeros((4 * h,), dtypes.param_dtype())})
+            d = h
+        return params
+
+    @staticmethod
+    def step(params, states, x_t):
+        """One step through the whole stack.  states: list of (h, c)."""
+        new_states = []
+        inp = x_t
+        for p, (h, c) in zip(params, states):
+            H = h.shape[-1]
+            xw, Wx, Wh = dtypes.cast_compute(inp, p["Wx"], p["Wh"])
+            hw = dtypes.cast_compute(h)
+            z = (jnp.matmul(xw, Wx, preferred_element_type=jnp.float32)
+                 + jnp.matmul(hw, Wh, preferred_element_type=jnp.float32)
+                 + p["b"])
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H:])
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            new_states.append((h_new, c_new))
+            inp = h_new
+        return new_states, inp
+
+    @staticmethod
+    def zero_states(batch: int, hidden_sizes: Sequence[int]):
+        return [(jnp.zeros((batch, h), jnp.float32),
+                 jnp.zeros((batch, h), jnp.float32)) for h in hidden_sizes]
+
+
+class Seq2seq(KerasNet):
+    """Multi-input layer: call on [enc_inputs (B,T_enc) ids or (B,T_enc,D) vectors,
+    dec_inputs (B,T_dec) ids]."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 64,
+                 hidden_sizes: Sequence[int] = (128,),
+                 bridge: str = "dense", init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.hidden_sizes = tuple(hidden_sizes)
+        self.bridge_kind = bridge
+        self.init_name = init
+        self._declared_input_shape = [(None,), (None,)]
+
+    def build(self, rng, input_shape=None) -> dict:
+        re, rd, rb, remb, rout = jax.random.split(rng, 5)
+        H = self.hidden_sizes
+        p = {
+            "embed": initializer("uniform", remb,
+                                 (self.vocab_size, self.embed_dim),
+                                 dtypes.param_dtype()),
+            "encoder": _LSTMCellStack.build(re, self.embed_dim, H,
+                                            self.init_name),
+            "decoder": _LSTMCellStack.build(rd, self.embed_dim, H,
+                                            self.init_name),
+            "out": {"W": initializer(self.init_name, rout,
+                                     (H[-1], self.vocab_size),
+                                     dtypes.param_dtype()),
+                    "b": jnp.zeros((self.vocab_size,), dtypes.param_dtype())},
+        }
+        if self.bridge_kind == "dense":
+            bridges = []
+            for i, h in enumerate(H):
+                r = jax.random.fold_in(rb, i)
+                r1, r2 = jax.random.split(r)
+                bridges.append({
+                    "Wh": initializer(self.init_name, r1, (h, h),
+                                      dtypes.param_dtype()),
+                    "Wc": initializer(self.init_name, r2, (h, h),
+                                      dtypes.param_dtype())})
+            p["bridge"] = bridges
+        return p
+
+    def _embed(self, params, ids):
+        return jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+
+    def _encode(self, params, enc_in):
+        xs = jnp.swapaxes(self._embed(params, enc_in), 0, 1)
+        states0 = _LSTMCellStack.zero_states(enc_in.shape[0], self.hidden_sizes)
+
+        def body(states, x_t):
+            new_states, _ = _LSTMCellStack.step(params["encoder"], states, x_t)
+            return new_states, 0.0
+
+        final_states, _ = jax.lax.scan(body, states0, xs)
+        return final_states
+
+    def _bridge(self, params, states):
+        if self.bridge_kind != "dense":
+            return states
+        out = []
+        for p, (h, c) in zip(params["bridge"], states):
+            out.append((jnp.tanh(h @ p["Wh"]), jnp.tanh(c @ p["Wc"])))
+        return out
+
+    def _project(self, params, h):
+        hw, W = dtypes.cast_compute(h, params["out"]["W"])
+        return jnp.matmul(hw, W, preferred_element_type=jnp.float32) \
+            + params["out"]["b"]
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        enc_in, dec_in = inputs[0], inputs[1]
+        if enc_in.ndim == 3 and enc_in.shape[-1] == 1:
+            enc_in = enc_in[..., 0]
+        if dec_in.ndim == 3 and dec_in.shape[-1] == 1:
+            dec_in = dec_in[..., 0]
+        states = self._bridge(params, self._encode(params, enc_in))
+        ys = jnp.swapaxes(self._embed(params, dec_in), 0, 1)
+
+        def body(st, y_t):
+            new_st, top = _LSTMCellStack.step(params["decoder"], st, y_t)
+            return new_st, top
+
+        _, tops = jax.lax.scan(body, states, ys)
+        logits = self._project(params, jnp.swapaxes(tops, 0, 1))
+        return jax.nn.softmax(logits, axis=-1)
+
+    # -- greedy inference (Seq2seq.scala infer) -------------------------------
+    def infer(self, params, enc_in, start_sign: int, max_seq_len: int = 30,
+              stop_sign: Optional[int] = None):
+        enc_in = jnp.asarray(enc_in)
+        if enc_in.ndim == 3 and enc_in.shape[-1] == 1:
+            enc_in = enc_in[..., 0]
+        B = enc_in.shape[0]
+        states = self._bridge(params, self._encode(params, enc_in))
+        tok0 = jnp.full((B,), start_sign, jnp.int32)
+
+        def body(carry, _):
+            st, tok = carry
+            emb = jnp.take(params["embed"], tok, axis=0)
+            new_st, top = _LSTMCellStack.step(params["decoder"], st, emb)
+            logits = self._project(params, top)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (new_st, nxt), nxt
+
+        _, toks = jax.lax.scan(body, (states, tok0), None, length=max_seq_len)
+        out = np.asarray(jnp.swapaxes(toks, 0, 1))
+        if stop_sign is not None:
+            trimmed = []
+            for row in out:
+                stops = np.where(row == stop_sign)[0]
+                trimmed.append(row[:stops[0]] if len(stops) else row)
+            return trimmed
+        return out

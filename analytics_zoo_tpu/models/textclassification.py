@@ -1,0 +1,66 @@
+"""TextClassifier — CNN/LSTM/GRU text classification.
+
+Reference parity: models/textclassification/TextClassifier.scala:34-192 — token-id
+sequences → embedding → encoder (cnn: Conv1D(k=5)+GlobalMaxPool; lstm/gru: last state) →
+Dense(128 relu) → Dense(class_num, softmax).  The reference loads GloVe into the
+embedding; pass `embedding_weights` for the same effect.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.nn.layers.conv import Convolution1D
+from analytics_zoo_tpu.nn.layers.core import Dense, Embedding
+from analytics_zoo_tpu.nn.layers.pooling import GlobalMaxPooling1D
+from analytics_zoo_tpu.nn.layers.recurrent import GRU, LSTM
+from analytics_zoo_tpu.nn.models import Sequential
+
+
+class TextClassifier(ZooModel):
+    def __init__(self, class_num: int, vocab_size: int, embedding_dim: int = 200,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256,
+                 embedding_weights: Optional[np.ndarray] = None):
+        self.class_num = int(class_num)
+        self.vocab_size = int(vocab_size)
+        self.embedding_dim = int(embedding_dim)
+        self.sequence_length = int(sequence_length)
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = int(encoder_output_dim)
+        self.embedding_weights = embedding_weights
+        super().__init__()
+
+    def build_model(self) -> Sequential:
+        m = Sequential(name="TextClassifier")
+        m.add(Embedding(self.vocab_size, self.embedding_dim,
+                        input_shape=(self.sequence_length,),
+                        name="tc_embedding"))
+        if self.encoder == "cnn":
+            m.add(Convolution1D(self.encoder_output_dim, 5, activation="relu",
+                                name="tc_conv"))
+            m.add(GlobalMaxPooling1D(name="tc_pool"))
+        elif self.encoder == "lstm":
+            m.add(LSTM(self.encoder_output_dim, name="tc_lstm"))
+        elif self.encoder == "gru":
+            m.add(GRU(self.encoder_output_dim, name="tc_gru"))
+        else:
+            raise ValueError(f"unknown encoder {self.encoder!r} "
+                             "(expected cnn/lstm/gru)")
+        m.add(Dense(128, activation="relu", name="tc_fc"))
+        m.add(Dense(self.class_num, activation="softmax", name="tc_out"))
+        if self.embedding_weights is not None:
+            self._pretrained = np.asarray(self.embedding_weights, np.float32)
+            # installed after init_weights(): see set_embedding_weights
+        return m
+
+    def init_weights(self, rng=None):
+        p = super().init_weights(rng)
+        if self.embedding_weights is not None:
+            import jax.numpy as jnp
+            p["tc_embedding"]["E"] = jnp.asarray(self._pretrained)
+            self.model.set_weights(p)
+        return p
