@@ -1,0 +1,51 @@
+"""Training checkpoints via orbax.
+
+Reference parity: BigDL snapshot files (`model.<iter>`, `optimMethod-<name>.<iter>`)
+written on a trigger (KerasNet.setCheckpoint Topology.scala:247-257; timestamped
+subdirectories Topology.scala:1294-1307) and reloaded by the failure-retry loop
+(Topology.scala:1229-1251).  TPU-native: one orbax StandardSave of
+{params, opt_state, model_state, global_step} per fire; preemption-safe (atomic dir
+renames) and restartable mid-training — the preemption-aware answer to BigDL's
+`bigdl.failure.retryTimes` scheme.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep))
+
+    def save(self, step: int, params, opt_state, model_state,
+             extra: Optional[Dict[str, Any]] = None) -> None:
+        tree = {"params": params, "opt_state": opt_state,
+                "model_state": model_state, "global_step": step}
+        if extra:
+            tree["extra"] = extra
+        self.mgr.save(step, args=self._ocp.args.StandardSave(tree))
+        self.mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.mgr.latest_step()
+
+    def restore(self, like, step: Optional[int] = None):
+        """`like`: a template tree with the target structure/avals."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return self.mgr.restore(
+            step, args=self._ocp.args.StandardRestore(like))
+
+    def close(self):
+        self.mgr.close()

@@ -13,12 +13,19 @@ gradient psum is inserted automatically by GSPMD because the weighted-mean loss 
 program semantics.  BigDL's reduce-scatter + per-shard update + all-gather scheme is what
 XLA emits anyway when beneficial; no shuffle, no reflection, no second job.
 
+Auxiliary subsystems carried over (SURVEY.md §5): ZooTrigger-driven checkpointing
+(orbax, estimator/checkpoint.py), the failure-retry loop (`bigdl.failure.retryTimes` ≙
+conf.failure_retry_times — reload latest snapshot and continue), and TensorBoard scalars
+(Loss / Throughput / validation metrics) via the in-repo event writer
+(utils/tbwriter.py).
+
 Batches are fixed-shape (padded with zero-weight rows), so one compilation serves every
 step — no dynamic-shape recompiles.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -28,6 +35,7 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.common.triggers import EveryEpoch, TrainState, ZooTrigger
 from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet, FeatureSet
 from analytics_zoo_tpu.nn import metrics as metrics_lib
 from analytics_zoo_tpu.nn import objectives as objectives_lib
@@ -72,10 +80,35 @@ class Estimator:
         self.state = None
         self.opt_state = None
         self.global_step = 0
+        self.epoch = 0
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
         self._listeners = []   # step-end callbacks: fn(step, loss)
+        self._ckpt_mgr = None
+        self._ckpt_trigger: Optional[ZooTrigger] = None
+        self._tb_writer = None
+        self._tb_val_writer = None
+
+    # -- configuration --------------------------------------------------------
+    def set_checkpoint(self, directory: str, trigger: Optional[ZooTrigger] = None,
+                       keep: Optional[int] = None):
+        """Checkpoint on trigger (KerasNet.setCheckpoint parity)."""
+        from analytics_zoo_tpu.estimator.checkpoint import CheckpointManager
+        self._ckpt_mgr = CheckpointManager(
+            directory, keep or self.ctx.conf.checkpoint_keep)
+        self._ckpt_trigger = trigger or EveryEpoch()
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        """Scalar summaries: Loss/Throughput + validation metrics
+        (KerasNet.setTensorBoard parity, Topology.scala:206-238)."""
+        from analytics_zoo_tpu.utils.tbwriter import FileWriter
+        base = os.path.join(log_dir, app_name)
+        self._tb_writer = FileWriter(os.path.join(base, "train"))
+        self._tb_val_writer = FileWriter(os.path.join(base, "validation"))
+        self._tb_dir = base
+        return self
 
     # -- initialisation -------------------------------------------------------
     def _ensure_init(self, sample_x):
@@ -101,9 +134,30 @@ class Estimator:
                 continue
             out.append(jax.tree.map(
                 lambda v: jax.device_put(
-                    jnp.asarray(v), self.ctx.data_sharding(np.ndim(v))),
-                a, is_leaf=lambda v: isinstance(v, (np.ndarray, jnp.ndarray))))
+                    jnp.asarray(v), self.ctx.data_sharding(np.ndim(v))), a))
         return out
+
+    # -- checkpoint save/restore ----------------------------------------------
+    def _ckpt_tree(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "model_state": self.state, "global_step": self.global_step}
+
+    def save_checkpoint(self):
+        if self._ckpt_mgr is None:
+            raise RuntimeError("call set_checkpoint(dir) first")
+        self._ckpt_mgr.save(self.global_step, self.params, self.opt_state,
+                            self.state)
+
+    def maybe_restore_checkpoint(self) -> bool:
+        """Restore the latest snapshot if one exists (resume/retry path)."""
+        if self._ckpt_mgr is None or self._ckpt_mgr.latest_step() is None:
+            return False
+        restored = self._ckpt_mgr.restore(self._ckpt_tree())
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.state = restored["model_state"]
+        self.global_step = int(restored["global_step"])
+        return True
 
     # -- compiled steps -------------------------------------------------------
     def _build_train_step(self):
@@ -152,7 +206,9 @@ class Estimator:
 
     # -- public API -----------------------------------------------------------
     def fit(self, x, y=None, *, batch_size=32, epochs=1, validation_data=None,
-            shuffle=True, verbose=True, log_every: Optional[int] = None) -> History:
+            shuffle=True, verbose=True, log_every: Optional[int] = None,
+            end_trigger: Optional[ZooTrigger] = None, resume: bool = False
+            ) -> History:
         if self.optimizer is None or self.loss is None:
             raise RuntimeError("Estimator needs optimizer and loss to fit")
         data = _as_feature_set(x, y)
@@ -165,38 +221,98 @@ class Estimator:
 
         first = next(iter(data.batches(batch_size)))
         self._ensure_init(first[0])
+        if resume:
+            self.maybe_restore_checkpoint()
         if self._train_step is None:
             self._train_step = self._build_train_step()
 
-        for epoch in range(epochs):
+        tstate = TrainState(epoch=self.epoch, iteration=self.global_step)
+        retries_left = self.ctx.conf.failure_retry_times
+        epoch = 0
+        while epoch < epochs:
             t0 = time.time()
             losses, seen = [], 0
-            for bx, by, bw in data.batches(batch_size, shuffle=shuffle,
-                                           rng=np_rng, pad_final=True):
-                sx, sy, sw = self._shard(bx, by, bw)
-                rng = jax.random.fold_in(
-                    jax.random.PRNGKey(self.ctx.conf.seed), self.global_step)
-                self.params, self.opt_state, self.state, l = self._train_step(
-                    self.params, self.opt_state, self.state, sx, sy, sw, rng)
-                self.global_step += 1
-                losses.append(l)
-                seen += int(bw.sum())
-                for fn in self._listeners:
-                    fn(self.global_step, l)
-            mean_loss = float(jnp.mean(jnp.stack([jnp.asarray(v) for v in losses])))
+            try:
+                for bx, by, bw in data.batches(batch_size, shuffle=shuffle,
+                                               rng=np_rng, pad_final=True):
+                    sx, sy, sw = self._shard(bx, by, bw)
+                    rng = jax.random.fold_in(
+                        jax.random.PRNGKey(self.ctx.conf.seed), self.global_step)
+                    (self.params, self.opt_state, self.state,
+                     l) = self._train_step(self.params, self.opt_state,
+                                           self.state, sx, sy, sw, rng)
+                    self.global_step += 1
+                    losses.append(l)
+                    seen += int(bw.sum())
+                    tstate.iteration = self.global_step
+                    tstate.epoch_finished = False
+                    if self.global_step % log_every == 0:
+                        lf = float(l)
+                        tstate.loss = lf
+                        if self._tb_writer is not None:
+                            self._tb_writer.add_scalar("Loss", lf,
+                                                       self.global_step)
+                    for fn in self._listeners:
+                        fn(self.global_step, l)
+                    if (self._ckpt_trigger is not None
+                            and self._ckpt_trigger(tstate)):
+                        self.save_checkpoint()
+                    if end_trigger is not None and end_trigger(tstate):
+                        break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                # failure-retry with checkpoint restore
+                # (Topology.scala:1180-1262 semantics)
+                if retries_left > 0 and self._ckpt_mgr is not None \
+                        and self._ckpt_mgr.latest_step() is not None:
+                    retries_left -= 1
+                    self._train_step = None
+                    self.maybe_restore_checkpoint()
+                    self._train_step = self._build_train_step()
+                    continue
+                raise
+
+            self.epoch += 1
+            epoch += 1
+            tstate.epoch = self.epoch
+            tstate.epoch_finished = True
+            if losses:
+                mean_loss = float(jnp.mean(jnp.stack(
+                    [jnp.asarray(v) for v in losses])))
+            else:
+                mean_loss = float("nan")
+            tstate.loss = mean_loss
             dt = time.time() - t0
+            throughput = seen / max(dt, 1e-9)
             hist.append("loss", mean_loss)
-            hist.append("throughput", seen / max(dt, 1e-9))
-            msg = (f"Epoch {epoch + 1}/{epochs} - loss {mean_loss:.4f} "
-                   f"- {seen / max(dt, 1e-9):.0f} samples/s")
+            hist.append("throughput", throughput)
+            if self._tb_writer is not None:
+                self._tb_writer.add_scalar("Loss", mean_loss, self.global_step)
+                self._tb_writer.add_scalar("Throughput", throughput,
+                                           self.global_step)
+            msg = (f"Epoch {self.epoch} ({epoch}/{epochs}) - loss {mean_loss:.4f} "
+                   f"- {throughput:.0f} samples/s")
             if validation_data is not None:
                 val = self.evaluate(*self._val_tuple(validation_data),
                                     batch_size=batch_size)
                 for k, v in val.items():
                     hist.append("val_" + k, v)
+                    if self._tb_val_writer is not None:
+                        self._tb_val_writer.add_scalar(k, v, self.global_step)
+                first_metric = next(iter(val.values())) if val else None
+                tstate.score = first_metric
                 msg += " - " + " ".join(f"val_{k} {v:.4f}" for k, v in val.items())
+            if (self._ckpt_trigger is not None and self._ckpt_trigger(tstate)):
+                self.save_checkpoint()
             if verbose:
                 print(msg)
+            if end_trigger is not None and end_trigger(tstate):
+                break
+        if self._tb_writer is not None:
+            self._tb_writer.flush()
+        if self._tb_val_writer is not None:
+            self._tb_val_writer.flush()
         return hist
 
     @staticmethod

@@ -1,0 +1,206 @@
+"""TensorBoard event-file writer/reader in pure Python.
+
+Reference parity: the reference implements its own TensorBoard pipeline in-repo
+(zoo/tensorboard/: FileWriter.scala:32-80, EventWriter.scala:32-70, RecordWriter with CRC,
+Summary builder, FileReader.readScalar:80-110).  Same here: hand-encoded Event/Summary
+protobufs + TFRecord framing with masked CRC32C — no tensorflow dependency.
+
+Wire format per record: [length:uint64le][masked_crc32c(length):uint32le][payload]
+[masked_crc32c(payload):uint32le].  Event proto fields used: wall_time(1,double),
+step(2,int64), file_version(3,string), summary(5,message); Summary.value(1) with
+tag(1,string) and simple_value(2,float).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Dict, List, Tuple
+
+# -- crc32c (software, table-driven) ------------------------------------------
+
+_CRC_TABLE = []
+
+
+def _make_table():
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+        _CRC_TABLE.append(crc)
+
+
+_make_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# -- minimal protobuf encoding ------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _pb_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _pb_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _pb_str(field: int, v: str) -> bytes:
+    return _pb_bytes(field, v.encode())
+
+
+def encode_scalar_event(tag: str, value: float, step: int,
+                        wall_time: float) -> bytes:
+    val = _pb_str(1, tag) + _pb_float(2, float(value))
+    summary = _pb_bytes(1, val)
+    return (_pb_double(1, wall_time) + _pb_int64(2, step)
+            + _pb_bytes(5, summary))
+
+
+def encode_version_event(wall_time: float) -> bytes:
+    return _pb_double(1, wall_time) + _pb_str(3, "brain.Event:2")
+
+
+def _record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header)) + payload
+            + struct.pack("<I", _masked_crc(payload)))
+
+
+class FileWriter:
+    """Append scalar summaries to an events file (FileWriter.scala parity)."""
+
+    def __init__(self, logdir: str, flush_secs: float = 5.0):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(logdir, fname)
+        self._f = open(self.path, "ab")
+        self._last_flush = time.time()
+        self.flush_secs = flush_secs
+        self._f.write(_record(encode_version_event(time.time())))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        ev = encode_scalar_event(tag, value, step, time.time())
+        self._f.write(_record(ev))
+        if time.time() - self._last_flush > self.flush_secs:
+            self.flush()
+
+    def flush(self):
+        self._f.flush()
+        self._last_flush = time.time()
+
+    def close(self):
+        self._f.flush()
+        self._f.close()
+
+
+# -- reader (FileReader.readScalar parity) ------------------------------------
+
+def _decode_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift, out = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _parse_fields(buf: bytes):
+    i = 0
+    while i < len(buf):
+        key, i = _decode_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _decode_varint(buf, i)
+        elif wire == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wire == 2:
+            ln, i = _decode_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+def read_scalars(path_or_dir: str) -> Dict[str, List[Tuple[int, float]]]:
+    """Read back {tag: [(step, value), ...]} from an events file or logdir."""
+    if os.path.isdir(path_or_dir):
+        files = sorted(f for f in os.listdir(path_or_dir)
+                       if f.startswith("events.out.tfevents"))
+        if not files:
+            return {}
+        path = os.path.join(path_or_dir, files[-1])
+    else:
+        path = path_or_dir
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    with open(path, "rb") as f:
+        data = f.read()
+    i = 0
+    while i + 12 <= len(data):
+        (ln,) = struct.unpack("<Q", data[i:i + 8])
+        payload = data[i + 12:i + 12 + ln]
+        i += 12 + ln + 4
+        step, summary = 0, None
+        for field, wire, v in _parse_fields(payload):
+            if field == 2 and wire == 0:
+                step = v
+            elif field == 5 and wire == 2:
+                summary = v
+        if summary is None:
+            continue
+        for field, wire, v in _parse_fields(summary):
+            if field == 1 and wire == 2:
+                tag, value = None, None
+                for f2, w2, v2 in _parse_fields(v):
+                    if f2 == 1 and w2 == 2:
+                        tag = v2.decode()
+                    elif f2 == 2 and w2 == 5:
+                        (value,) = struct.unpack("<f", v2)
+                if tag is not None and value is not None:
+                    out.setdefault(tag, []).append((step, value))
+    return out
