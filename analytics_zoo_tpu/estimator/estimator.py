@@ -67,7 +67,7 @@ class Estimator:
 
     def __init__(self, model: Layer, optimizer=None, loss=None, metrics=(),
                  ctx=None, clip_norm: Optional[float] = None,
-                 clip_value: Optional[float] = None):
+                 clip_value: Optional[float] = None, param_plan=None):
         self.model = model
         self.ctx = ctx or get_context()
         opt = optimizers_lib.get(optimizer) if optimizer is not None else None
@@ -85,6 +85,7 @@ class Estimator:
         self._eval_step = None
         self._predict_step = None
         self._listeners = []   # step-end callbacks: fn(step, loss)
+        self.param_plan = param_plan
         self._ckpt_mgr = None
         self._ckpt_trigger: Optional[ZooTrigger] = None
         self._tb_writer = None
@@ -120,10 +121,19 @@ class Estimator:
         rng = self.ctx.next_rng()
         params, state = self.model.init(rng, shape)
         repl = self.ctx.replicated_sharding()
-        self.params = jax.device_put(params, repl)
+        if self.param_plan is not None:
+            # tensor-parallel layout: place params per the ShardingPlan; GSPMD
+            # partitions the matmuls (parallel/sharding.py)
+            self.params = self.param_plan.shard(params, self.ctx.mesh)
+        else:
+            self.params = jax.device_put(params, repl)
         self.state = jax.device_put(state, repl)
         if self.optimizer is not None:
-            self.opt_state = jax.device_put(self.optimizer.init(self.params), repl)
+            opt_state = self.optimizer.init(self.params)
+            # moments created via zeros_like inherit the params' shardings; only
+            # force-replicate in the plain-DP case
+            self.opt_state = (opt_state if self.param_plan is not None
+                              else jax.device_put(opt_state, repl))
 
     def _shard(self, *arrays):
         """Place batch arrays sharded along the mesh data axis."""

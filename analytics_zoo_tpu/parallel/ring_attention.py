@@ -1,0 +1,83 @@
+"""Ring attention — sequence/context parallelism over the mesh `seq` axis.
+
+Green-field (SURVEY.md §5 long-context: the reference has NO sequence parallelism; its
+TransformerLayer materialises the full (T, T) matrix).  Design: shard the sequence axis
+of q/k/v across devices; each step every device computes attention of its local query
+block against the k/v block it currently holds, accumulates via online softmax
+(flash-attention statistics m/l), then rotates k/v one hop around the ring with
+`lax.ppermute` — compute overlaps the ICI transfer and full attention is recovered in
+`seq` hops with O(T/n) memory per device.
+
+Causal masking uses absolute positions, so fully-masked future blocks contribute zero
+(their statistics are washed out by the online-softmax correction term).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.common.context import SEQ_AXIS
+
+
+def _ring_local(q, k, v, *, axis_name: str, causal: bool,
+                scale: Optional[float]):
+    """Per-shard body.  q/k/v: (B, H, T_local, D)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    s = scale if scale is not None else 1.0 / np.sqrt(D)
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * Tq + jnp.arange(Tq)
+
+    # derive accumulators from q so they carry the same varying-axis type as the
+    # rotating k/v blocks (jax>=0.9 shard_map manual-axes typing)
+    o0 = q32 * 0.0
+    l0 = q32[..., 0] * 0.0
+    m0 = q32[..., 0] * 0.0 - 1e30
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        src = (idx - i) % n
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * s
+        if causal:
+            k_pos = src * Tk + jnp.arange(Tk)
+            mask = k_pos[None, :] <= q_pos[:, None]          # (Tq, Tk)
+            logits = jnp.where(mask[None, None], logits, -1e9)
+        m_new = jnp.maximum(m, logits.max(-1))
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m_new, k_blk, v_blk
+
+    o, l, _, _, _ = jax.lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
+    return (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
+                   scale: Optional[float] = None,
+                   axis_name: str = SEQ_AXIS):
+    """q/k/v: (B, H, T, D) with T sharded over `axis_name`.  Returns attention output
+    with the same sharding.  Equivalent to full softmax attention (see tests)."""
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_local, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def sequence_sharded_spec(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    return P(None, None, axis_name, None)

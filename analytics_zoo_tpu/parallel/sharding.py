@@ -1,0 +1,125 @@
+r"""Sharding plans — how parameter pytrees lay out over the mesh.
+
+Green-field beyond the reference (SURVEY.md §2.3: the reference is data-parallel only).
+A `ShardingPlan` maps parameter tree paths (regex over "layer/leaf" path strings) to
+`PartitionSpec`s; the Estimator places params accordingly and GSPMD partitions the
+matmuls — Megatron-style tensor parallelism without touching layer code:
+
+    plan = ShardingPlan([
+        (r".*_fc\d*/W$",  P(None, "model")),   # column-parallel
+        (r".*_proj/W$",   P("model", None)),   # row-parallel
+        (r".*embed.*/E$", P("model", None)),   # vocab-sharded embedding
+    ])
+
+Axis names follow common/context.py: data / model / pipe / seq / expert.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def leaf_paths(tree):
+    """Flatten a pytree into ("a/b/c", leaf) pairs."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+class ShardingPlan:
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, path: str, leaf=None) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                if leaf is not None and len(spec) > np.ndim(leaf):
+                    continue  # rule doesn't fit this rank; keep looking
+                return spec
+        return self.default
+
+    def shard(self, tree, mesh: Mesh):
+        """device_put every leaf with its matched spec (axes not in the mesh are
+        dropped from the spec so plans are portable across mesh shapes)."""
+        pairs = leaf_paths(tree)
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        placed = []
+        for (path, leaf), _ in zip(pairs, flat):
+            spec = self._fit(self.spec_for(path, leaf), mesh, np.shape(leaf))
+            placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def shardings(self, tree, mesh: Mesh):
+        """NamedSharding pytree matching `tree` (for jit in_shardings)."""
+        pairs = leaf_paths(tree)
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        out = [NamedSharding(mesh, self._fit(self.spec_for(p, l), mesh,
+                                             np.shape(l)))
+               for (p, l) in pairs]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    @staticmethod
+    def _fit(spec: P, mesh: Mesh, shape) -> P:
+        """Drop axes missing from the mesh or sized 1; trim to leaf rank; drop axes
+        that don't divide the dimension evenly (GSPMD requires divisibility)."""
+        rank = len(shape)
+        parts = list(spec) + [None] * (rank - len(spec))
+        fitted = []
+        for dim, ax in zip(shape, parts[:rank]):
+            n = mesh.shape.get(ax, 1) if ax is not None else 1
+            if ax is None or n == 1 or dim % n != 0:
+                fitted.append(None)
+            else:
+                fitted.append(ax)
+        while fitted and fitted[-1] is None:
+            fitted.pop()
+        return P(*fitted)
+
+
+def replicated_plan() -> ShardingPlan:
+    return ShardingPlan([], default=P())
+
+
+def megatron_plan(column_patterns: Optional[Sequence[str]] = None,
+                  row_patterns: Optional[Sequence[str]] = None,
+                  embed_patterns: Optional[Sequence[str]] = None
+                  ) -> ShardingPlan:
+    """Default tensor-parallel plan for transformer-ish stacks: qkv/ffn-in are
+    column-parallel, attention-out/ffn-proj are row-parallel, embeddings vocab-sharded."""
+    rules: List[Tuple[str, P]] = []
+    for pat in (column_patterns or [r".*qkv/W$", r".*_ffn/fc/W$",
+                                    r".*fc\d*/W$"]):
+        rules.append((pat, P(None, "model")))
+    for pat in (column_patterns or [r".*qkv/b$", r".*_ffn/fc/b$"]):
+        rules.append((pat.replace("/W$", "/b$"), P("model",)))
+    for pat in (row_patterns or [r".*attn/out/W$", r".*_ffn/proj/W$"]):
+        rules.append((pat, P("model", None)))
+    for pat in (embed_patterns or [r".*(wte|word|embed.*)/(E)$", r".*wte$",
+                                   r".*word$"]):
+        rules.append((pat, P("model", None)))
+    return rules and ShardingPlan(rules) or replicated_plan()
+
+
+def data_parallel_batch(ctx, *arrays):
+    """Shard batch arrays over the data axis (helper mirroring Estimator._shard)."""
+    out = []
+    for a in arrays:
+        out.append(jax.tree.map(
+            lambda v: jax.device_put(v, ctx.data_sharding(np.ndim(v))), a))
+    return out
