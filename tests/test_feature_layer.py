@@ -1,0 +1,141 @@
+"""Feature layer: preprocessing chains, image transforms, text pipeline, 3D ops."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.common import (
+    ChainedPreprocessing, FeatureLabelPreprocessing, FnPreprocessing)
+from analytics_zoo_tpu.feature.image import (
+    ImageAspectScale, ImageBrightness, ImageCenterCrop, ImageChannelNormalize,
+    ImageColorJitter, ImageExpand, ImageFeature, ImageHFlip, ImageRandomCrop,
+    ImageRandomTransformer, ImageResize, ImageSet, ImageSetToSample, ImageVFlip)
+from analytics_zoo_tpu.feature.image3d import (
+    AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D)
+from analytics_zoo_tpu.feature.text import (
+    Relation, TextSet, generate_relation_lists, generate_relation_pairs,
+    relation_pairs_to_arrays)
+
+
+def _img(h=32, w=48):
+    g = np.random.default_rng(0)
+    return g.integers(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def test_chain_composition():
+    double = FnPreprocessing(lambda x: x * 2)
+    inc = FnPreprocessing(lambda x: x + 1)
+    chain = double >> inc >> double
+    assert chain.transform(3) == 14
+    assert isinstance(chain, ChainedPreprocessing)
+    fl = FeatureLabelPreprocessing(double, inc)
+    assert fl.transform((2, 5)) == (4, 6)
+
+
+def test_image_resize_crop_flip():
+    f = ImageFeature(image=_img())
+    out = ImageResize(16, 24).transform(f)
+    assert out.image.shape == (16, 24, 3)
+    out = ImageCenterCrop(20, 20).transform(f)
+    assert out.image.shape == (20, 20, 3)
+    out = ImageRandomCrop(20, 20, seed=0).transform(f)
+    assert out.image.shape == (20, 20, 3)
+    flipped = ImageHFlip().transform(f)
+    np.testing.assert_array_equal(flipped.image, f.image[:, ::-1])
+    vflipped = ImageVFlip().transform(f)
+    np.testing.assert_array_equal(vflipped.image, f.image[::-1])
+
+
+def test_image_aspect_scale():
+    f = ImageFeature(image=_img(100, 200))
+    out = ImageAspectScale(50, max_size=120).transform(f)
+    h, w = out.image.shape[:2]
+    assert min(h, w) <= 50 and max(h, w) <= 120
+
+
+def test_image_color_and_normalize():
+    f = ImageFeature(image=_img())
+    out = ImageBrightness(10, 10, seed=0).transform(f)
+    assert (out.image >= f.image.astype(np.float32)).mean() > 0.9
+    norm = ImageChannelNormalize(104, 117, 123, 2, 2, 2).transform(f)
+    expect = (f.image.astype(np.float32)
+              - np.asarray([104, 117, 123], np.float32)) / 2.0
+    np.testing.assert_allclose(norm.image, expect)
+    jit = ImageColorJitter(seed=1).transform(f)
+    assert jit.image.shape == f.image.shape
+    exp = ImageExpand(max_expand_ratio=2.0, seed=2).transform(f)
+    assert exp.image.shape[0] >= f.image.shape[0]
+
+
+def test_image_random_transformer_prob():
+    f = ImageFeature(image=_img())
+    never = ImageRandomTransformer(ImageHFlip(), p=0.0, seed=0)
+    np.testing.assert_array_equal(never.transform(f).image, f.image)
+    always = ImageRandomTransformer(ImageHFlip(), p=1.0, seed=0)
+    np.testing.assert_array_equal(always.transform(f).image, f.image[:, ::-1])
+
+
+def test_imageset_pipeline_to_featureset():
+    imgs = [_img(40, 40) for _ in range(6)]
+    labels = [1, 2, 1, 2, 1, 2]
+    iset = ImageSet.from_arrays(imgs, labels)
+    iset = iset.transform(ImageResize(24, 24))
+    iset = iset.transform(ImageChannelNormalize(120, 120, 120, 50, 50, 50))
+    fs = iset.to_feature_set()
+    assert fs.size() == 6
+    bx, by, bw = next(iter(fs.batches(4)))
+    assert bx.shape == (4, 24, 24, 3)
+    assert by.shape == (4, 1)
+
+
+def test_text_pipeline():
+    texts = ["Hello world, hello TPU!", "the quick brown fox", "hello fox"]
+    ts = TextSet.from_texts(texts, labels=[0, 1, 1])
+    ts.tokenize().normalize().word2idx()
+    assert "hello" in ts.get_word_index()
+    ts.shape_sequence(6)
+    x, y = ts.gen_sample()
+    assert x.shape == (3, 6)
+    assert y.shape == (3, 1)
+    # hello appears 3 times -> most frequent -> index 1
+    assert ts.get_word_index()["hello"] == 1
+
+
+def test_text_word_index_options(tmp_path):
+    ts = TextSet.from_texts(["a a a b b c", "a b c d"])
+    ts.tokenize().normalize().word2idx(remove_topN=1, max_words_num=2)
+    wi = ts.get_word_index()
+    assert "a" not in wi and len(wi) == 2
+    p = str(tmp_path / "wi.json")
+    ts.save_word_index(p)
+    ts2 = TextSet.from_texts(["b c"]).tokenize().normalize()
+    ts2.load_word_index(p)
+    ts2.word2idx(existing_map=ts2.word_index)
+    assert ts2.features[0]["indexed_tokens"][0] == wi["b"]
+
+
+def test_relations():
+    rels = [Relation("q1", "d1", 1), Relation("q1", "d2", 0),
+            Relation("q1", "d3", 0), Relation("q2", "d1", 1),
+            Relation("q2", "d4", 0)]
+    pairs = generate_relation_pairs(rels, seed=0)
+    assert len(pairs) == 2
+    for q, p, n in pairs:
+        assert p in ("d1",) and n in ("d2", "d3", "d4")
+    lists = generate_relation_lists(rels)
+    assert len(lists["q1"]) == 3
+    corpus_q = {"q1": [1, 2], "q2": [3, 4]}
+    corpus_d = {f"d{i}": [i, i] for i in range(1, 5)}
+    q_arr, d_arr = relation_pairs_to_arrays(pairs, corpus_q, corpus_d)
+    assert q_arr.shape == (4, 2)  # interleaved pos/neg
+    np.testing.assert_array_equal(q_arr[0], q_arr[1])
+
+
+def test_image3d_ops():
+    vol = np.random.default_rng(0).normal(size=(16, 16, 16)).astype(np.float32)
+    assert Crop3D((2, 2, 2), (8, 8, 8)).transform(vol).shape == (8, 8, 8)
+    assert CenterCrop3D((8, 10, 12)).transform(vol).shape == (8, 10, 12)
+    assert RandomCrop3D((8, 8, 8), seed=0).transform(vol).shape == (8, 8, 8)
+    rot = Rotate3D(yaw=90).transform(vol)
+    assert rot.shape == vol.shape
+    ident = AffineTransform3D(np.eye(3)).transform(vol)
+    np.testing.assert_allclose(ident, vol, atol=1e-5)
