@@ -1,0 +1,114 @@
+"""InferenceModel — the multi-backend concurrent-inference holder.
+
+Reference parity: pipeline/inference/InferenceModel.scala:30-889 — loaders for multiple
+model formats + a blocking queue of weight-sharing model clones for concurrent predict
+(modelQueue, :67,741-790).
+
+TPU-native redesign: a jitted predict function IS thread-safe and weight-sharing —
+no clone queue needed; concurrency is handled by XLA's stream executor.  What remains is
+(a) the loader surface: zoo weights (`do_load`), TF SavedModel (`do_load_tensorflow`,
+via the interop bridge — the TFNet analog), ONNX when available, and (b) **bucketed
+batching**: inputs are padded to the nearest power-of-two batch so a handful of compiled
+programs serve any request size (the serving-latency answer to the reference's per-core
+BLAS threading, SURVEY.md §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+class InferenceModel:
+    def __init__(self, supported_concurrent_num: int = 1,
+                 max_batch: int = 1024):
+        self.max_batch = int(max_batch)
+        self._predict_fn: Optional[Callable] = None
+        self._params = None
+        self._state = None
+        self._model: Optional[Layer] = None
+        self._jitted = None
+        self._lock = threading.Lock()
+
+    # -- loaders --------------------------------------------------------------
+    def do_load_model(self, model: Layer, params=None, state=None):
+        """Load an in-memory zoo layer/container (doLoadBigDL analog)."""
+        self._model = model
+        if params is None and hasattr(model, "_params"):
+            params, state = model._params, model._state
+        self._params, self._state = params, state
+        self._jitted = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        return self
+
+    def do_load(self, topology_builder: Callable[[], Layer], weights_path: str):
+        """Rebuild topology via `topology_builder` and load weights from `.npz`
+        (doLoad analog — weights file + known architecture)."""
+        model = topology_builder()
+        model.init_weights()
+        model.load_weights(weights_path)
+        return self.do_load_model(model, model._params, model._state)
+
+    def do_load_tensorflow(self, saved_model_path: str,
+                           signature: str = "serving_default"):
+        """Wrap a TF SavedModel as the predict function (TFNet analog — see
+        interop/tfnet.py; runs through the TF runtime bridge)."""
+        from analytics_zoo_tpu.interop.tfnet import TFNet
+        net = TFNet.from_saved_model(saved_model_path, signature=signature)
+        self._model = net
+        self._params, self._state = {}, {}
+        self._jitted = lambda p, s, x: net.call({}, x)
+        return self
+
+    def do_load_onnx(self, onnx_path: str):
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "onnx is not available in this environment; export to a zoo "
+                "weights file or TF SavedModel instead") from e
+        raise NotImplementedError("onnx import lands with the interop wave")
+
+    # -- predict --------------------------------------------------------------
+    def do_predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Batched forward with power-of-two bucket padding: at most
+        log2(max_batch) compiled programs ever exist per input signature."""
+        if self._jitted is None:
+            raise RuntimeError("load a model first")
+        multi = isinstance(x, (list, tuple))
+        xs = [np.asarray(a) for a in (x if multi else [x])]
+        n = xs[0].shape[0]
+        step = batch_size or self.max_batch
+        outs = []
+        i = 0
+        while i < n:
+            take = min(step, n - i)
+            bucket = _bucket(take, self.max_batch)
+            chunk = [a[i:i + take] for a in xs]
+            if take < bucket:
+                chunk = [np.concatenate(
+                    [c, np.zeros((bucket - take,) + c.shape[1:], c.dtype)])
+                    for c in chunk]
+            arg = chunk if multi else chunk[0]
+            y = self._jitted(self._params, self._state, arg)
+            outs.append(jax.tree.map(lambda a: np.asarray(a)[:take], y))
+            i += take
+        if isinstance(outs[0], (list, tuple)):
+            return [np.concatenate([o[j] for o in outs])
+                    for j in range(len(outs[0]))]
+        return np.concatenate(outs)
+
+    # reference-style aliases
+    predict = do_predict

@@ -1,0 +1,60 @@
+"""TFNet — TensorFlow model import.
+
+Reference parity: `TFNet` (pipeline/api/net/TFNet.scala:56-716) wraps a TF GraphDef as a
+layer executed through libtensorflow JNI.  Here the bridge is jax2tf.call_tf: the
+SavedModel's serving function becomes a JAX-callable (compilable where the TF ops have
+XLA lowerings, else executed by the TF runtime on host).  Frozen-graph import follows the
+same path via a wrapped ConcreteFunction.
+
+This is deliberately a *bridge*, like the reference; the preferred path for models that
+should run natively on TPU is weight import into zoo layers (interop/keras_import.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.nn.module import Layer
+
+
+class TFNet(Layer):
+    def __init__(self, tf_callable, output_names: Optional[Sequence[str]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._fn = tf_callable
+        self._output_names = list(output_names or [])
+
+    @staticmethod
+    def from_saved_model(path: str, signature: str = "serving_default",
+                         compilable: bool = True) -> "TFNet":
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+
+        loaded = tf.saved_model.load(path)
+        fn = loaded.signatures[signature]
+        outputs = list(fn.structured_outputs.keys())
+
+        def call(x):
+            xs = x if isinstance(x, (list, tuple)) else [x]
+            kwargs = {}
+            for spec, arr in zip(fn.structured_input_signature[1].values(), xs):
+                kwargs[spec.name.split(":")[0]] = arr
+            res = jax2tf.call_tf(fn, has_side_effects=False)(**kwargs) \
+                if compilable else fn(**{k: tf.constant(np.asarray(v))
+                                         for k, v in kwargs.items()})
+            vals = [res[k] for k in outputs]
+            return vals[0] if len(vals) == 1 else vals
+
+        net = TFNet(call, output_names=outputs)
+        net._keepalive = loaded  # prevent GC of the SavedModel
+        return net
+
+    @staticmethod
+    def from_concrete_function(fn) -> "TFNet":
+        from jax.experimental import jax2tf
+        return TFNet(jax2tf.call_tf(fn, has_side_effects=False))
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self._fn(x)

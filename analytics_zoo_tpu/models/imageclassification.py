@@ -1,0 +1,149 @@
+"""Image classification zoo: ResNet family + ImageClassifier facade.
+
+Reference parity: `ImageClassifier` (models/imageclassification/ImageClassifier.scala:28)
+with the per-model preprocessing registry (ImageClassificationConfig.scala:1-190); model
+bodies follow the standard ResNet-v1.5 graph (the reference loads published BigDL .model
+files — here the architectures are built natively and weights train/load via the usual
+save/load path).
+
+TPU notes: NHWC everywhere, bf16 conv compute with f32 accumulation (MXU), BatchNorm
+reductions are global under the data-sharded pjit step (cross-replica sync BN for free).
+ResNet-50 on ImageNet is the throughput north star (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+from analytics_zoo_tpu.feature.image import (
+    ImageCenterCrop, ImageChannelNormalize, ImageResize)
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.nn.graph import Input, SymTensor
+from analytics_zoo_tpu.nn.layers.conv import Convolution2D, ZeroPadding2D
+from analytics_zoo_tpu.nn.layers.core import (
+    Activation, BatchNormalization, Dense, Flatten, merge)
+from analytics_zoo_tpu.nn.layers.pooling import (
+    AveragePooling2D, GlobalAveragePooling2D, MaxPooling2D)
+from analytics_zoo_tpu.nn.models import Model
+
+
+def _conv_bn(x: SymTensor, filters: int, kernel: int, stride: int, name: str,
+             activation: Optional[str] = "relu", border_mode="same"):
+    x = Convolution2D(filters, kernel, subsample=stride, border_mode=border_mode,
+                      bias=False, init="he_normal", name=name + "_conv")(x)
+    x = BatchNormalization(name=name + "_bn")(x)
+    if activation:
+        x = Activation(activation, name=name + "_act")(x)
+    return x
+
+
+def _bottleneck(x: SymTensor, filters: int, stride: int, name: str,
+                downsample: bool):
+    shortcut = x
+    if downsample:
+        shortcut = _conv_bn(x, filters * 4, 1, stride, name + "_down",
+                            activation=None)
+    h = _conv_bn(x, filters, 1, 1, name + "_1")
+    h = _conv_bn(h, filters, 3, stride, name + "_2")
+    h = _conv_bn(h, filters * 4, 1, 1, name + "_3", activation=None)
+    out = merge([h, shortcut], mode="sum", name=name + "_add")
+    return Activation("relu", name=name + "_out")(out)
+
+
+def _basic_block(x: SymTensor, filters: int, stride: int, name: str,
+                 downsample: bool):
+    shortcut = x
+    if downsample:
+        shortcut = _conv_bn(x, filters, 1, stride, name + "_down",
+                            activation=None)
+    h = _conv_bn(x, filters, 3, stride, name + "_1")
+    h = _conv_bn(h, filters, 3, 1, name + "_2", activation=None)
+    out = merge([h, shortcut], mode="sum", name=name + "_add")
+    return Activation("relu", name=name + "_out")(out)
+
+
+_RESNET_SPECS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+
+
+def resnet(depth: int = 50, num_classes: int = 1000,
+           input_shape: Tuple[int, int, int] = (224, 224, 3),
+           include_top: bool = True, stem: str = "imagenet",
+           name: Optional[str] = None) -> Model:
+    """ResNet-v1.5 graph.  stem="cifar" uses a 3x3 stem with no max-pool."""
+    kind, blocks = _RESNET_SPECS[depth]
+    block_fn = _bottleneck if kind == "bottleneck" else _basic_block
+    name = name or f"resnet{depth}"
+    inp = Input(shape=input_shape, name=name + "_input")
+    if stem == "imagenet":
+        x = _conv_bn(inp, 64, 7, 2, name + "_stem")
+        x = MaxPooling2D(3, strides=2, border_mode="same",
+                         name=name + "_stem_pool")(x)
+    else:
+        x = _conv_bn(inp, 64, 3, 1, name + "_stem")
+    filters = 64
+    for stage, n_blocks in enumerate(blocks):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            x = block_fn(x, filters, stride, f"{name}_s{stage}b{b}",
+                         downsample=(b == 0))
+        filters *= 2
+    if include_top:
+        x = GlobalAveragePooling2D(name=name + "_gap")(x)
+        x = Dense(num_classes, activation="softmax", name=name + "_fc")(x)
+    return Model(input=inp, output=x, name=name)
+
+
+class ImageClassificationConfig:
+    """Per-model preprocessing registry (ImageClassificationConfig.scala:1-190)."""
+
+    _REGISTRY: Dict[str, ChainedPreprocessing] = {}
+
+    @classmethod
+    def register(cls, model_name: str, preprocessing):
+        cls._REGISTRY[model_name] = preprocessing
+
+    @classmethod
+    def preprocessing(cls, model_name: str):
+        if model_name in cls._REGISTRY:
+            return cls._REGISTRY[model_name]
+        # imagenet default: resize-256 -> center-crop-224 -> mean-subtract
+        return (ImageResize(256, 256)
+                >> ImageCenterCrop(224, 224)
+                >> ImageChannelNormalize(103.939, 116.779, 123.68))
+
+
+class ImageClassifier(ZooModel):
+    """Facade: model graph + matching preprocessing + predict over ImageSets
+    (ImageClassifier.scala:28, ImageModel.doPredictImage)."""
+
+    def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 stem: str = "imagenet"):
+        self.model_name = model_name
+        self.num_classes = num_classes
+        self.input_shape = tuple(input_shape)
+        self.stem = stem
+        super().__init__()
+        self.preprocessor = ImageClassificationConfig.preprocessing(model_name)
+
+    def build_model(self) -> Model:
+        depth = int("".join(c for c in self.model_name if c.isdigit()) or 50)
+        return resnet(depth, self.num_classes, self.input_shape,
+                      stem=self.stem, name=self.model_name)
+
+    def predict_image_set(self, image_set, batch_size: int = 32,
+                          top_k: int = 5):
+        """Preprocess + forward an ImageSet; returns (top-k class ids, probs)."""
+        import numpy as np
+        processed = image_set.transform(self.preprocessor)
+        fs = processed.to_feature_set()
+        probs = self.predict(fs.xs[0], batch_size=batch_size)
+        idx = np.argsort(-probs, axis=-1)[:, :top_k]
+        return idx, np.take_along_axis(probs, idx, axis=-1)

@@ -1,0 +1,60 @@
+"""Serving client — InputQueue / OutputQueue.
+
+Reference parity: pyzoo/zoo/serving/client.py:62-160 — `InputQueue.enqueue_image`
+(base64 → stream XADD) and `OutputQueue.query/dequeue` (result table reads), over any
+queue backend (in-proc, file spool, or Redis).
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.queues import BaseQueue
+
+
+class InputQueue:
+    def __init__(self, queue: BaseQueue):
+        self.queue = queue
+
+    def enqueue_image(self, uri: str, image, resize=None) -> str:
+        """image: path, encoded bytes, or HWC ndarray (encoded to png)."""
+        if isinstance(image, str):
+            with open(image, "rb") as f:
+                data = f.read()
+        elif isinstance(image, (bytes, bytearray)):
+            data = bytes(image)
+        else:
+            import cv2
+            ok, buf = cv2.imencode(".png", np.asarray(image))
+            if not ok:
+                raise ValueError("failed to encode image")
+            data = buf.tobytes()
+        record = {"uri": uri, "image": base64.b64encode(data).decode()}
+        if resize is not None:
+            record["resize"] = list(resize)
+        return self.queue.xadd(record)
+
+    def enqueue_tensor(self, uri: str, tensor: np.ndarray) -> str:
+        arr = np.asarray(tensor, np.float32)
+        return self.queue.xadd({"uri": uri, "data": arr.reshape(-1).tolist(),
+                                "shape": list(arr.shape)})
+
+
+class OutputQueue:
+    def __init__(self, queue: BaseQueue):
+        self.queue = queue
+
+    def query(self, uri: str, timeout_s: float = 0.0) -> Optional[Dict]:
+        deadline = time.time() + timeout_s
+        while True:
+            res = self.queue.get_result(uri)
+            if res is not None or time.time() >= deadline:
+                return res
+            time.sleep(0.01)
+
+    def dequeue(self, uris) -> Dict[str, Dict]:
+        return {u: self.queue.get_result(u) for u in uris}

@@ -1,0 +1,136 @@
+"""Cluster Serving engine — queue → batcher → TPU predict → result store.
+
+Reference parity: `ClusterServing.main` (serving/ClusterServing.scala:34-352): a
+streaming micro-batch loop reading the Redis stream, batching to `batch_size`,
+pre-processing base64 images, broadcast-model predict, top-N post-processing, writing
+the result table with back-pressure, XTRIM memory guard, and throughput scalars
+(`Serving Throughput`, `Total Records Number`) to TensorBoard.
+
+TPU-native: the "broadcast model" is just the jitted predict function; batching pads to
+power-of-two buckets (InferenceModel) so the compile cache stays tiny; the micro-batch
+loop is a plain thread, not a Spark Structured Streaming job.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.queues import BaseQueue
+
+
+def default_preprocess(record: Dict) -> np.ndarray:
+    """base64 bytes -> decoded image CHW float (PreProcessing.scala:1-53) or raw
+    tensor passthrough for `data` records."""
+    if "image" in record:
+        import cv2
+        buf = np.frombuffer(base64.b64decode(record["image"]), np.uint8)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR).astype(np.float32)
+        if "resize" in record:
+            h, w = record["resize"]
+            img = cv2.resize(img, (w, h))
+        return img
+    if "data" in record:
+        arr = np.asarray(record["data"], np.float32)
+        if "shape" in record:
+            arr = arr.reshape(record["shape"])
+        return arr
+    raise ValueError(f"record has neither image nor data: {list(record)}")
+
+
+def default_postprocess(probs: np.ndarray, top_n: int = 5) -> List:
+    """top-N (class, prob) pairs (PostProcessing.scala:1-117)."""
+    idx = np.argsort(-probs)[:top_n]
+    return [[int(i), float(probs[i])] for i in idx]
+
+
+class ServingParams:
+    """config.yaml surface (scripts/cluster-serving/config.yaml parity)."""
+
+    def __init__(self, batch_size: int = 4, top_n: int = 5,
+                 poll_timeout_s: float = 0.05, stream_max_len: int = 100000,
+                 filter_threshold: Optional[float] = None):
+        self.batch_size = batch_size
+        self.top_n = top_n
+        self.poll_timeout_s = poll_timeout_s
+        self.stream_max_len = stream_max_len
+        self.filter_threshold = filter_threshold
+
+    @staticmethod
+    def from_yaml(path: str) -> "ServingParams":
+        import yaml
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        params = cfg.get("params", {})
+        return ServingParams(
+            batch_size=int(params.get("batch_size", 4)),
+            top_n=int(params.get("top_n", 5)))
+
+
+class ClusterServing:
+    def __init__(self, model: InferenceModel, queue: BaseQueue,
+                 params: Optional[ServingParams] = None,
+                 preprocess: Callable = default_preprocess,
+                 postprocess: Optional[Callable] = None,
+                 tensorboard_dir: Optional[str] = None):
+        self.model = model
+        self.queue = queue
+        self.params = params or ServingParams()
+        self.preprocess = preprocess
+        self.postprocess = postprocess or (
+            lambda p: default_postprocess(p, self.params.top_n))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.total_records = 0
+        self._tb = None
+        if tensorboard_dir:
+            from analytics_zoo_tpu.utils.tbwriter import FileWriter
+            self._tb = FileWriter(tensorboard_dir)
+
+    # -- one micro-batch ------------------------------------------------------
+    def serve_once(self) -> int:
+        batch = self.queue.read_batch(self.params.batch_size,
+                                      self.params.poll_timeout_s)
+        if not batch:
+            return 0
+        t0 = time.time()
+        ids = [rid for rid, _ in batch]
+        tensors = np.stack([self.preprocess(rec) for _, rec in batch])
+        probs = self.model.do_predict(tensors)
+        for rid, row in zip(ids, probs):
+            self.queue.put_result(rid, {"value": self.postprocess(np.asarray(row))})
+        n = len(batch)
+        self.total_records += n
+        dt = max(time.time() - t0, 1e-9)
+        if self._tb is not None:
+            self._tb.add_scalar("Serving Throughput", n / dt,
+                                self.total_records)
+            self._tb.add_scalar("Total Records Number", self.total_records,
+                                self.total_records)
+        self.queue.trim(self.params.stream_max_len)
+        return n
+
+    # -- lifecycle (cluster-serving-start/stop scripts parity) ----------------
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self.serve_once() == 0:
+                time.sleep(0.005)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._tb is not None:
+            self._tb.flush()

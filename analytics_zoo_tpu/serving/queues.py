@@ -1,0 +1,202 @@
+"""Serving queues — the Redis-stream transport with pluggable backends.
+
+Reference parity: Cluster Serving's Redis streams (`image_stream` XADD in the client,
+result HSET table — serving/ClusterServing.scala:106-307, pyzoo client.py:62-160).
+Backends:
+- `InProcQueue`  — same-process deque (tests, embedded serving)
+- `FileQueue`    — spool-directory stream + result table (cross-process, no deps)
+- `RedisQueue`   — real Redis when the `redis` package + server are available
+
+All share the same four calls: xadd / read_batch / put_result / get_result.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+
+class BaseQueue:
+    def xadd(self, record: Dict) -> str:
+        raise NotImplementedError
+
+    def read_batch(self, max_items: int, timeout_s: float = 0.1) -> List[Tuple[str, Dict]]:
+        raise NotImplementedError
+
+    def put_result(self, key: str, value: Dict) -> None:
+        raise NotImplementedError
+
+    def get_result(self, key: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def result_count(self) -> int:
+        raise NotImplementedError
+
+    def trim(self, max_len: int) -> None:
+        """Memory guard (ClusterServing.scala:134-140 XTRIM analog)."""
+
+
+class InProcQueue(BaseQueue):
+    def __init__(self):
+        self._stream = deque()
+        self._results: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+
+    def xadd(self, record):
+        rid = record.get("uri") or str(uuid.uuid4())
+        with self._lock:
+            self._stream.append((rid, record))
+        return rid
+
+    def read_batch(self, max_items, timeout_s=0.1):
+        deadline = time.time() + timeout_s
+        out = []
+        while len(out) < max_items:
+            with self._lock:
+                while self._stream and len(out) < max_items:
+                    out.append(self._stream.popleft())
+            if out or time.time() > deadline:
+                break
+            time.sleep(0.005)
+        return out
+
+    def put_result(self, key, value):
+        with self._lock:
+            self._results[key] = value
+
+    def get_result(self, key):
+        with self._lock:
+            return self._results.get(key)
+
+    def result_count(self):
+        with self._lock:
+            return len(self._results)
+
+    def trim(self, max_len):
+        with self._lock:
+            while len(self._stream) > max_len:
+                self._stream.popleft()
+
+
+class FileQueue(BaseQueue):
+    """Spool-dir stream: records are json files named <seq>-<id>.json in stream/,
+    results live in results/<key>.json.  Safe for one consumer, many producers."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.stream_dir = os.path.join(root, "stream")
+        self.result_dir = os.path.join(root, "results")
+        os.makedirs(self.stream_dir, exist_ok=True)
+        os.makedirs(self.result_dir, exist_ok=True)
+
+    def xadd(self, record):
+        rid = record.get("uri") or str(uuid.uuid4())
+        seq = f"{time.time_ns()}"
+        tmp = os.path.join(self.stream_dir, f".{seq}-{rid}.tmp")
+        dst = os.path.join(self.stream_dir, f"{seq}-{rid}.json")
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.rename(tmp, dst)
+        return rid
+
+    def read_batch(self, max_items, timeout_s=0.1):
+        deadline = time.time() + timeout_s
+        out = []
+        while len(out) < max_items:
+            files = sorted(f for f in os.listdir(self.stream_dir)
+                           if f.endswith(".json"))
+            for fname in files[:max_items - len(out)]:
+                path = os.path.join(self.stream_dir, fname)
+                try:
+                    with open(path) as f:
+                        rec = json.load(f)
+                    os.remove(path)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    continue
+                rid = fname.split("-", 1)[1][:-5]
+                out.append((rid, rec))
+            if out or time.time() > deadline:
+                break
+            time.sleep(0.01)
+        return out
+
+    def put_result(self, key, value):
+        tmp = os.path.join(self.result_dir, f".{key}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.rename(tmp, os.path.join(self.result_dir, f"{key}.json"))
+
+    def get_result(self, key):
+        path = os.path.join(self.result_dir, f"{key}.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def result_count(self):
+        return len(os.listdir(self.result_dir))
+
+    def trim(self, max_len):
+        files = sorted(f for f in os.listdir(self.stream_dir)
+                       if f.endswith(".json"))
+        for fname in files[:max(0, len(files) - max_len)]:
+            try:
+                os.remove(os.path.join(self.stream_dir, fname))
+            except FileNotFoundError:
+                pass
+
+
+class RedisQueue(BaseQueue):
+    """Real Redis streams (requires the `redis` package + a server)."""
+
+    def __init__(self, host="localhost", port=6379, stream="image_stream",
+                 result_table="result"):
+        import redis
+        self.r = redis.Redis(host=host, port=port)
+        self.stream = stream
+        self.table = result_table
+        self._last_id = "0"
+
+    def xadd(self, record):
+        rid = record.get("uri") or str(uuid.uuid4())
+        self.r.xadd(self.stream, {"data": json.dumps(record)})
+        return rid
+
+    def read_batch(self, max_items, timeout_s=0.1):
+        resp = self.r.xread({self.stream: self._last_id}, count=max_items,
+                            block=int(timeout_s * 1000))
+        out = []
+        for _, entries in resp:
+            for eid, fields in entries:
+                self._last_id = eid
+                rec = json.loads(fields[b"data"])
+                out.append((rec.get("uri", eid.decode()), rec))
+        return out
+
+    def put_result(self, key, value):
+        self.r.hset(self.table, key, json.dumps(value))
+
+    def get_result(self, key):
+        v = self.r.hget(self.table, key)
+        return json.loads(v) if v else None
+
+    def result_count(self):
+        return self.r.hlen(self.table)
+
+    def trim(self, max_len):
+        self.r.xtrim(self.stream, maxlen=max_len)
+
+
+def make_queue(kind: str = "inproc", **kwargs) -> BaseQueue:
+    if kind == "inproc":
+        return InProcQueue()
+    if kind == "file":
+        return FileQueue(kwargs["root"])
+    if kind == "redis":
+        return RedisQueue(**kwargs)
+    raise ValueError(f"unknown queue kind {kind!r}")

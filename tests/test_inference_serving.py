@@ -1,0 +1,118 @@
+"""Inference runtime + Cluster Serving end-to-end (in-proc and file-spool queues)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.inference.inference_model import InferenceModel, _bucket
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue
+
+
+def _trained_model():
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,), name="imfc1"))
+    m.add(Dense(3, activation="softmax", name="imfc2"))
+    m.init_weights()
+    return m
+
+
+def test_bucket_sizes():
+    assert _bucket(1, 1024) == 1
+    assert _bucket(3, 1024) == 4
+    assert _bucket(100, 1024) == 128
+    assert _bucket(5000, 1024) == 1024
+
+
+def test_inference_model_load_and_predict(ctx):
+    m = _trained_model()
+    im = InferenceModel().do_load_model(m)
+    x = np.random.default_rng(0).normal(size=(37, 4)).astype(np.float32)
+    y = im.do_predict(x)
+    assert y.shape == (37, 3)
+    np.testing.assert_allclose(y.sum(-1), np.ones(37), rtol=1e-5)
+    # results identical to direct forward (bucketing must not change outputs)
+    import jax.numpy as jnp
+    direct = np.asarray(m.call(m.get_weights(), jnp.asarray(x)))
+    np.testing.assert_allclose(y, direct, rtol=1e-5, atol=1e-6)
+
+
+def test_inference_model_weights_roundtrip(ctx, tmp_path):
+    m = _trained_model()
+    path = str(tmp_path / "w.npz")
+    m.save_weights(path)
+
+    def builder():
+        m2 = Sequential()
+        m2.add(Dense(8, activation="relu", input_shape=(4,), name="imfc1"))
+        m2.add(Dense(3, activation="softmax", name="imfc2"))
+        return m2
+
+    im = InferenceModel().do_load(builder, path)
+    x = np.ones((2, 4), np.float32)
+    import jax.numpy as jnp
+    np.testing.assert_allclose(im.do_predict(x),
+                               np.asarray(m.call(m.get_weights(),
+                                                 jnp.asarray(x))),
+                               rtol=1e-5)
+
+
+def test_serving_end_to_end_inproc(ctx):
+    m = _trained_model()
+    im = InferenceModel().do_load_model(m)
+    q = InProcQueue()
+    serving = ClusterServing(im, q, ServingParams(batch_size=4, top_n=2),
+                             preprocess=lambda rec: np.asarray(rec["data"],
+                                                               np.float32))
+    inq, outq = InputQueue(q), OutputQueue(q)
+    g = np.random.default_rng(1)
+    for i in range(10):
+        inq.enqueue_tensor(f"t{i}", g.normal(size=(4,)).astype(np.float32))
+    served = 0
+    while served < 10:
+        n = serving.serve_once()
+        if n == 0:
+            break
+        served += n
+    assert served == 10
+    res = outq.query("t3")
+    assert res is not None and len(res["value"]) == 2
+    top_class, top_prob = res["value"][0]
+    assert 0 <= top_class < 3 and 0 < top_prob <= 1.0
+
+
+def test_serving_background_thread_and_file_queue(ctx, tmp_path):
+    m = _trained_model()
+    im = InferenceModel().do_load_model(m)
+    q = FileQueue(str(tmp_path / "q"))
+    serving = ClusterServing(
+        im, q, ServingParams(batch_size=4, top_n=3),
+        preprocess=lambda rec: np.asarray(rec["data"], np.float32),
+        tensorboard_dir=str(tmp_path / "tb")).start()
+    inq, outq = InputQueue(q), OutputQueue(q)
+    for i in range(7):
+        inq.enqueue_tensor(f"r{i}", np.ones((4,), np.float32) * i)
+    res = outq.query("r6", timeout_s=10.0)
+    serving.shutdown()
+    assert res is not None
+    assert serving.total_records == 7
+    from analytics_zoo_tpu.utils.tbwriter import read_scalars
+    scalars = read_scalars(str(tmp_path / "tb"))
+    assert "Serving Throughput" in scalars
+
+
+def test_serving_image_records(ctx):
+    """base64-encoded image path through default_preprocess."""
+    import cv2
+    from analytics_zoo_tpu.serving.engine import default_preprocess
+    import base64
+    img = np.random.default_rng(2).integers(0, 255, (8, 8, 3)).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    rec = {"image": base64.b64encode(buf.tobytes()).decode(), "resize": [4, 4]}
+    out = default_preprocess(rec)
+    assert out.shape == (4, 4, 3)
