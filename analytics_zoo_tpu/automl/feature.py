@@ -1,0 +1,106 @@
+"""Time-series feature engineering.
+
+Reference parity: `TimeSequenceFeatureTransformer` (automl/feature/time_sequence.py:
+1-573) — datetime features (hour / dayofweek / weekend...), rolling unroll into
+(lookback, features) windows, min-max scaling with train-fit/transform split, and
+post-processing (inverse scaling) for predictions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+_DT_FEATURES = ("HOUR", "DAY", "MONTH", "DAYOFWEEK", "WEEKDAY", "WEEKEND",
+                "MINUTE", "IS_BUSY_HOURS")
+
+
+class TimeSequenceFeatureTransformer:
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 drop_missing: bool = True):
+        self.dt_col = dt_col
+        self.target_col = target_col
+        self.extra = list(extra_features_col or [])
+        self.drop_missing = drop_missing
+        self._min = None
+        self._max = None
+
+    # -- datetime features ----------------------------------------------------
+    def _gen_dt_features(self, df: pd.DataFrame,
+                         selected: Sequence[str]) -> pd.DataFrame:
+        dt = pd.to_datetime(df[self.dt_col])
+        out = pd.DataFrame(index=df.index)
+        if "HOUR" in selected:
+            out["HOUR"] = dt.dt.hour
+        if "MINUTE" in selected:
+            out["MINUTE"] = dt.dt.minute
+        if "DAY" in selected:
+            out["DAY"] = dt.dt.day
+        if "MONTH" in selected:
+            out["MONTH"] = dt.dt.month
+        if "DAYOFWEEK" in selected or "WEEKDAY" in selected:
+            out["DAYOFWEEK"] = dt.dt.dayofweek
+        if "WEEKEND" in selected:
+            out["WEEKEND"] = (dt.dt.dayofweek >= 5).astype(int)
+        if "IS_BUSY_HOURS" in selected:
+            out["IS_BUSY_HOURS"] = dt.dt.hour.isin([7, 8, 9, 17, 18, 19]).astype(int)
+        return out
+
+    # -- scaling --------------------------------------------------------------
+    def _fit_scale(self, arr: np.ndarray):
+        self._min = arr.min(axis=0)
+        self._max = arr.max(axis=0)
+
+    def _scale(self, arr: np.ndarray) -> np.ndarray:
+        span = np.where(self._max - self._min < 1e-9, 1.0, self._max - self._min)
+        return (arr - self._min) / span
+
+    def inverse_scale_target(self, y: np.ndarray) -> np.ndarray:
+        span = (self._max[0] - self._min[0]) or 1.0
+        return y * span + self._min[0]
+
+    # -- unroll ---------------------------------------------------------------
+    def fit_transform(self, df: pd.DataFrame, lookback: int = 10,
+                      horizon: int = 1,
+                      dt_features: Sequence[str] = ("HOUR", "DAYOFWEEK",
+                                                    "WEEKEND")
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        mat = self._matrix(df, dt_features)
+        self._fit_scale(mat)
+        return self._unroll(self._scale(mat), lookback, horizon)
+
+    def transform(self, df: pd.DataFrame, lookback: int = 10, horizon: int = 1,
+                  dt_features: Sequence[str] = ("HOUR", "DAYOFWEEK", "WEEKEND"),
+                  with_label: bool = True):
+        mat = self._matrix(df, dt_features)
+        scaled = self._scale(mat)
+        if with_label:
+            return self._unroll(scaled, lookback, horizon)
+        x, _ = self._unroll(scaled, lookback, 0)
+        return x
+
+    def _matrix(self, df: pd.DataFrame, dt_features) -> np.ndarray:
+        if self.drop_missing:
+            df = df.dropna(subset=[self.target_col])
+        cols = [df[self.target_col].to_numpy(np.float32)[:, None]]
+        for c in self.extra:
+            cols.append(df[c].to_numpy(np.float32)[:, None])
+        if self.dt_col in df.columns and dt_features:
+            dtf = self._gen_dt_features(df, dt_features)
+            cols.append(dtf.to_numpy(np.float32))
+        return np.concatenate(cols, axis=1)
+
+    @staticmethod
+    def _unroll(mat: np.ndarray, lookback: int, horizon: int):
+        n = mat.shape[0] - lookback - horizon + 1
+        if n <= 0:
+            raise ValueError("series shorter than lookback+horizon")
+        x = np.stack([mat[i:i + lookback] for i in range(n)])
+        if horizon == 0:
+            return x, None
+        y = np.stack([mat[i + lookback:i + lookback + horizon, 0]
+                      for i in range(n)])
+        return x, y

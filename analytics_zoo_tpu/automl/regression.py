@@ -1,0 +1,197 @@
+"""TimeSequencePredictor + TimeSequencePipeline + Recipes.
+
+Reference parity: `TimeSequencePredictor.fit → TimeSequencePipeline`
+(automl/regression/time_sequence_predictor.py:37-276, pipeline/time_sequence.py:1-221)
+and the `Recipe` HP-space presets (config/recipe.py:1-518).  Each trial builds an LSTM
+forecaster from a sampled config, trains on unrolled windows, and scores validation MSE;
+the best config becomes the pipeline (save/load via json + npz weights).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.automl.search import (
+    BayesSearchEngine, Choice, LogUniform, RandInt, RandomSearchEngine,
+    SearchEngine)
+from analytics_zoo_tpu.nn.layers.core import Dense, Dropout
+from analytics_zoo_tpu.nn.layers.recurrent import LSTM
+from analytics_zoo_tpu.nn.models import Sequential
+from analytics_zoo_tpu.nn.optimizers import Adam
+
+
+# -- recipes (config/recipe.py parity) ----------------------------------------
+
+class Recipe:
+    n_trials = 5
+
+    def search_space(self) -> Dict:
+        raise NotImplementedError
+
+    def engine(self) -> SearchEngine:
+        return RandomSearchEngine(n_trials=self.n_trials, mode="min")
+
+
+class SmokeRecipe(Recipe):
+    n_trials = 2
+
+    def search_space(self):
+        return {"lstm_units": Choice([8]), "lr": Choice([0.01]),
+                "lookback": Choice([8]), "dropout": Choice([0.0]),
+                "epochs": Choice([6]), "batch_size": Choice([32])}
+
+
+class RandomRecipe(Recipe):
+    def __init__(self, n_trials: int = 5, lookback_range=(6, 16)):
+        self.n_trials = n_trials
+        self.lookback_range = lookback_range
+
+    def search_space(self):
+        return {"lstm_units": Choice([16, 32, 64]),
+                "lr": LogUniform(1e-3, 3e-2),
+                "lookback": RandInt(*self.lookback_range),
+                "dropout": Choice([0.0, 0.1, 0.2]),
+                "epochs": Choice([3, 5]),
+                "batch_size": Choice([32, 64])}
+
+
+class BayesRecipe(RandomRecipe):
+    def engine(self):
+        return BayesSearchEngine(n_trials=self.n_trials, mode="min")
+
+
+def _build_lstm_model(cfg: Dict, input_shape) -> Sequential:
+    # stable layer names so saved pipelines reload across processes
+    m = Sequential(name="ts_lstm_model")
+    m.add(LSTM(int(cfg["lstm_units"]), return_sequences=False,
+               input_shape=input_shape, name="ts_lstm"))
+    if cfg.get("dropout", 0) > 0:
+        m.add(Dropout(float(cfg["dropout"]), name="ts_dropout"))
+    m.add(Dense(int(cfg.get("horizon", 1)), name="ts_out"))
+    return m
+
+
+class TimeSequencePredictor:
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 future_seq_len: int = 1, recipe: Optional[Recipe] = None):
+        self.dt_col = dt_col
+        self.target_col = target_col
+        self.extra = extra_features_col
+        self.horizon = int(future_seq_len)
+        self.recipe = recipe or RandomRecipe()
+
+    def fit(self, input_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            verbose: bool = False) -> "TimeSequencePipeline":
+        space = self.recipe.search_space()
+        engine = self.recipe.engine()
+        results: Dict[int, Dict] = {}
+
+        def train_fn(cfg: Dict) -> float:
+            ft = TimeSequenceFeatureTransformer(self.dt_col, self.target_col,
+                                                self.extra)
+            lookback = int(cfg["lookback"])
+            x, y = ft.fit_transform(input_df, lookback=lookback,
+                                    horizon=self.horizon)
+            cfg = dict(cfg, horizon=self.horizon)
+            model = _build_lstm_model(cfg, input_shape=x.shape[1:])
+            model.compile(optimizer=Adam(lr=float(cfg["lr"])), loss="mse")
+            model.fit(x, y, batch_size=int(cfg["batch_size"]),
+                      nb_epoch=int(cfg["epochs"]), verbose=False)
+            if validation_df is not None:
+                vx, vy = ft.transform(validation_df, lookback=lookback,
+                                      horizon=self.horizon)
+            else:
+                cut = int(0.8 * len(x))
+                vx, vy = x[cut:], y[cut:]
+            res = model.evaluate(vx, vy, batch_size=int(cfg["batch_size"]))
+            mse = res["loss"]
+            results[id(cfg)] = {"model": model, "ft": ft, "cfg": cfg}
+            if verbose:
+                print(f"trial cfg={cfg} mse={mse:.5f}")
+            return mse
+
+        engine.run(train_fn, space)
+        best = engine.get_best_trial()
+        # retrain best on full data for the pipeline
+        ft = TimeSequenceFeatureTransformer(self.dt_col, self.target_col,
+                                            self.extra)
+        lookback = int(best.config["lookback"])
+        x, y = ft.fit_transform(input_df, lookback=lookback,
+                                horizon=self.horizon)
+        cfg = dict(best.config, horizon=self.horizon)
+        model = _build_lstm_model(cfg, input_shape=x.shape[1:])
+        model.compile(optimizer=Adam(lr=float(cfg["lr"])), loss="mse")
+        model.fit(x, y, batch_size=int(cfg["batch_size"]),
+                  nb_epoch=int(cfg["epochs"]), verbose=False)
+        return TimeSequencePipeline(model, ft, cfg)
+
+
+class TimeSequencePipeline:
+    def __init__(self, model: Sequential,
+                 feature_transformer: TimeSequenceFeatureTransformer,
+                 config: Dict):
+        self.model = model
+        self.ft = feature_transformer
+        self.config = config
+
+    def predict(self, df: pd.DataFrame) -> np.ndarray:
+        x, _ = self.ft.transform(df, lookback=int(self.config["lookback"]),
+                                 horizon=int(self.config["horizon"]))
+        y = self.model.predict(x, batch_size=128)
+        return self.ft.inverse_scale_target(y)
+
+    def evaluate(self, df: pd.DataFrame, metrics=("mse",)) -> Dict[str, float]:
+        lookback = int(self.config["lookback"])
+        horizon = int(self.config["horizon"])
+        x, y = self.ft.transform(df, lookback=lookback, horizon=horizon)
+        pred = self.model.predict(x, batch_size=128)
+        y_t = self.ft.inverse_scale_target(y)
+        p_t = self.ft.inverse_scale_target(pred)
+        out = {}
+        for m in metrics:
+            if m == "mse":
+                out["mse"] = float(np.mean((y_t - p_t) ** 2))
+            elif m == "rmse":
+                out["rmse"] = float(np.sqrt(np.mean((y_t - p_t) ** 2)))
+            elif m in ("mae",):
+                out["mae"] = float(np.mean(np.abs(y_t - p_t)))
+            elif m == "smape":
+                out["smape"] = float(100 * np.mean(
+                    2 * np.abs(p_t - y_t) / (np.abs(p_t) + np.abs(y_t) + 1e-9)))
+        return out
+
+    # -- persistence (pipeline/time_sequence.py save/load) --------------------
+    def save(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.model.save_weights(os.path.join(path, "weights.npz"))
+        meta = {"config": {k: v for k, v in self.config.items()},
+                "scaler_min": np.asarray(self.ft._min).tolist(),
+                "scaler_max": np.asarray(self.ft._max).tolist(),
+                "dt_col": self.ft.dt_col, "target_col": self.ft.target_col,
+                "extra": self.ft.extra}
+        with open(os.path.join(path, "pipeline.json"), "w") as f:
+            json.dump(meta, f)
+
+    @staticmethod
+    def load(path: str) -> "TimeSequencePipeline":
+        with open(os.path.join(path, "pipeline.json")) as f:
+            meta = json.load(f)
+        cfg = meta["config"]
+        ft = TimeSequenceFeatureTransformer(meta["dt_col"], meta["target_col"],
+                                            meta["extra"])
+        ft._min = np.asarray(meta["scaler_min"], np.float32)
+        ft._max = np.asarray(meta["scaler_max"], np.float32)
+        n_feat = len(ft._min)
+        model = _build_lstm_model(cfg, input_shape=(int(cfg["lookback"]),
+                                                    n_feat))
+        model.init_weights()
+        model.load_weights(os.path.join(path, "weights.npz"))
+        return TimeSequencePipeline(model, ft, cfg)

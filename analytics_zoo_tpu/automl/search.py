@@ -1,0 +1,188 @@
+"""AutoML search engine + hyperparameter space.
+
+Reference parity: `SearchEngine` (automl/search/abstract.py:1-66) with the
+RayTuneSearchEngine implementation (search/RayTuneSearchEngine.py:28-224: `tune.run`
+over a sample-space dict, optional Bayesian search).  Ray is not available in this
+environment, so the engine is native: sequential (or thread-pooled) trials over sampled
+configs — the single-controller pattern that fits a TPU host better than a Ray cluster
+bootstrapped inside Spark (SURVEY.md §7 step 10).  Space primitives mirror
+automl/config/recipe.py usage (tune.uniform/qrandint/choice...).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# -- search space primitives ---------------------------------------------------
+
+class Sampler:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self) -> List:
+        raise NotImplementedError("no finite grid for this sampler")
+
+
+@dataclasses.dataclass
+class Uniform(Sampler):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+@dataclasses.dataclass
+class LogUniform(Sampler):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(np.log(self.low), np.log(self.high))))
+
+
+@dataclasses.dataclass
+class RandInt(Sampler):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+
+@dataclasses.dataclass
+class QUniform(Sampler):
+    low: float
+    high: float
+    q: float = 1.0
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return float(np.round(v / self.q) * self.q)
+
+
+@dataclasses.dataclass
+class Choice(Sampler):
+    options: Sequence
+
+    def sample(self, rng):
+        return self.options[int(rng.integers(0, len(self.options)))]
+
+    def grid(self):
+        return list(self.options)
+
+
+def sample_config(space: Dict[str, Any], rng: np.random.Generator) -> Dict:
+    out = {}
+    for k, v in space.items():
+        out[k] = v.sample(rng) if isinstance(v, Sampler) else v
+    return out
+
+
+# -- engines -------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Trial:
+    config: Dict
+    metric: float
+    extra: Optional[Dict] = None
+
+
+class SearchEngine:
+    """abstract.py parity: compile(space) -> run() -> get_best_config()."""
+
+    def __init__(self, mode: str = "min"):
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.trials: List[Trial] = []
+
+    def run(self, train_fn: Callable[[Dict], float], space: Dict) -> List[Trial]:
+        raise NotImplementedError
+
+    def get_best_trial(self) -> Trial:
+        if not self.trials:
+            raise RuntimeError("no trials have run")
+        key = (min if self.mode == "min" else max)
+        return key(self.trials, key=lambda t: t.metric)
+
+    def get_best_config(self) -> Dict:
+        return self.get_best_trial().config
+
+
+class RandomSearchEngine(SearchEngine):
+    def __init__(self, n_trials: int = 10, mode: str = "min", seed: int = 0,
+                 parallelism: int = 1):
+        super().__init__(mode)
+        self.n_trials = n_trials
+        self.seed = seed
+        self.parallelism = parallelism
+
+    def run(self, train_fn, space):
+        rng = np.random.default_rng(self.seed)
+        configs = [sample_config(space, rng) for _ in range(self.n_trials)]
+        if self.parallelism > 1:
+            with ThreadPoolExecutor(self.parallelism) as pool:
+                metrics = list(pool.map(train_fn, configs))
+        else:
+            metrics = [train_fn(c) for c in configs]
+        self.trials = [Trial(c, float(m)) for c, m in zip(configs, metrics)]
+        return self.trials
+
+
+class GridSearchEngine(SearchEngine):
+    """Cartesian product over Choice dims; non-Choice samplers drawn once per point."""
+
+    def __init__(self, mode: str = "min", seed: int = 0):
+        super().__init__(mode)
+        self.seed = seed
+
+    def run(self, train_fn, space):
+        import itertools
+        rng = np.random.default_rng(self.seed)
+        grid_keys = [k for k, v in space.items()
+                     if isinstance(v, Choice)]
+        grids = [space[k].grid() for k in grid_keys]
+        self.trials = []
+        for combo in itertools.product(*grids) if grids else [()]:
+            cfg = sample_config(
+                {k: v for k, v in space.items() if k not in grid_keys}, rng)
+            cfg.update(dict(zip(grid_keys, combo)))
+            self.trials.append(Trial(cfg, float(train_fn(cfg))))
+        return self.trials
+
+
+class BayesSearchEngine(SearchEngine):
+    """Lightweight Bayesian-ish search: random exploration then local perturbation of
+    the incumbent (the reference's BayesOpt option without the skopt dep)."""
+
+    def __init__(self, n_trials: int = 20, explore_frac: float = 0.5,
+                 mode: str = "min", seed: int = 0):
+        super().__init__(mode)
+        self.n_trials = n_trials
+        self.explore = max(1, int(n_trials * explore_frac))
+        self.seed = seed
+
+    def run(self, train_fn, space):
+        rng = np.random.default_rng(self.seed)
+        self.trials = []
+        for i in range(self.n_trials):
+            if i < self.explore or not self.trials:
+                cfg = sample_config(space, rng)
+            else:
+                best = self.get_best_trial().config
+                cfg = dict(best)
+                for k, v in space.items():
+                    if isinstance(v, (Uniform, LogUniform, QUniform)) \
+                            and rng.random() < 0.5:
+                        jitter = 0.8 + 0.4 * rng.random()
+                        cfg[k] = float(np.clip(best[k] * jitter, v.low, v.high))
+                    elif isinstance(v, (Choice, RandInt)) and rng.random() < 0.3:
+                        cfg[k] = v.sample(rng)
+            self.trials.append(Trial(cfg, float(train_fn(cfg))))
+        return self.trials

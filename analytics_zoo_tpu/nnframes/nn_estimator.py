@@ -1,0 +1,195 @@
+"""NNFrames — DataFrame Estimator/Transformer integration.
+
+Reference parity: `NNEstimator.fit → NNModel.transform` (nnframes/NNEstimator.scala:
+198-923), `NNClassifier/NNClassifierModel` (NNClassifier.scala:42-306), and
+`NNImageReader` (NNImageReader.scala:1-182).  The tabular substrate is pandas (Arrow
+interchange covers Spark handoff — SURVEY.md §7 step 6): `fit(df)` assembles feature/
+label arrays through `sample_preprocessing`, trains on the mesh via the Estimator, and
+returns an `NNModel` whose `transform(df)` appends a prediction column partition-wise.
+
+The Spark-ML param-setter surface (setFeaturesCol etc.) is kept as chainable set_*
+methods.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _column_to_array(df: pd.DataFrame, col: str) -> np.ndarray:
+    """A column of scalars or fixed-length lists -> (N, ...) float32 array."""
+    first = df[col].iloc[0]
+    if np.isscalar(first):
+        return df[col].to_numpy(np.float32)[:, None]
+    return np.stack([np.asarray(v, np.float32) for v in df[col]])
+
+
+class NNEstimator:
+    def __init__(self, model: Layer, loss,
+                 feature_preprocessing: Optional[Callable] = None,
+                 label_preprocessing: Optional[Callable] = None):
+        self.model = model
+        self.loss = loss
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.features_col: Union[str, List[str]] = "features"
+        self.label_col = "label"
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.optimizer = "adam"
+        self.metrics: Sequence = ()
+        self.ckpt_dir: Optional[str] = None
+        self.validation_df: Optional[pd.DataFrame] = None
+        self.tb: Optional[tuple] = None
+
+    # -- Spark-ML-style param setters ----------------------------------------
+    def set_features_col(self, col):
+        self.features_col = col
+        return self
+
+    def set_label_col(self, col):
+        self.label_col = col
+        return self
+
+    def set_batch_size(self, n):
+        self.batch_size = int(n)
+        return self
+
+    def set_max_epoch(self, n):
+        self.max_epoch = int(n)
+        return self
+
+    def set_optim_method(self, optimizer):
+        self.optimizer = optimizer
+        return self
+
+    def set_metrics(self, metrics):
+        self.metrics = metrics
+        return self
+
+    def set_checkpoint(self, path):
+        self.ckpt_dir = path
+        return self
+
+    def set_validation(self, df: pd.DataFrame):
+        self.validation_df = df
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        self.tb = (log_dir, app_name)
+        return self
+
+    # -- feature assembly (getDataSet / samplePreprocessing analog) -----------
+    def _assemble(self, df: pd.DataFrame, with_label: bool = True):
+        cols = (self.features_col if isinstance(self.features_col, list)
+                else [self.features_col])
+        xs = [_column_to_array(df, c) for c in cols]
+        if self.feature_preprocessing is not None:
+            xs = [self.feature_preprocessing(x) for x in xs]
+        x = xs if len(xs) > 1 else xs[0]
+        y = None
+        if with_label and self.label_col in df.columns:
+            y = _column_to_array(df, self.label_col)
+            if self.label_preprocessing is not None:
+                y = self.label_preprocessing(y)
+        return x, y
+
+    # -- fit -------------------------------------------------------------------
+    def fit(self, df: pd.DataFrame) -> "NNModel":
+        x, y = self._assemble(df)
+        est = Estimator(self.model, optimizer=self.optimizer, loss=self.loss,
+                        metrics=self.metrics)
+        if self.ckpt_dir:
+            est.set_checkpoint(self.ckpt_dir)
+        if self.tb:
+            est.set_tensorboard(*self.tb)
+        val = None
+        if self.validation_df is not None:
+            val = self._assemble(self.validation_df)
+        est.fit(x, y, batch_size=self.batch_size, epochs=self.max_epoch,
+                validation_data=val, verbose=False)
+        return self._wrap_model(est)
+
+    def _wrap_model(self, est: Estimator) -> "NNModel":
+        m = NNModel(self.model, est)
+        m.features_col = self.features_col
+        m.feature_preprocessing = self.feature_preprocessing
+        m.batch_size = self.batch_size
+        return m
+
+
+class NNModel:
+    """Spark-ML Transformer analog: transform(df) appends `prediction`."""
+
+    def __init__(self, model: Layer, est: Optional[Estimator] = None):
+        self.model = model
+        self.est = est or Estimator(model)
+        self.features_col: Union[str, List[str]] = "features"
+        self.feature_preprocessing: Optional[Callable] = None
+        self.batch_size = 32
+        self.prediction_col = "prediction"
+
+    def set_prediction_col(self, col):
+        self.prediction_col = col
+        return self
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        cols = (self.features_col if isinstance(self.features_col, list)
+                else [self.features_col])
+        xs = [_column_to_array(df, c) for c in cols]
+        if self.feature_preprocessing is not None:
+            xs = [self.feature_preprocessing(x) for x in xs]
+        x = xs if len(xs) > 1 else xs[0]
+        pred = self.est.predict(x, batch_size=self.batch_size)
+        out = df.copy()
+        out[self.prediction_col] = [self._format(p) for p in np.asarray(pred)]
+        return out
+
+    def _format(self, p: np.ndarray):
+        return p.tolist() if p.ndim > 0 and p.size > 1 else float(np.ravel(p)[0])
+
+
+class NNClassifier(NNEstimator):
+    """Classification specialisation: argmax prediction column
+    (NNClassifier.scala:42-306; labels zero-based here)."""
+
+    def fit(self, df: pd.DataFrame) -> "NNClassifierModel":
+        base = super().fit(df)
+        m = NNClassifierModel(self.model, base.est)
+        m.features_col = base.features_col
+        m.feature_preprocessing = base.feature_preprocessing
+        m.batch_size = base.batch_size
+        return m
+
+
+class NNClassifierModel(NNModel):
+    def _format(self, p: np.ndarray):
+        if p.ndim == 0 or p.size == 1:
+            return float(np.ravel(p)[0] > 0.5)
+        return float(int(np.argmax(p)))
+
+
+class NNImageReader:
+    """Read an image directory into a DataFrame with an image-schema column
+    (NNImageReader.scala / NNImageSchema parity)."""
+
+    @staticmethod
+    def read_images(path: str, with_label: bool = False) -> pd.DataFrame:
+        from analytics_zoo_tpu.feature.image import ImageSet
+        iset = ImageSet.read(path, with_label=with_label)
+        rows = []
+        for f in iset.features:
+            img = f.image
+            row = {"image": {"origin": f.get("uri"), "height": img.shape[0],
+                             "width": img.shape[1], "nChannels": img.shape[2],
+                             "data": img}}
+            if with_label:
+                row["label"] = f.get("label")
+            rows.append(row)
+        return pd.DataFrame(rows)

@@ -1,0 +1,151 @@
+"""Zouwu — time-series forecasting toolkit.
+
+Reference parity: pyzoo/zoo/zouwu — `LSTMForecaster` (model/forecast.py:49-107),
+`MTNetForecaster` (:108-160), `AutoTSTrainer` (autots/forecast.py:22-79) and
+`TSPipeline` (:81-170).  Forecasters are thin KerasNet builds (the reference builds
+TFPark KerasModels); AutoTS wraps the automl TimeSequencePredictor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.regression import (
+    Recipe, TimeSequencePipeline, TimeSequencePredictor)
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.nn.graph import Input
+from analytics_zoo_tpu.nn.layers.conv import Convolution1D
+from analytics_zoo_tpu.nn.layers.core import (
+    Dense, Dropout, Flatten, Lambda, merge)
+from analytics_zoo_tpu.nn.layers.recurrent import GRU, LSTM
+from analytics_zoo_tpu.nn.models import Model, Sequential
+
+
+class Forecaster(ZooModel):
+    """Common fit/predict surface over (B, lookback, features) windows."""
+
+    def fit(self, x, y, **kw):
+        kw.setdefault("verbose", False)
+        return self.model.fit(x, y, **kw)
+
+
+class LSTMForecaster(Forecaster):
+    """Two stacked LSTMs + dropout -> dense horizon head (forecast.py:49-107)."""
+
+    def __init__(self, horizon: int = 1, feature_dim: int = 1,
+                 lookback: int = 10, lstm_1_units: int = 16,
+                 lstm_2_units: int = 8, dropout: float = 0.2,
+                 target_col_num: int = 1):
+        self.horizon = horizon
+        self.feature_dim = feature_dim
+        self.lookback = lookback
+        self.l1, self.l2 = lstm_1_units, lstm_2_units
+        self.dropout = dropout
+        super().__init__()
+
+    def build_model(self) -> Sequential:
+        m = Sequential(name="LSTMForecaster")
+        m.add(LSTM(self.l1, return_sequences=True,
+                   input_shape=(self.lookback, self.feature_dim),
+                   name="zf_lstm1"))
+        m.add(Dropout(self.dropout, name="zf_drop1"))
+        m.add(LSTM(self.l2, return_sequences=False, name="zf_lstm2"))
+        m.add(Dropout(self.dropout, name="zf_drop2"))
+        m.add(Dense(self.horizon, name="zf_out"))
+        return m
+
+
+class Seq2SeqForecaster(Forecaster):
+    """GRU encoder-decoder forecaster (automl/model Seq2Seq flavour)."""
+
+    def __init__(self, horizon: int = 1, feature_dim: int = 1,
+                 lookback: int = 10, latent_dim: int = 32,
+                 dropout: float = 0.1):
+        self.horizon = horizon
+        self.feature_dim = feature_dim
+        self.lookback = lookback
+        self.latent = latent_dim
+        self.dropout = dropout
+        super().__init__()
+
+    def build_model(self) -> Sequential:
+        m = Sequential(name="Seq2SeqForecaster")
+        m.add(GRU(self.latent, return_sequences=True,
+                  input_shape=(self.lookback, self.feature_dim), name="s2s_enc"))
+        m.add(Dropout(self.dropout, name="s2s_drop"))
+        m.add(GRU(self.latent, return_sequences=False, name="s2s_dec"))
+        m.add(Dense(self.horizon, name="s2s_out"))
+        return m
+
+
+class MTNetForecaster(Forecaster):
+    """Memory-augmented CNN + attention + autoregressive skip path
+    (MTNet, zouwu model/forecast.py:108-160; simplified long/short memory series)."""
+
+    def __init__(self, horizon: int = 1, feature_dim: int = 1,
+                 lookback: int = 16, cnn_filters: int = 32,
+                 cnn_kernel: int = 3, ar_window: int = 4,
+                 dropout: float = 0.1):
+        self.horizon = horizon
+        self.feature_dim = feature_dim
+        self.lookback = lookback
+        self.filters = cnn_filters
+        self.kernel = cnn_kernel
+        self.ar_window = min(ar_window, lookback)
+        self.dropout = dropout
+        super().__init__()
+
+    def build_model(self) -> Model:
+        import jax.numpy as jnp
+        inp = Input(shape=(self.lookback, self.feature_dim), name="mt_input")
+        conv = Convolution1D(self.filters, self.kernel, activation="relu",
+                             border_mode="same", name="mt_conv")(inp)
+        enc = GRU(self.filters, return_sequences=False, name="mt_gru")(conv)
+        enc = Dropout(self.dropout, name="mt_drop")(enc)
+        nonlinear = Dense(self.horizon, name="mt_nl_out")(enc)
+        # autoregressive highway on the target channel (last ar_window steps)
+        ar_in = Lambda(lambda t: t[:, -self.ar_window:, 0], name="mt_ar_slice")(inp)
+        ar = Dense(self.horizon, name="mt_ar")(ar_in)
+        out = merge([nonlinear, ar], mode="sum", name="mt_sum")
+        return Model(input=inp, output=out, name="MTNetForecaster")
+
+
+class AutoTSTrainer:
+    """AutoML-driven forecaster selection (autots/forecast.py:22-79)."""
+
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 horizon: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 recipe: Optional[Recipe] = None):
+        self._predictor = TimeSequencePredictor(
+            dt_col=dt_col, target_col=target_col,
+            extra_features_col=extra_features_col, future_seq_len=horizon,
+            recipe=recipe)
+
+    def fit(self, train_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None) -> "TSPipeline":
+        pipe = self._predictor.fit(train_df, validation_df)
+        return TSPipeline(pipe)
+
+
+class TSPipeline:
+    """Deployable fitted pipeline (autots/forecast.py:81-170)."""
+
+    def __init__(self, pipeline: TimeSequencePipeline):
+        self._p = pipeline
+
+    def predict(self, df: pd.DataFrame) -> np.ndarray:
+        return self._p.predict(df)
+
+    def evaluate(self, df: pd.DataFrame, metrics=("mse", "smape")):
+        return self._p.evaluate(df, metrics)
+
+    def save(self, path: str):
+        self._p.save(path)
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        return TSPipeline(TimeSequencePipeline.load(path))

@@ -1,0 +1,159 @@
+"""AutoML search, time-series pipeline, zouwu forecasters, NNFrames."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.automl.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.automl.regression import (
+    SmokeRecipe, TimeSequencePipeline, TimeSequencePredictor)
+from analytics_zoo_tpu.automl.search import (
+    BayesSearchEngine, Choice, GridSearchEngine, LogUniform, RandInt,
+    RandomSearchEngine, Uniform, sample_config)
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.nnframes.nn_estimator import (
+    NNClassifier, NNEstimator, NNModel)
+from analytics_zoo_tpu.zouwu.forecast import (
+    AutoTSTrainer, LSTMForecaster, MTNetForecaster, TSPipeline)
+
+
+def _ts_df(n=240, freq="h", seed=0):
+    g = np.random.default_rng(seed)
+    t = pd.date_range("2020-01-01", periods=n, freq=freq)
+    value = (10 + np.sin(np.arange(n) * 2 * np.pi / 24)
+             + 0.1 * g.normal(size=n))
+    return pd.DataFrame({"datetime": t, "value": value.astype(np.float32)})
+
+
+def test_search_engines_find_minimum():
+    space = {"x": Uniform(-4.0, 4.0), "k": Choice([1.0, 2.0])}
+
+    def objective(cfg):
+        return (cfg["x"] - 1.0) ** 2 + cfg["k"]
+
+    eng = RandomSearchEngine(n_trials=60, seed=0)
+    eng.run(objective, space)
+    best = eng.get_best_config()
+    assert abs(best["x"] - 1.0) < 0.6 and best["k"] == 1.0
+
+    bayes = BayesSearchEngine(n_trials=40, seed=0)
+    bayes.run(objective, space)
+    assert bayes.get_best_trial().metric <= eng.get_best_trial().metric + 0.5
+
+    grid = GridSearchEngine()
+    grid.run(lambda c: c["a"] * 10 + c["b"], {"a": Choice([0, 1]),
+                                              "b": Choice([2, 3])})
+    assert grid.get_best_config() == {"a": 0, "b": 2}
+    assert len(grid.trials) == 4
+
+
+def test_sampler_types():
+    g = np.random.default_rng(0)
+    cfg = sample_config({"u": Uniform(0, 1), "l": LogUniform(1e-4, 1e-1),
+                         "i": RandInt(2, 5), "c": Choice(["a", "b"]),
+                         "fixed": 7}, g)
+    assert 0 <= cfg["u"] <= 1
+    assert 1e-4 <= cfg["l"] <= 1e-1
+    assert 2 <= cfg["i"] <= 5
+    assert cfg["c"] in ("a", "b")
+    assert cfg["fixed"] == 7
+
+
+def test_feature_transformer_unroll_and_scale():
+    df = _ts_df(100)
+    ft = TimeSequenceFeatureTransformer()
+    x, y = ft.fit_transform(df, lookback=12, horizon=2)
+    assert x.shape == (100 - 12 - 2 + 1, 12, 1 + 3)  # value + 3 dt features
+    assert y.shape == (87, 2)
+    assert x.min() >= 0.0 and x.max() <= 1.0
+    restored = ft.inverse_scale_target(y)
+    assert restored.min() > 8.0  # back to the ~10-centred series
+
+
+def test_time_sequence_predictor_smoke(ctx, tmp_path):
+    df = _ts_df(150)
+    pred = TimeSequencePredictor(recipe=SmokeRecipe())
+    pipe = pred.fit(df, verbose=False)
+    out = pipe.predict(df)
+    assert out.shape[1] == 1
+    metrics = pipe.evaluate(df, metrics=("mse", "smape"))
+    assert metrics["mse"] < 1.0  # near-deterministic sinusoid
+    # persistence round-trip
+    path = str(tmp_path / "pipe")
+    pipe.save(path)
+    pipe2 = TimeSequencePipeline.load(path)
+    np.testing.assert_allclose(pipe2.predict(df), out, rtol=1e-4, atol=1e-4)
+
+
+def test_forecasters_learn_sine(ctx):
+    df = _ts_df(200)
+    ft = TimeSequenceFeatureTransformer()
+    x, y = ft.fit_transform(df, lookback=16, horizon=1)
+    for cls, kw in [(LSTMForecaster, dict(lstm_1_units=16, lstm_2_units=8)),
+                    (MTNetForecaster, dict(cnn_filters=16))]:
+        f = cls(horizon=1, feature_dim=x.shape[-1], lookback=16, **kw)
+        from analytics_zoo_tpu.nn.optimizers import Adam
+        f.compile(optimizer=Adam(lr=0.01), loss="mse")
+        hist = f.fit(x, y, batch_size=32, nb_epoch=5)
+        assert hist.history["loss"][-1] < hist.history["loss"][0], cls.__name__
+
+
+def test_autots_trainer(ctx):
+    df = _ts_df(150)
+    trainer = AutoTSTrainer(recipe=SmokeRecipe())
+    ts_pipe = trainer.fit(df)
+    res = ts_pipe.evaluate(df)
+    assert "mse" in res
+
+
+def test_nnframes_estimator_and_classifier(ctx):
+    g = np.random.default_rng(0)
+    n = 256
+    feats = g.normal(size=(n, 6)).astype(np.float32)
+    label = (feats.sum(-1) > 0).astype(np.float32)
+    df = pd.DataFrame({"features": list(feats), "label": label})
+
+    def builder():
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(6,)))
+        m.add(Dense(1, activation="sigmoid"))
+        return m
+
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    est = (NNEstimator(builder(), "binary_crossentropy")
+           .set_optim_method(Adam(lr=0.02)).set_batch_size(64).set_max_epoch(8))
+    nn_model = est.fit(df)
+    out = nn_model.transform(df)
+    assert "prediction" in out.columns
+    pred = np.asarray(out["prediction"], np.float32)
+    acc = ((pred > 0.5) == label).mean()
+    assert acc > 0.85
+
+    clf = (NNClassifier(builder(), "binary_crossentropy")
+           .set_optim_method(Adam(lr=0.02)).set_batch_size(64).set_max_epoch(8))
+    clf_model = clf.fit(df)
+    out2 = clf_model.transform(df)
+    preds = np.asarray(out2["prediction"], np.float32)
+    assert set(np.unique(preds)) <= {0.0, 1.0}
+    assert (preds == label).mean() > 0.85
+
+
+def test_nnframes_multi_feature_cols(ctx):
+    g = np.random.default_rng(1)
+    n = 128
+    a = g.normal(size=(n, 3)).astype(np.float32)
+    b = g.normal(size=(n, 3)).astype(np.float32)
+    label = (a.sum(-1) > b.sum(-1)).astype(np.float32)
+    df = pd.DataFrame({"fa": list(a), "fb": list(b), "label": label})
+    from analytics_zoo_tpu.nn import Input, Model
+    from analytics_zoo_tpu.nn.layers import merge
+    ia, ib = Input(shape=(3,)), Input(shape=(3,))
+    h = merge([Dense(8, activation="relu")(ia),
+               Dense(8, activation="relu")(ib)], mode="concat")
+    model = Model(input=[ia, ib], output=Dense(1, activation="sigmoid")(h))
+    est = (NNEstimator(model, "binary_crossentropy")
+           .set_features_col(["fa", "fb"]).set_batch_size(32).set_max_epoch(3))
+    nn_model = est.fit(df)
+    out = nn_model.transform(df)
+    assert len(out) == n
