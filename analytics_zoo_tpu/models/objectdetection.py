@@ -1,0 +1,313 @@
+"""Object detection: SSD graph, bbox utilities, MultiBox loss, mAP evaluation.
+
+Reference parity: models/objectdetection — SSD assembly (ssd/SSD.scala:1-214,
+SSDGraph.scala:1-220), `BboxUtil` (common/BboxUtil.scala:1-1033: encode/decode with
+center-size variances, IoU, NMS), `MultiBoxLoss` (common/MultiBoxLoss.scala:1-622:
+smooth-L1 localisation + cross-entropy with 3:1 hard negative mining), and the
+PascalVOC mAP evaluator (common/evaluation/EvalUtil.scala:1-223).
+
+TPU split: anchor matching/encoding runs on host per image (data pipeline); the network
+forward + MultiBox loss are one jitted program over (B, num_priors, ...) dense tensors —
+no dynamic shapes.  Decode+NMS run on host at inference (as in the reference's
+post-processing).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.nn.graph import Input, SymTensor
+from analytics_zoo_tpu.nn.layers.conv import Convolution2D
+from analytics_zoo_tpu.nn.layers.core import (
+    Activation, BatchNormalization, Lambda, Reshape, merge)
+from analytics_zoo_tpu.nn.layers.pooling import MaxPooling2D
+from analytics_zoo_tpu.nn.models import Model
+
+# ---------------------------------------------------------------------------
+# bbox utils (BboxUtil parity; boxes are (x1, y1, x2, y2) normalised to [0,1])
+# ---------------------------------------------------------------------------
+
+def iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(Na, 4) x (Nb, 4) -> (Na, Nb) IoU."""
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / np.clip(union, 1e-9, None)
+
+
+def encode_boxes(priors: np.ndarray, boxes: np.ndarray,
+                 variances=(0.1, 0.2)) -> np.ndarray:
+    """gt boxes -> center-size offsets relative to priors (BboxUtil.encodeBoxes)."""
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    gcx = (boxes[:, 0] + boxes[:, 2]) / 2
+    gcy = (boxes[:, 1] + boxes[:, 3]) / 2
+    gw = np.clip(boxes[:, 2] - boxes[:, 0], 1e-8, None)
+    gh = np.clip(boxes[:, 3] - boxes[:, 1], 1e-8, None)
+    return np.stack([
+        (gcx - pcx) / (pw * variances[0]),
+        (gcy - pcy) / (ph * variances[0]),
+        np.log(gw / pw) / variances[1],
+        np.log(gh / ph) / variances[1]], axis=1).astype(np.float32)
+
+
+def decode_boxes(priors: np.ndarray, deltas: np.ndarray,
+                 variances=(0.1, 0.2)) -> np.ndarray:
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    cx = deltas[:, 0] * variances[0] * pw + pcx
+    cy = deltas[:, 1] * variances[0] * ph + pcy
+    w = np.exp(deltas[:, 2] * variances[1]) * pw
+    h = np.exp(deltas[:, 3] * variances[1]) * ph
+    return np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.45,
+        top_k: int = 200) -> np.ndarray:
+    """Greedy NMS; returns kept indices (BboxUtil.nms semantics)."""
+    order = np.argsort(-scores)[:top_k]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        ious = iou_matrix(boxes[i:i + 1], boxes[order[1:]])[0]
+        order = order[1:][ious <= iou_threshold]
+    return np.asarray(keep, np.int64)
+
+
+def match_priors(priors: np.ndarray, gt_boxes: np.ndarray,
+                 gt_labels: np.ndarray, iou_threshold: float = 0.5
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Assign each prior a class (0 = background) and encoded loc target
+    (MultiBoxLoss matching stage: best-prior-per-gt forced + per-prior threshold)."""
+    P = priors.shape[0]
+    cls_t = np.zeros((P,), np.int32)
+    loc_t = np.zeros((P, 4), np.float32)
+    if gt_boxes.shape[0] == 0:
+        return cls_t, loc_t
+    ious = iou_matrix(priors, gt_boxes)              # (P, G)
+    best_gt = ious.argmax(1)
+    best_gt_iou = ious.max(1)
+    # force-match the best prior for every gt
+    best_prior = ious.argmax(0)
+    best_gt[best_prior] = np.arange(gt_boxes.shape[0])
+    best_gt_iou[best_prior] = 1.0
+    pos = best_gt_iou >= iou_threshold
+    cls_t[pos] = gt_labels[best_gt[pos]]
+    loc_t[pos] = encode_boxes(priors[pos], gt_boxes[best_gt[pos]])
+    return cls_t, loc_t
+
+
+# ---------------------------------------------------------------------------
+# prior boxes (PriorBox op parity)
+# ---------------------------------------------------------------------------
+
+def generate_priors(feature_sizes: Sequence[int], image_size: int,
+                    min_scale: float = 0.2, max_scale: float = 0.9,
+                    aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)
+                    ) -> np.ndarray:
+    """Dense anchors over len(feature_sizes) scales -> (P, 4) in [0,1]."""
+    K = len(feature_sizes)
+    scales = [min_scale + (max_scale - min_scale) * k / max(K - 1, 1)
+              for k in range(K)]
+    priors = []
+    for k, fs in enumerate(feature_sizes):
+        for i, j in itertools.product(range(fs), repeat=2):
+            cx = (j + 0.5) / fs
+            cy = (i + 0.5) / fs
+            for ar in aspect_ratios:
+                w = scales[k] * math.sqrt(ar)
+                h = scales[k] / math.sqrt(ar)
+                priors.append([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2])
+    return np.clip(np.asarray(priors, np.float32), 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# SSD network
+# ---------------------------------------------------------------------------
+
+def _conv_block(x, filters, name, stride=1):
+    x = Convolution2D(filters, 3, subsample=stride, border_mode="same",
+                      bias=False, init="he_normal", name=name + "_conv")(x)
+    x = BatchNormalization(name=name + "_bn")(x)
+    return Activation("relu", name=name + "_act")(x)
+
+
+class SSD:
+    """Compact SSD: conv backbone + per-scale loc/conf heads.
+
+    Outputs [loc (B, P, 4), conf (B, P, classes)]; `num_anchors` per cell follows the
+    aspect-ratio list.  For parity the class count INCLUDES background at index 0."""
+
+    def __init__(self, class_num: int, image_size: int = 96,
+                 aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5),
+                 base_filters: int = 32):
+        self.class_num = int(class_num)
+        self.image_size = int(image_size)
+        self.aspect_ratios = tuple(aspect_ratios)
+        self.base = base_filters
+        self.feature_sizes = [image_size // 8, image_size // 16,
+                              image_size // 32]
+        self.priors = generate_priors(self.feature_sizes, image_size,
+                                      aspect_ratios=self.aspect_ratios)
+        self.model = self._build()
+
+    def _build(self) -> Model:
+        A = len(self.aspect_ratios)
+        C = self.class_num
+        inp = Input(shape=(self.image_size, self.image_size, 3),
+                    name="ssd_input")
+        x = _conv_block(inp, self.base, "ssd_c1", stride=2)
+        x = _conv_block(x, self.base * 2, "ssd_c2", stride=2)
+        f1 = _conv_block(x, self.base * 4, "ssd_c3", stride=2)    # /8
+        f2 = _conv_block(f1, self.base * 4, "ssd_c4", stride=2)   # /16
+        f3 = _conv_block(f2, self.base * 4, "ssd_c5", stride=2)   # /32
+        locs, confs = [], []
+        for i, f in enumerate([f1, f2, f3]):
+            fs = self.feature_sizes[i]
+            loc = Convolution2D(A * 4, 3, border_mode="same",
+                                name=f"ssd_loc{i}")(f)
+            loc = Reshape((fs * fs * A, 4), name=f"ssd_loc{i}_r")(loc)
+            conf = Convolution2D(A * C, 3, border_mode="same",
+                                 name=f"ssd_conf{i}")(f)
+            conf = Reshape((fs * fs * A, C), name=f"ssd_conf{i}_r")(conf)
+            locs.append(loc)
+            confs.append(conf)
+        loc_all = merge(locs, mode="concat", concat_axis=1, name="ssd_loc")
+        conf_all = merge(confs, mode="concat", concat_axis=1, name="ssd_conf")
+        return Model(input=inp, output=[loc_all, conf_all], name="SSD")
+
+    # -- host-side target assembly -------------------------------------------
+    def encode_targets(self, gt_boxes_list: Sequence[np.ndarray],
+                       gt_labels_list: Sequence[np.ndarray]) -> np.ndarray:
+        """Per-image gt -> dense (B, P, 5) [cls, loc4] targets."""
+        out = []
+        for boxes, labels in zip(gt_boxes_list, gt_labels_list):
+            cls_t, loc_t = match_priors(self.priors, np.asarray(boxes),
+                                        np.asarray(labels))
+            out.append(np.concatenate([cls_t[:, None].astype(np.float32),
+                                       loc_t], axis=1))
+        return np.stack(out)
+
+    # -- inference ------------------------------------------------------------
+    def detect(self, images: np.ndarray, score_threshold: float = 0.3,
+               iou_threshold: float = 0.45, top_k: int = 100,
+               batch_size: int = 32) -> List[List[Tuple[int, float, np.ndarray]]]:
+        """Returns per-image [(class, score, box(4,))...] after decode + NMS."""
+        loc, conf = self.model.predict(images, batch_size=batch_size)
+        probs = jax.nn.softmax(jnp.asarray(conf), axis=-1)
+        probs = np.asarray(probs)
+        results = []
+        for b in range(images.shape[0]):
+            dets = []
+            boxes = decode_boxes(self.priors, loc[b])
+            for c in range(1, self.class_num):     # skip background
+                sc = probs[b, :, c]
+                mask = sc > score_threshold
+                if not mask.any():
+                    continue
+                keep = nms(boxes[mask], sc[mask], iou_threshold, top_k)
+                for i in keep:
+                    idx = np.where(mask)[0][i]
+                    dets.append((c, float(sc[idx]), boxes[idx]))
+            results.append(dets)
+        return results
+
+
+def multibox_loss(y_pred, y_true, *, class_num: int, neg_pos_ratio: float = 3.0,
+                  loc_weight: float = 1.0):
+    """MultiBoxLoss (smooth-L1 + CE with hard negative mining) as a per-sample loss
+    usable by the Estimator.  y_pred = [loc (B,P,4), conf (B,P,C)];
+    y_true = (B, P, 5) [cls, loc4]."""
+    loc_pred, conf_pred = y_pred
+    cls_t = y_true[..., 0].astype(jnp.int32)          # (B, P)
+    loc_t = y_true[..., 1:]
+    pos = (cls_t > 0).astype(jnp.float32)
+    n_pos = jnp.maximum(pos.sum(axis=1), 1.0)
+
+    # smooth L1 on positives
+    diff = jnp.abs(loc_pred - loc_t)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+    loc_loss = (sl1 * pos).sum(axis=1) / n_pos
+
+    # CE with hard negative mining
+    logp = jax.nn.log_softmax(conf_pred, axis=-1)
+    ce = -jnp.take_along_axis(logp, cls_t[..., None], axis=-1)[..., 0]  # (B,P)
+    neg_ce = jnp.where(pos > 0, -jnp.inf, ce)
+    n_neg = jnp.minimum(neg_pos_ratio * n_pos,
+                        (1 - pos).sum(axis=1)).astype(jnp.int32)
+    # rank negatives: a negative is kept if its ce is within the top n_neg
+    order = jnp.argsort(-neg_ce, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    neg_keep = (ranks < n_neg[:, None]).astype(jnp.float32) * (1 - pos)
+    conf_loss = ((ce * pos).sum(axis=1)
+                 + (ce * neg_keep).sum(axis=1)) / n_pos
+    return loc_weight * loc_loss + conf_loss
+
+
+# ---------------------------------------------------------------------------
+# mAP evaluation (EvalUtil / PascalVocEvaluator parity)
+# ---------------------------------------------------------------------------
+
+def average_precision(detections, ground_truths, class_id: int,
+                      iou_threshold: float = 0.5) -> float:
+    """detections: per-image [(cls, score, box)]; ground_truths: per-image
+    (boxes (G,4), labels (G,)).  VOC-style AP (all-point interpolation)."""
+    scores, matches = [], []
+    total_gt = 0
+    for dets, (gt_boxes, gt_labels) in zip(detections, ground_truths):
+        gt_mask = np.asarray(gt_labels) == class_id
+        gt = np.asarray(gt_boxes)[gt_mask]
+        total_gt += gt.shape[0]
+        used = np.zeros(gt.shape[0], bool)
+        for (c, s, box) in sorted([d for d in dets if d[0] == class_id],
+                                  key=lambda d: -d[1]):
+            scores.append(s)
+            if gt.shape[0] == 0:
+                matches.append(0)
+                continue
+            ious = iou_matrix(box[None], gt)[0]
+            j = ious.argmax()
+            if ious[j] >= iou_threshold and not used[j]:
+                used[j] = True
+                matches.append(1)
+            else:
+                matches.append(0)
+    if total_gt == 0 or not scores:
+        return 0.0
+    order = np.argsort(-np.asarray(scores))
+    tp = np.asarray(matches)[order]
+    fp = 1 - tp
+    tp_cum = np.cumsum(tp)
+    fp_cum = np.cumsum(fp)
+    recall = tp_cum / total_gt
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-9)
+    # all-point interpolation
+    ap = 0.0
+    for r in np.linspace(0, 1, 101):
+        mask = recall >= r
+        ap += precision[mask].max() if mask.any() else 0.0
+    return float(ap / 101)
+
+
+def mean_average_precision(detections, ground_truths, num_classes: int,
+                           iou_threshold: float = 0.5) -> float:
+    aps = [average_precision(detections, ground_truths, c, iou_threshold)
+           for c in range(1, num_classes)]
+    return float(np.mean(aps)) if aps else 0.0

@@ -1,0 +1,124 @@
+"""Flash attention — blockwise online-softmax Pallas TPU kernel.
+
+The long-context upgrade over the reference's materialised (T, T) attention
+(TransformerLayer.scala:56-279): O(block) VMEM instead of O(T^2) HBM, fused
+softmax-matmul on the MXU.  Forward is a Pallas kernel (grid over batch*heads x
+q-blocks, inner fori_loop over k-blocks carrying running max/sum statistics); backward
+uses a custom_vjp that recomputes attention blockwise through the XLA path (correct,
+O(T^2) flops like every flash backward, no stored probability matrix).
+
+Composes with parallel/ring_attention.py: ring handles the cross-chip sequence axis,
+this kernel handles the on-chip block loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                scale: float, seq_len: int, block_q: int):
+    # q_ref: (block_q, d); k_ref/v_ref: (T, d); o_ref: (block_q, d)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    d = q.shape[-1]
+    n_kb = seq_len // block_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+
+    if causal:
+        # skip fully-masked k-blocks beyond the diagonal
+        last = qi * block_q + block_q - 1
+        n_valid = last // block_k + 1
+        o, m, l = jax.lax.fori_loop(0, n_valid, body, (o0, m0, l0))
+    else:
+        o, m, l = jax.lax.fori_loop(0, n_kb, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
+               block_k: int, interpret: bool):
+    B, H, T, D = q.shape
+    q3 = q.reshape(B * H, T, D)
+    k3 = k.reshape(B * H, T, D)
+    v3 = v.reshape(B * H, T, D)
+    grid = (B * H, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                          scale=scale, seq_len=T, block_q=block_q),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """q/k/v: (B, H, T, D).  T must be a multiple of the block sizes (the attention
+    layers pad/bucket to this).  Returns softmax(qk^T * scale) v."""
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    interp = (jax.default_backend() != "tpu") if interpret is None else interpret
+    bq = min(block_q, q.shape[2])
+    bk = min(block_k, k.shape[2])
+    return _flash_fwd(q, k, v, causal, s, bq, bk, interp)
+
+
+def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    """Backward by recomputation through the XLA attention graph (no stored P)."""
+    from analytics_zoo_tpu.ops.attention import _attention_xla
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+
+    def f(q_, k_, v_):
+        return _attention_xla(q_, k_, v_, causal=causal, scale=s)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)
