@@ -1,0 +1,130 @@
+// Native sample store + multi-threaded minibatch gather.
+//
+// Reference parity: the native pieces of the reference's data layer — the
+// PersistentMemoryAllocator JNI arena (pmem/NativeArray.scala:57-100,
+// SparkPersistentMemoryAlocator.scala:38-60) and the multi-threaded
+// Sample->MiniBatch assembly (MTSampleToMiniBatch.scala:28-139).  TPU-native
+// equivalent: a host-RAM or mmap-file-backed arena holding fixed-stride samples,
+// with a pthread-parallel shuffled gather that assembles contiguous minibatch
+// buffers ready for device infeed.  Exposed to Python via a plain C ABI (ctypes).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libsamplestore.so sample_store.cpp -lpthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct SampleStore {
+  int64_t n_samples = 0;
+  int64_t sample_bytes = 0;
+  uint8_t* data = nullptr;     // arena base
+  bool is_mmap = false;
+  int fd = -1;
+  int64_t arena_bytes = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a store.  path == nullptr -> anonymous RAM arena (DRAM tier);
+// otherwise an mmap'd file arena (DISK_AND_DRAM tier; the OS page cache is the
+// slice loop).
+void* ss_create(const char* path, int64_t n_samples, int64_t sample_bytes) {
+  auto* s = new SampleStore();
+  s->n_samples = n_samples;
+  s->sample_bytes = sample_bytes;
+  s->arena_bytes = n_samples * sample_bytes;
+  if (path == nullptr || path[0] == '\0') {
+    s->data = static_cast<uint8_t*>(
+        mmap(nullptr, s->arena_bytes, PROT_READ | PROT_WRITE,
+             MAP_PRIVATE | MAP_ANONYMOUS, -1, 0));
+    if (s->data == MAP_FAILED) { delete s; return nullptr; }
+    s->is_mmap = false;
+  } else {
+    s->fd = open(path, O_RDWR | O_CREAT, 0644);
+    if (s->fd < 0) { delete s; return nullptr; }
+    if (ftruncate(s->fd, s->arena_bytes) != 0) {
+      close(s->fd); delete s; return nullptr;
+    }
+    s->data = static_cast<uint8_t*>(
+        mmap(nullptr, s->arena_bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+             s->fd, 0));
+    if (s->data == MAP_FAILED) { close(s->fd); delete s; return nullptr; }
+    s->is_mmap = true;
+  }
+  return s;
+}
+
+int ss_write(void* handle, int64_t index, const void* src, int64_t bytes) {
+  auto* s = static_cast<SampleStore*>(handle);
+  if (index < 0 || index >= s->n_samples || bytes > s->sample_bytes) return -1;
+  std::memcpy(s->data + index * s->sample_bytes, src, bytes);
+  return 0;
+}
+
+// Bulk load: copy n contiguous samples starting at index `start`.
+int ss_write_bulk(void* handle, int64_t start, const void* src, int64_t n) {
+  auto* s = static_cast<SampleStore*>(handle);
+  if (start < 0 || start + n > s->n_samples) return -1;
+  std::memcpy(s->data + start * s->sample_bytes, src, n * s->sample_bytes);
+  return 0;
+}
+
+// Parallel gather: out[i] = store[indices[i]] for i in [0, n), using n_threads.
+int ss_gather(void* handle, const int64_t* indices, int64_t n, void* out,
+              int n_threads) {
+  auto* s = static_cast<SampleStore*>(handle);
+  const int64_t stride = s->sample_bytes;
+  auto* dst = static_cast<uint8_t*>(out);
+  if (n_threads <= 1) {
+    for (int64_t i = 0; i < n; ++i) {
+      if (indices[i] < 0 || indices[i] >= s->n_samples) return -1;
+      std::memcpy(dst + i * stride, s->data + indices[i] * stride, stride);
+    }
+    return 0;
+  }
+  std::atomic<int> bad{0};
+  std::vector<std::thread> threads;
+  const int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([&, lo, hi]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        if (indices[i] < 0 || indices[i] >= s->n_samples) { bad = 1; return; }
+        std::memcpy(dst + i * stride, s->data + indices[i] * stride, stride);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  return bad.load() ? -1 : 0;
+}
+
+int64_t ss_size(void* handle) {
+  return static_cast<SampleStore*>(handle)->n_samples;
+}
+
+int64_t ss_sample_bytes(void* handle) {
+  return static_cast<SampleStore*>(handle)->sample_bytes;
+}
+
+void ss_destroy(void* handle) {
+  auto* s = static_cast<SampleStore*>(handle);
+  if (s->data && s->data != MAP_FAILED) munmap(s->data, s->arena_bytes);
+  if (s->fd >= 0) close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,47 @@
+"""Native C++ sample store (csrc/sample_store.cpp) via ctypes."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.dataset import NativeFeatureSet
+from analytics_zoo_tpu.utils.native import NativeSampleStore
+
+
+def test_store_write_gather_roundtrip():
+    st = NativeSampleStore(100, (8, 4), np.float32)
+    data = np.arange(100 * 32, dtype=np.float32).reshape(100, 8, 4)
+    st.write_bulk(0, data)
+    got = st.gather(np.asarray([0, 99, 50, 50]))
+    np.testing.assert_array_equal(got, data[[0, 99, 50, 50]])
+    st.close()
+
+
+def test_store_mmap_tier(tmp_path):
+    p = str(tmp_path / "arena.bin")
+    st = NativeSampleStore(64, (16,), np.float32, path=p)
+    st.write_bulk(0, np.full((64, 16), 7.0, np.float32))
+    assert st.gather(np.asarray([63]))[0].sum() == 7.0 * 16
+    st.close()
+    import os
+    assert os.path.getsize(p) == 64 * 16 * 4
+
+
+def test_store_bad_index_raises():
+    st = NativeSampleStore(10, (4,), np.float32)
+    st.write_bulk(0, np.zeros((10, 4), np.float32))
+    with pytest.raises(IndexError):
+        st.gather(np.asarray([11]))
+    st.close()
+
+
+def test_native_featureset_batches(ctx):
+    g = np.random.default_rng(0)
+    x = g.normal(size=(130, 6)).astype(np.float32)
+    y = g.normal(size=(130, 1)).astype(np.float32)
+    fs = NativeFeatureSet(x, y)
+    batches = list(fs.batches(64, shuffle=True, rng=np.random.default_rng(1)))
+    assert len(batches) == 3
+    assert batches[-1][2].sum() == 130 - 128  # padding weights zero
+    total = sum(int(b[2].sum()) for b in batches)
+    assert total == 130
+    fs.close()
