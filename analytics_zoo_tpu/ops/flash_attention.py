@@ -57,13 +57,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
 
-    if causal:
-        # skip fully-masked k-blocks beyond the diagonal
-        last = qi * block_q + block_q - 1
-        n_valid = last // block_k + 1
-        o, m, l = jax.lax.fori_loop(0, n_valid, body, (o0, m0, l0))
-    else:
-        o, m, l = jax.lax.fori_loop(0, n_kb, body, (o0, m0, l0))
+    # static trip count: a program-id-dependent bound stalls the Mosaic compiler on
+    # this target; fully-masked causal blocks contribute exactly zero (j ascends, so
+    # the running max is already above NEG_INF when masked blocks arrive)
+    o, m, l = jax.lax.fori_loop(0, n_kb, body, (o0, m0, l0))
     o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 

@@ -2,10 +2,14 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-The reference publishes no absolute numbers (BASELINE.md); the north-star target is
-samples/sec/chip on NCF.  vs_baseline is computed against a fixed reference point of
-1e6 samples/s/chip (a strong CPU-cluster-era bound for this model size) so the number is
-comparable across rounds.
+Methodology notes (axon relay environment): per-dispatch overhead is ~seconds and
+`block_until_ready` does not synchronise through the relay, so the training loop runs
+DEVICE-SIDE — `lax.scan` over pre-staged batches inside one jitted call — and timing
+syncs on a scalar readback.  That is also the TPU-idiomatic shape for a hot training
+loop (no host round-trips between steps).  Fresh random inputs defeat relay caching.
+
+The reference publishes no absolute numbers (BASELINE.md); vs_baseline is against a
+fixed 1e6 samples/s/chip reference point so the number is comparable across rounds.
 """
 
 from __future__ import annotations
@@ -20,11 +24,13 @@ BASELINE_SAMPLES_PER_SEC = 1_000_000.0
 
 def main():
     import jax
+    import jax.numpy as jnp
+    import optax
 
     from analytics_zoo_tpu.common import dtypes
     from analytics_zoo_tpu.common.context import init_context
-    from analytics_zoo_tpu.estimator.estimator import Estimator
     from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.nn import objectives
     from analytics_zoo_tpu.nn.optimizers import Adam
 
     dtypes.mixed_bf16()
@@ -35,37 +41,55 @@ def main():
     ncf = NeuralCF(user_count=6040, item_count=3706, class_num=2,
                    user_embed=64, item_embed=64, hidden_layers=(128, 64, 32),
                    mf_embed=64)
-    est = Estimator(ncf.model, optimizer=Adam(lr=0.001),
-                    loss="sparse_categorical_crossentropy", ctx=ctx)
+    model = ncf.model
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt = Adam(lr=0.001)
+    opt_state = opt.init(params)
+    loss_fn = objectives.get("sparse_categorical_crossentropy")
 
     batch = 8192 * n_dev
-    rng = np.random.default_rng(0)
-    users = rng.integers(1, 6041, (batch, 1)).astype(np.float32)
-    items = rng.integers(1, 3707, (batch, 1)).astype(np.float32)
-    labels = rng.integers(0, 2, (batch, 1)).astype(np.float32)
+    steps = 50
 
-    est._ensure_init([users, items])
-    step = est._build_train_step()
-    sx, sy, sw = est._shard([users, items], labels,
-                            np.ones((batch,), np.float32))
-    key = jax.random.PRNGKey(0)
+    def one_step(carry, batch_data):
+        params, opt_state, state = carry
+        users, items, labels = batch_data
 
-    params, opt_state, state = est.params, est.opt_state, est.state
-    # warmup / compile
-    for _ in range(3):
-        params, opt_state, state, loss = step(params, opt_state, state,
-                                              sx, sy, sw, key)
-    jax.block_until_ready(loss)
+        def loss_of(p):
+            y_pred, new_state = model.apply(p, state, [users, items],
+                                            training=True, rng=None)
+            per = loss_fn(y_pred, labels)
+            return per.mean(), new_state
 
-    iters = 50
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, state, loss = step(params, opt_state, state,
-                                              sx, sy, sw, key)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        (l, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, new_state), l
 
-    samples_per_sec = batch * iters / dt
+    @jax.jit
+    def train_loop(params, opt_state, state, users, items, labels):
+        (params, opt_state, state), losses = jax.lax.scan(
+            one_step, (params, opt_state, state), (users, items, labels))
+        return jnp.sum(losses)  # scalar readback = sync point
+
+    def fresh_data(seed):
+        g = np.random.default_rng(seed)
+        users = g.integers(1, 6041, (steps, batch, 1)).astype(np.float32)
+        items = g.integers(1, 3707, (steps, batch, 1)).astype(np.float32)
+        labels = g.integers(0, 2, (steps, batch, 1)).astype(np.float32)
+        return users, items, labels
+
+    # compile + warmup
+    float(train_loop(params, opt_state, state, *fresh_data(0)))
+
+    totals = []
+    for trial in range(3):
+        data = fresh_data(trial + 1)
+        t0 = time.perf_counter()
+        float(train_loop(params, opt_state, state, *data))
+        totals.append(time.perf_counter() - t0)
+    dt = min(totals)
+
+    samples_per_sec = batch * steps / dt
     per_chip = samples_per_sec / n_dev
     print(json.dumps({
         "metric": "ncf_train_samples_per_sec_per_chip",
