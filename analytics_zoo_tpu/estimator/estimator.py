@@ -147,6 +147,17 @@ class Estimator:
                     jnp.asarray(v), self.ctx.data_sharding(np.ndim(v))), a))
         return out
 
+    def _shard_grouped(self, *arrays):
+        """Grouped (k, B, ...) batches: shard the BATCH axis (dim 1), replicate the
+        scan axis."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(v):
+            spec = P(None, "data", *([None] * (np.ndim(v) - 2)))
+            return jax.device_put(jnp.asarray(v),
+                                  NamedSharding(self.ctx.mesh, spec))
+        return [None if a is None else jax.tree.map(put, a) for a in arrays]
+
     # -- checkpoint save/restore ----------------------------------------------
     def _ckpt_tree(self):
         return {"params": self.params, "opt_state": self.opt_state,
@@ -187,6 +198,36 @@ class Estimator:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_scanned_train_step(self):
+        """k steps fused into one XLA program via lax.scan over stacked batches —
+        removes host-device round trips between steps (the infeed-style hot loop;
+        see bench.py methodology).  Batch leaves are (k, B, ...)."""
+        model, loss_fn, opt = self.model, self.loss, self.optimizer
+
+        def one(carry, batch):
+            params, opt_state, state = carry
+            x, y, w, rng = batch
+
+            def loss_of(p):
+                y_pred, new_state = model.apply(p, state, x, training=True,
+                                                rng=rng)
+                per = loss_fn(y_pred, y)
+                per = per.reshape(per.shape[0], -1).mean(axis=-1)
+                l = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-8)
+                return l, new_state
+            (l, new_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_state), l
+
+        def multi(params, opt_state, state, xs, ys, ws, rngs):
+            (params, opt_state, state), losses = jax.lax.scan(
+                one, (params, opt_state, state), (xs, ys, ws, rngs))
+            return params, opt_state, state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
     def _build_eval_step(self):
         model, loss_fn, metric_objs = self.model, self.loss, self.metrics
 
@@ -217,8 +258,11 @@ class Estimator:
     # -- public API -----------------------------------------------------------
     def fit(self, x, y=None, *, batch_size=32, epochs=1, validation_data=None,
             shuffle=True, verbose=True, log_every: Optional[int] = None,
-            end_trigger: Optional[ZooTrigger] = None, resume: bool = False
-            ) -> History:
+            end_trigger: Optional[ZooTrigger] = None, resume: bool = False,
+            steps_per_call: int = 1) -> History:
+        """steps_per_call > 1 fuses that many optimizer steps into one compiled
+        lax.scan program (fewer host round trips; triggers/listeners then fire at
+        call granularity)."""
         if self.optimizer is None or self.loss is None:
             raise RuntimeError("Estimator needs optimizer and loss to fit")
         data = _as_feature_set(x, y)
@@ -233,7 +277,10 @@ class Estimator:
         self._ensure_init(first[0])
         if resume:
             self.maybe_restore_checkpoint()
-        if self._train_step is None:
+        if steps_per_call > 1:
+            if getattr(self, "_scan_step", None) is None:
+                self._scan_step = self._build_scanned_train_step()
+        elif self._train_step is None:
             self._train_step = self._build_train_step()
 
         tstate = TrainState(epoch=self.epoch, iteration=self.global_step)
@@ -243,17 +290,38 @@ class Estimator:
             t0 = time.time()
             losses, seen = [], 0
             try:
-                for bx, by, bw in data.batches(batch_size, shuffle=shuffle,
-                                               rng=np_rng, pad_final=True):
-                    sx, sy, sw = self._shard(bx, by, bw)
-                    rng = jax.random.fold_in(
-                        jax.random.PRNGKey(self.ctx.conf.seed), self.global_step)
-                    (self.params, self.opt_state, self.state,
-                     l) = self._train_step(self.params, self.opt_state,
-                                           self.state, sx, sy, sw, rng)
-                    self.global_step += 1
-                    losses.append(l)
-                    seen += int(bw.sum())
+                batch_iter = data.batches(batch_size, shuffle=shuffle,
+                                          rng=np_rng, pad_final=True)
+                if steps_per_call > 1:
+                    batch_iter = self._grouped(batch_iter, steps_per_call)
+                for item in batch_iter:
+                    if steps_per_call > 1:
+                        bxs, bys, bws = item
+                        sx, sy, sw = self._shard_grouped(bxs, bys, bws)
+                        rngs = jnp.stack([
+                            jax.random.fold_in(
+                                jax.random.PRNGKey(self.ctx.conf.seed),
+                                self.global_step + i)
+                            for i in range(bws.shape[0])])
+                        (self.params, self.opt_state, self.state,
+                         ls) = self._scan_step(self.params, self.opt_state,
+                                               self.state, sx, sy, sw, rngs)
+                        self.global_step += int(bws.shape[0])
+                        l = ls[-1]
+                        losses.extend(list(ls))
+                        seen += int(bws.sum())
+                    else:
+                        bx, by, bw = item
+                        sx, sy, sw = self._shard(bx, by, bw)
+                        rng = jax.random.fold_in(
+                            jax.random.PRNGKey(self.ctx.conf.seed),
+                            self.global_step)
+                        (self.params, self.opt_state, self.state,
+                         l) = self._train_step(self.params, self.opt_state,
+                                               self.state, sx, sy, sw, rng)
+                        self.global_step += 1
+                        losses.append(l)
+                        seen += int(bw.sum())
                     tstate.iteration = self.global_step
                     tstate.epoch_finished = False
                     if self.global_step % log_every == 0:
@@ -324,6 +392,26 @@ class Estimator:
         if self._tb_val_writer is not None:
             self._tb_val_writer.flush()
         return hist
+
+    @staticmethod
+    def _grouped(batch_iter, k: int):
+        """Stack k consecutive (x, y, w) batches into (k, B, ...) leaves; a final
+        short group is emitted at its natural size (its own compilation)."""
+        buf = []
+        for item in batch_iter:
+            buf.append(item)
+            if len(buf) == k:
+                yield Estimator._stack_group(buf)
+                buf = []
+        if buf:
+            yield Estimator._stack_group(buf)
+
+    @staticmethod
+    def _stack_group(buf):
+        xs = jax.tree.map(lambda *a: np.stack(a), *[b[0] for b in buf])
+        ys = jax.tree.map(lambda *a: np.stack(a), *[b[1] for b in buf])
+        ws = np.stack([b[2] for b in buf])
+        return xs, ys, ws
 
     @staticmethod
     def _val_tuple(validation_data):

@@ -144,3 +144,16 @@ def test_failure_retry_restores_and_continues(ctx, tmp_path):
     hist = est.fit(x, y, batch_size=64, epochs=3, verbose=False)
     assert boom["fired"]
     assert len(hist.history["loss"]) == 3  # all epochs completed despite failure
+
+
+def test_steps_per_call_scanned_training(ctx):
+    """Fused multi-step scan must train equivalently to per-step calls."""
+    x, y = _data(n=512, seed=3)
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    est1 = Estimator(_model(), optimizer=Adam(lr=0.02),
+                     loss="binary_crossentropy", metrics=["accuracy"])
+    est1.fit(x, y, batch_size=64, epochs=5, verbose=False, shuffle=False,
+             steps_per_call=4)
+    assert est1.global_step == 5 * 8
+    res = est1.evaluate(x, y, batch_size=64)
+    assert res["accuracy"] > 0.9
