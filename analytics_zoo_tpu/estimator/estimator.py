@@ -119,7 +119,11 @@ class Estimator:
                  if isinstance(sample_x, (list, tuple))
                  else sample_x.shape[1:])
         rng = self.ctx.next_rng()
-        params, state = self.model.init(rng, shape)
+        if getattr(self.model, "_params", None) is not None:
+            # respect preloaded weights (imported / load_weights'd models)
+            params, state = self.model._params, self.model._state
+        else:
+            params, state = self.model.init(rng, shape)
         repl = self.ctx.replicated_sharding()
         if self.param_plan is not None:
             # tensor-parallel layout: place params per the ShardingPlan; GSPMD

@@ -1,0 +1,264 @@
+"""TFPark API surface — train/serve TF-defined models on the zoo TPU engine.
+
+Reference parity: pyzoo/zoo/tfpark — `TFDataset` (tf_dataset.py:115-1178), `TFOptimizer`
+(tf_optimizer.py:342-709), `KerasModel` (model.py:34-375), `TFEstimator`
+(estimator.py:30-330), `TFPredictor` (tf_predictor.py:30), `GANEstimator`
+(gan/gan_estimator.py:28).
+
+Architecture difference (SURVEY.md §7): the reference runs TF graphs inside executor JVMs
+and all-reduces their gradients through BigDL; here a tf.keras model is *imported* into
+native layers (interop/keras_import.py) and trained as pure JAX/XLA — same API shape,
+no TF in the hot loop.  GANEstimator implements the alternating two-optimizer loop
+natively (GanOptimMethod.scala:26 analog).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.estimator.estimator import Estimator, History
+from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet, FeatureSet
+
+
+class TFDataset:
+    """Dataset facade with the TFDataset constructor family (thin over FeatureSet)."""
+
+    def __init__(self, feature_set: FeatureSet, batch_size: int = 32):
+        self.feature_set = feature_set
+        self.batch_size = batch_size
+
+    @staticmethod
+    def from_ndarrays(tensors, batch_size: int = 32, labels=None) -> "TFDataset":
+        if isinstance(tensors, tuple) and labels is None and len(tensors) == 2:
+            x, y = tensors
+        else:
+            x, y = tensors, labels
+        return TFDataset(ArrayFeatureSet(x, y), batch_size)
+
+    @staticmethod
+    def from_feature_set(fs: FeatureSet, batch_size: int = 32) -> "TFDataset":
+        return TFDataset(fs, batch_size)
+
+    @staticmethod
+    def from_dataframe(df, feature_cols, label_col=None,
+                       batch_size: int = 32) -> "TFDataset":
+        xs = [np.stack([np.asarray(v, np.float32) for v in df[c]])
+              if not np.isscalar(df[c].iloc[0])
+              else df[c].to_numpy(np.float32)[:, None]
+              for c in feature_cols]
+        y = (df[label_col].to_numpy(np.float32)[:, None]
+             if label_col else None)
+        return TFDataset(ArrayFeatureSet(xs if len(xs) > 1 else xs[0], y),
+                         batch_size)
+
+    @staticmethod
+    def from_tf_data(tf_dataset, batch_size: int = 32,
+                     size: Optional[int] = None) -> "TFDataset":
+        """Materialise a (finite) tf.data.Dataset (TFDataFeatureSet analog)."""
+        xs, ys = [], []
+        for item in tf_dataset.as_numpy_iterator():
+            if isinstance(item, tuple):
+                x, y = item
+                xs.append(np.asarray(x))
+                ys.append(np.asarray(y))
+            else:
+                xs.append(np.asarray(item))
+        x = np.stack(xs) if xs[0].ndim == np.ndim(xs[0]) else np.concatenate(xs)
+        y = np.stack(ys) if ys else None
+        return TFDataset(ArrayFeatureSet(x, y), batch_size)
+
+
+class KerasModel:
+    """tf.keras model -> native TPU training (model.py:34-375 parity)."""
+
+    def __init__(self, tf_keras_model, loss=None, optimizer=None,
+                 metrics=None):
+        from analytics_zoo_tpu.interop.keras_import import from_tf_keras
+        self.native = from_tf_keras(tf_keras_model)
+        loss = loss or getattr(tf_keras_model, "loss", None) or "mse"
+        if not isinstance(loss, str):
+            loss = getattr(loss, "name", None) or "mse"
+        loss = {"binary_crossentropy": "binary_crossentropy",
+                "categorical_crossentropy": "categorical_crossentropy",
+                "sparse_categorical_crossentropy":
+                    "sparse_categorical_crossentropy",
+                "mean_squared_error": "mse", "mse": "mse",
+                "mae": "mae"}.get(loss, loss)
+        self.native.compile(optimizer or "adam", loss, metrics or [])
+        # keep imported weights (compile does not clobber them)
+
+    def fit(self, x=None, y=None, batch_size=32, epochs=1,
+            validation_data=None, distributed=True) -> History:
+        if isinstance(x, TFDataset):
+            fs, batch_size = x.feature_set, x.batch_size
+            return self.native.fit(fs, batch_size=batch_size, nb_epoch=epochs,
+                                   validation_data=validation_data,
+                                   verbose=False)
+        return self.native.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+                               validation_data=validation_data, verbose=False)
+
+    def evaluate(self, x, y=None, batch_size=32):
+        if isinstance(x, TFDataset):
+            return self.native.evaluate(x.feature_set, batch_size=x.batch_size)
+        return self.native.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size=128, distributed=True):
+        if isinstance(x, TFDataset):
+            x = x.feature_set
+        return self.native.predict(x, batch_size=batch_size)
+
+    def get_weights(self):
+        return self.native.get_weights()
+
+    def save_weights(self, path):
+        self.native.save_weights(path)
+
+
+class TFOptimizer:
+    """Training-loop facade (tf_optimizer.py:342-709 surface)."""
+
+    def __init__(self, keras_model: KerasModel, dataset: TFDataset):
+        self.model = keras_model
+        self.dataset = dataset
+
+    @staticmethod
+    def from_keras(tf_keras_model, dataset: TFDataset, optimizer=None,
+                   loss=None) -> "TFOptimizer":
+        return TFOptimizer(KerasModel(tf_keras_model, loss=loss,
+                                      optimizer=optimizer), dataset)
+
+    def optimize(self, end_trigger=None, epochs: int = 1) -> History:
+        from analytics_zoo_tpu.common.triggers import MaxEpoch
+        if isinstance(end_trigger, MaxEpoch):
+            epochs = end_trigger.max_epoch
+        return self.model.fit(self.dataset, epochs=epochs)
+
+
+class TFPredictor:
+    def __init__(self, keras_model: KerasModel):
+        self.model = keras_model
+
+    def predict(self, x, batch_size: int = 128):
+        return self.model.predict(x, batch_size=batch_size)
+
+
+class TFEstimator:
+    """model_fn-style estimator (estimator.py:30-330 surface): model_fn(features,
+    labels, mode) -> native layer + loss name."""
+
+    def __init__(self, model_builder: Callable[[], object], loss, optimizer="adam",
+                 metrics=()):
+        self.model = model_builder()
+        self.est = Estimator(self.model, optimizer=optimizer, loss=loss,
+                             metrics=metrics)
+
+    def train(self, dataset: TFDataset, steps: Optional[int] = None,
+              epochs: int = 1):
+        from analytics_zoo_tpu.common.triggers import MaxIteration
+        end = MaxIteration(steps) if steps else None
+        return self.est.fit(dataset.feature_set,
+                            batch_size=dataset.batch_size, epochs=epochs,
+                            end_trigger=end, verbose=False)
+
+    def evaluate(self, dataset: TFDataset):
+        return self.est.evaluate(dataset.feature_set,
+                                 batch_size=dataset.batch_size)
+
+    def predict(self, dataset: TFDataset):
+        return self.est.predict(dataset.feature_set,
+                                batch_size=dataset.batch_size)
+
+
+class GANEstimator:
+    """Alternating generator/discriminator training (gan_estimator.py:28,
+    GanOptimMethod.scala:26 analog) — two optax optimizers, one compiled step."""
+
+    def __init__(self, generator, discriminator, generator_loss_fn,
+                 discriminator_loss_fn, generator_optimizer,
+                 discriminator_optimizer, noise_dim: int, ctx=None):
+        from analytics_zoo_tpu.nn import optimizers as opt_lib
+        self.gen = generator
+        self.disc = discriminator
+        self.gen_loss_fn = generator_loss_fn
+        self.disc_loss_fn = discriminator_loss_fn
+        self.gen_opt = opt_lib.get(generator_optimizer)
+        self.disc_opt = opt_lib.get(discriminator_optimizer)
+        self.noise_dim = noise_dim
+        self.ctx = ctx or get_context()
+        self.gen_params = None
+        self._step = None
+
+    def _init(self, sample_batch):
+        rng = self.ctx.next_rng()
+        self.gen_params, self.gen_state = self.gen.init(rng, (self.noise_dim,))
+        self.disc_params, self.disc_state = self.disc.init(
+            jax.random.fold_in(rng, 1), sample_batch.shape[1:])
+        self.gen_opt_state = self.gen_opt.init(self.gen_params)
+        self.disc_opt_state = self.disc_opt.init(self.disc_params)
+
+    def _build_step(self):
+        gen, disc = self.gen, self.disc
+        g_loss_fn, d_loss_fn = self.gen_loss_fn, self.disc_loss_fn
+        g_opt, d_opt = self.gen_opt, self.disc_opt
+
+        def step(gp, gos, dp, dos, gstate, dstate, real, rng):
+            B = real.shape[0]
+            noise = jax.random.normal(rng, (B, self.noise_dim))
+
+            def d_loss(dp_):
+                fake, _ = gen.apply(gp, gstate, noise, training=True, rng=rng)
+                d_real, _ = disc.apply(dp_, dstate, real, training=True,
+                                       rng=rng)
+                d_fake, _ = disc.apply(dp_, dstate, fake, training=True,
+                                       rng=rng)
+                return d_loss_fn(d_real, d_fake)
+
+            dl, d_grads = jax.value_and_grad(d_loss)(dp)
+            d_up, dos = d_opt.update(d_grads, dos, dp)
+            dp = optax.apply_updates(dp, d_up)
+
+            def g_loss(gp_):
+                fake, _ = gen.apply(gp_, gstate, noise, training=True, rng=rng)
+                d_fake, _ = disc.apply(dp, dstate, fake, training=True,
+                                       rng=rng)
+                return g_loss_fn(d_fake)
+
+            gl, g_grads = jax.value_and_grad(g_loss)(gp)
+            g_up, gos = g_opt.update(g_grads, gos, gp)
+            gp = optax.apply_updates(gp, g_up)
+            return gp, gos, dp, dos, gl, dl
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def train(self, real_data: np.ndarray, batch_size: int = 64,
+              steps: int = 100, verbose: bool = False):
+        if self.gen_params is None:
+            self._init(real_data[:1])
+            self._step = self._build_step()
+        n = real_data.shape[0]
+        g = np.random.default_rng(self.ctx.conf.seed)
+        logs = []
+        for i in range(steps):
+            idx = g.integers(0, n, batch_size)
+            rng = jax.random.fold_in(jax.random.PRNGKey(self.ctx.conf.seed), i)
+            (self.gen_params, self.gen_opt_state, self.disc_params,
+             self.disc_opt_state, gl, dl) = self._step(
+                self.gen_params, self.gen_opt_state, self.disc_params,
+                self.disc_opt_state, self.gen_state, self.disc_state,
+                jnp.asarray(real_data[idx]), rng)
+            logs.append((float(gl), float(dl)))
+            if verbose and i % 20 == 0:
+                print(f"step {i}: g_loss {float(gl):.4f} d_loss {float(dl):.4f}")
+        return logs
+
+    def generate(self, n: int, seed: int = 0) -> np.ndarray:
+        noise = jax.random.normal(jax.random.PRNGKey(seed), (n, self.noise_dim))
+        out, _ = self.gen.apply(self.gen_params, self.gen_state, noise,
+                                training=False)
+        return np.asarray(out)

@@ -1,0 +1,166 @@
+"""Autograd DSL — symbolic math over SymTensors + custom losses + trainable Parameters.
+
+Reference parity: pipeline/api/autograd — `AutoGrad` math functions (math.scala:32-376),
+`Variable` operator overloads (math.scala:378-611, already on SymTensor), `CustomLoss`
+(CustomLoss.scala:51-66) and `Parameter`/`Constant` (KerasParameter.scala:1-208).
+
+JAX itself is the autograd engine, so every function is just a Lambda node; `custom_loss`
+turns a symbolic expression of (y_true, y_pred) placeholders into an ordinary loss
+callable for compile()/Estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.graph import Input, SymTensor
+from analytics_zoo_tpu.nn.layers.core import Lambda
+from analytics_zoo_tpu.nn.models import Model
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _unary(fn, name):
+    def apply(x: SymTensor, **kw):
+        return Lambda(lambda t: fn(t, **kw), name=name)(x)
+    return apply
+
+
+abs = _unary(jnp.abs, "ag_abs")  # noqa: A001 - AutoGrad.abs parity
+square = _unary(jnp.square, "ag_square")
+sqrt = _unary(jnp.sqrt, "ag_sqrt")
+log = _unary(jnp.log, "ag_log")
+exp = _unary(jnp.exp, "ag_exp")
+softsign = _unary(jax.nn.soft_sign, "ag_softsign")
+softplus = _unary(jax.nn.softplus, "ag_softplus")
+
+
+def epsilon() -> float:
+    return 1e-7
+
+
+def mean(x: SymTensor, axis: int = 0, keep_dims: bool = False) -> SymTensor:
+    """Mean over a non-batch axis (AutoGrad.mean; axis 0 = first non-batch dim)."""
+    return Lambda(lambda t: jnp.mean(t, axis=axis + 1, keepdims=keep_dims),
+                  name="ag_mean")(x)
+
+
+def sum(x: SymTensor, axis: int = 0, keep_dims: bool = False) -> SymTensor:  # noqa: A001
+    return Lambda(lambda t: jnp.sum(t, axis=axis + 1, keepdims=keep_dims),
+                  name="ag_sum")(x)
+
+
+def clip(x: SymTensor, min_v: float, max_v: float) -> SymTensor:
+    return Lambda(lambda t: jnp.clip(t, min_v, max_v), name="ag_clip")(x)
+
+
+def maximum(x: SymTensor, y) -> SymTensor:
+    if isinstance(y, SymTensor):
+        return Lambda(lambda ts: jnp.maximum(ts[0], ts[1]),
+                      name="ag_maximum")([x, y])
+    return Lambda(lambda t: jnp.maximum(t, y), name="ag_maximum")(x)
+
+
+def pow(x: SymTensor, a: float) -> SymTensor:  # noqa: A001
+    return Lambda(lambda t: t ** a, name="ag_pow")(x)
+
+
+def neg(x: SymTensor) -> SymTensor:
+    return Lambda(lambda t: -t, name="ag_neg")(x)
+
+
+def stack(xs: Sequence[SymTensor], axis: int = 1) -> SymTensor:
+    return Lambda(lambda ts: jnp.stack(ts, axis=axis), name="ag_stack")(list(xs))
+
+
+def expand_dims(x: SymTensor, axis: int) -> SymTensor:
+    return Lambda(lambda t: jnp.expand_dims(t, axis), name="ag_expand")(x)
+
+
+def l2_normalize(x: SymTensor, axis: int = -1) -> SymTensor:
+    return Lambda(
+        lambda t: t / jnp.clip(jnp.linalg.norm(t, axis=axis, keepdims=True),
+                               1e-8, None), name="ag_l2norm")(x)
+
+
+def mm(x: SymTensor, y: SymTensor, axes: Optional[Sequence[int]] = None
+       ) -> SymTensor:
+    """Batched matmul over non-batch dims (AutoGrad.mm)."""
+    def go(ts):
+        a, b = ts
+        if axes is not None:
+            return jnp.einsum("b...i,b...i->b...", a, b) if axes == [1, 1] \
+                else jnp.matmul(a, b)
+        return jnp.matmul(a, b, preferred_element_type=dtypes.param_dtype())
+    return Lambda(go, name="ag_mm")([x, y])
+
+
+def batch_dot(x: SymTensor, y: SymTensor, axes=(1, 1)) -> SymTensor:
+    return Lambda(lambda ts: jnp.sum(ts[0] * ts[1], axis=axes[0],
+                                     keepdims=True), name="ag_batchdot")([x, y])
+
+
+# -- CustomLoss ----------------------------------------------------------------
+
+def custom_loss(loss_builder: Callable[[SymTensor, SymTensor], SymTensor],
+                y_pred_shape, y_true_shape=None) -> Callable:
+    """Build a loss callable from a symbolic expression (CustomLoss.scala:51-66).
+
+    `loss_builder(y_true, y_pred) -> SymTensor` of per-sample (or scalar-per-sample)
+    losses.  Returns fn(y_pred, y_true) usable with compile()/Estimator."""
+    y_true_shape = y_true_shape or y_pred_shape
+    yt = Input(shape=y_true_shape, name="ct_ytrue")
+    yp = Input(shape=y_pred_shape, name="ct_ypred")
+    out = loss_builder(yt, yp)
+    graph = Model(input=[yt, yp], output=out, name="custom_loss")
+    params, state = graph.init(jax.random.PRNGKey(0))
+
+    def loss_fn(y_pred, y_true):
+        per = graph.call(params, [y_true, y_pred])
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+    return loss_fn
+
+
+# -- Parameter / Constant ------------------------------------------------------
+
+class Parameter(Layer):
+    """Standalone trainable tensor usable as a graph node
+    (KerasParameter.scala:1-208).  Call it on any node; the input is ignored and the
+    (broadcast) parameter value is returned."""
+
+    def __init__(self, shape, init_weight: Optional[np.ndarray] = None,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(shape)
+        self.init_weight = init_weight
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        from analytics_zoo_tpu.nn.module import initializer
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight, dtypes.param_dtype())
+        else:
+            w = initializer(self.init_name, rng, self.shape,
+                            dtypes.param_dtype())
+        return {"value": w}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.broadcast_to(params["value"],
+                                (x.shape[0],) + self.shape)
+
+
+class Constant(Layer):
+    """Non-trainable constant node (KerasConstant)."""
+
+    def __init__(self, value: np.ndarray, **kwargs):
+        super().__init__(**kwargs)
+        self.value = np.asarray(value, np.float32)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.broadcast_to(jnp.asarray(self.value),
+                                (x.shape[0],) + self.value.shape)

@@ -1,0 +1,140 @@
+"""Keras-2-style layer API — modern argument names over the same kernels.
+
+Reference parity: pipeline/api/keras2/layers/*.scala (~20 layers with Keras-2 arg
+names/aliases: `units` for output_dim, `kernel_initializer` for init, `rate` for p,
+`filters`/`kernel_size`/`strides`/`padding` for conv, and merge-op classes
+Add/Multiply/Average/Maximum/Minimum/Concatenate).
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.nn.layers import core as _core
+from analytics_zoo_tpu.nn.layers import conv as _conv
+from analytics_zoo_tpu.nn.layers import pooling as _pool
+
+
+def Dense(units, activation=None, kernel_initializer="glorot_uniform",
+          use_bias=True, **kw):
+    return _core.Dense(units, activation=activation, init=kernel_initializer,
+                       bias=use_bias, **kw)
+
+
+def Dropout(rate, **kw):
+    return _core.Dropout(rate, **kw)
+
+
+def Flatten(**kw):
+    return _core.Flatten(**kw)
+
+
+def Activation(activation, **kw):
+    return _core.Activation(activation, **kw)
+
+
+def Reshape(target_shape, **kw):
+    return _core.Reshape(target_shape, **kw)
+
+
+def Embedding(input_dim, output_dim, embeddings_initializer="uniform", **kw):
+    return _core.Embedding(input_dim, output_dim, init=embeddings_initializer,
+                           **kw)
+
+
+def BatchNormalization(momentum=0.99, epsilon=1e-3, **kw):
+    return _core.BatchNormalization(epsilon=epsilon, momentum=momentum, **kw)
+
+
+def Conv1D(filters, kernel_size, strides=1, padding="valid", activation=None,
+           kernel_initializer="glorot_uniform", use_bias=True,
+           dilation_rate=1, **kw):
+    return _conv.Convolution1D(filters, kernel_size, activation=activation,
+                               border_mode=padding, subsample=strides,
+                               dilation=dilation_rate,
+                               init=kernel_initializer, bias=use_bias, **kw)
+
+
+def Conv2D(filters, kernel_size, strides=1, padding="valid", activation=None,
+           kernel_initializer="glorot_uniform", use_bias=True,
+           dilation_rate=1, data_format="channels_last", **kw):
+    return _conv.Convolution2D(
+        filters, kernel_size, activation=activation, border_mode=padding,
+        subsample=strides, dilation=dilation_rate, init=kernel_initializer,
+        bias=use_bias,
+        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+
+
+def MaxPooling1D(pool_size=2, strides=None, padding="valid", **kw):
+    return _pool.MaxPooling1D(pool_size, strides, border_mode=padding, **kw)
+
+
+def MaxPooling2D(pool_size=2, strides=None, padding="valid",
+                 data_format="channels_last", **kw):
+    return _pool.MaxPooling2D(
+        pool_size, strides, border_mode=padding,
+        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+
+
+def AveragePooling1D(pool_size=2, strides=None, padding="valid", **kw):
+    return _pool.AveragePooling1D(pool_size, strides, border_mode=padding, **kw)
+
+
+def AveragePooling2D(pool_size=2, strides=None, padding="valid",
+                     data_format="channels_last", **kw):
+    return _pool.AveragePooling2D(
+        pool_size, strides, border_mode=padding,
+        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+
+
+def GlobalMaxPooling1D(**kw):
+    return _pool.GlobalMaxPooling1D(**kw)
+
+
+def GlobalAveragePooling2D(data_format="channels_last", **kw):
+    return _pool.GlobalAveragePooling2D(
+        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+
+
+# -- merge-op classes (keras2/layers/merge) ----------------------------------
+
+def Add(**kw):
+    return _core.Merge(mode="sum", **kw)
+
+
+def Multiply(**kw):
+    return _core.Merge(mode="mul", **kw)
+
+
+def Average(**kw):
+    return _core.Merge(mode="ave", **kw)
+
+
+def Maximum(**kw):
+    return _core.Merge(mode="max", **kw)
+
+
+def Minimum(**kw):
+    return _core.Merge(mode="min", **kw)
+
+
+def Concatenate(axis=-1, **kw):
+    return _core.Merge(mode="concat", concat_axis=axis, **kw)
+
+
+def add(inputs, **kw):
+    return Add(**kw)(list(inputs))
+
+
+def multiply(inputs, **kw):
+    return Multiply(**kw)(list(inputs))
+
+
+def average(inputs, **kw):
+    return Average(**kw)(list(inputs))
+
+
+def maximum(inputs, **kw):
+    return Maximum(**kw)(list(inputs))
+
+
+def concatenate(inputs, axis=-1, **kw):
+    return Concatenate(axis=axis, **kw)(list(inputs))

@@ -1,0 +1,166 @@
+"""Interop: tf.keras import, TFPark surface, GANEstimator, autograd, keras2."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_autograd_custom_loss(ctx):
+    import analytics_zoo_tpu.nn.autograd as A
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    def huber(y_true, y_pred):
+        d = A.abs(y_true - y_pred)
+        return A.mean(A.clip(d, 0.0, 1.0) * d - 0.5 * A.clip(d, 0.0, 1.0) ** 2,
+                      axis=0)
+
+    loss = A.custom_loss(huber, y_pred_shape=(1,))
+    g = np.random.default_rng(0)
+    x = g.normal(size=(128, 4)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32)
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(1))
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    m.compile(optimizer=Adam(lr=0.05), loss=loss)
+    hist = m.fit(x, y, batch_size=32, nb_epoch=10, verbose=False)
+    assert hist.history["loss"][-1] < 0.5 * hist.history["loss"][0]
+
+
+def test_autograd_parameter_node(ctx):
+    import jax.numpy as jnp
+    import analytics_zoo_tpu.nn.autograd as A
+    from analytics_zoo_tpu.nn import Input, Model
+    x = Input(shape=(3,))
+    p = A.Parameter((3,), init_weight=np.asarray([1.0, 2.0, 3.0]))(x)
+    out = x * p
+    model = Model(input=x, output=out)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    y = model.call(params, jnp.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(y), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_keras2_api(ctx):
+    from analytics_zoo_tpu.nn import keras2 as k2
+    from analytics_zoo_tpu.nn.models import Sequential
+    m = Sequential()
+    m.add(k2.Conv2D(4, 3, padding="same", activation="relu",
+                    input_shape=(8, 8, 3)))
+    m.add(k2.MaxPooling2D(2))
+    m.add(k2.Flatten())
+    m.add(k2.Dense(5, activation="softmax"))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    import jax.numpy as jnp
+    y = m.call(params, jnp.ones((2, 8, 8, 3)))
+    assert y.shape == (2, 5)
+
+
+def test_tf_keras_import_matches_tf(ctx):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.interop.keras_import import from_tf_keras
+    import jax.numpy as jnp
+
+    tf_model = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = np.random.default_rng(0).normal(size=(5, 6)).astype(np.float32)
+    tf_out = tf_model(x).numpy()
+    native = from_tf_keras(tf_model)
+    out = np.asarray(native.call(native.get_weights(), jnp.asarray(x)))
+    np.testing.assert_allclose(out, tf_out, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_keras_import_conv_lstm(ctx):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.interop.keras_import import from_tf_keras
+    import jax.numpy as jnp
+
+    tf_model = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 4)),
+        tf.keras.layers.LSTM(6, return_sequences=False),
+        tf.keras.layers.Dense(2),
+    ])
+    x = np.random.default_rng(1).normal(size=(3, 10, 4)).astype(np.float32)
+    tf_out = tf_model(x).numpy()
+    native = from_tf_keras(tf_model)
+    out = np.asarray(native.call(native.get_weights(), jnp.asarray(x)))
+    np.testing.assert_allclose(out, tf_out, rtol=1e-3, atol=1e-4)
+
+
+def test_tfpark_keras_model_trains(ctx):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.interop.tfpark import KerasModel, TFDataset
+
+    tf_model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(1, activation="sigmoid"),
+    ])
+    g = np.random.default_rng(0)
+    x = g.normal(size=(256, 4)).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    km = KerasModel(tf_model, loss="binary_crossentropy",
+                    optimizer=Adam(lr=0.02), metrics=["accuracy"])
+    ds = TFDataset.from_ndarrays((x, y), batch_size=64)
+    km.fit(ds, epochs=8)
+    res = km.evaluate(ds)
+    assert res["accuracy"] > 0.8
+
+
+def test_tfpark_tfoptimizer_surface(ctx):
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+    from analytics_zoo_tpu.interop.tfpark import TFDataset, TFOptimizer
+    tf_model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(1),
+    ])
+    g = np.random.default_rng(0)
+    x = g.normal(size=(64, 4)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32)
+    opt = TFOptimizer.from_keras(tf_model, TFDataset.from_ndarrays((x, y), 32),
+                                 loss="mse")
+    hist = opt.optimize(end_trigger=MaxEpoch(3))
+    assert len(hist.history["loss"]) == 3
+
+
+def test_gan_estimator_learns_1d_gaussian(ctx):
+    """GAN on a 1-D gaussian: generated samples should move toward the target
+    mean."""
+    import optax
+    from analytics_zoo_tpu.interop.tfpark import GANEstimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    import jax.numpy as jnp
+
+    gen = Sequential(name="gan_gen")
+    gen.add(Dense(16, activation="relu", input_shape=(4,), name="gg1"))
+    gen.add(Dense(1, name="gg2"))
+    disc = Sequential(name="gan_disc")
+    disc.add(Dense(16, activation="relu", input_shape=(1,), name="gd1"))
+    disc.add(Dense(1, name="gd2"))
+
+    def d_loss(d_real, d_fake):
+        return (optax.sigmoid_binary_cross_entropy(
+                    d_real, jnp.ones_like(d_real)).mean()
+                + optax.sigmoid_binary_cross_entropy(
+                    d_fake, jnp.zeros_like(d_fake)).mean())
+
+    def g_loss(d_fake):
+        return optax.sigmoid_binary_cross_entropy(
+            d_fake, jnp.ones_like(d_fake)).mean()
+
+    real = np.random.default_rng(0).normal(5.0, 0.5, (512, 1)).astype(np.float32)
+    from analytics_zoo_tpu.nn.optimizers import Adam
+    gan = GANEstimator(gen, disc, g_loss, d_loss,
+                       generator_optimizer=Adam(lr=0.01),
+                       discriminator_optimizer=Adam(lr=0.01), noise_dim=4)
+    gan.train(real, batch_size=64, steps=300)
+    samples = gan.generate(256)
+    # generator starts near 0; adversarial training must pull it toward 5
+    assert samples.mean() > 2.0
