@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.xshard.shard import XShards, read_csv, read_json

@@ -1,0 +1,106 @@
+"""XShards — sharded distributed-pandas data structure.
+
+Reference parity: pyzoo/zoo/xshard — `RayDataShards.apply/collect/repartition`
+(shard.py:20-99) and the pandas reader preprocessing (pandas/preprocessing.py:26-188:
+`read_csv`/`read_json` over Ray actors).  Without a Ray cluster the shards are plain
+pandas frames processed by a thread pool (one shard per input file / partition);
+`to_feature_set` bridges into the training data path.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet
+
+
+class XShards:
+    def __init__(self, shards: List, n_workers: int = 4):
+        self.shards = list(shards)
+        self.n_workers = n_workers
+
+    # -- functional ops (RayDataShards surface) -------------------------------
+    def apply(self, fn: Callable, *args) -> "XShards":
+        """Apply fn to every shard in parallel (shard.py `apply`)."""
+        with ThreadPoolExecutor(self.n_workers) as pool:
+            out = list(pool.map(lambda s: fn(s, *args), self.shards))
+        return XShards(out, self.n_workers)
+
+    transform_shard = apply
+
+    def collect(self):
+        """Materialise: concat DataFrames / concatenate arrays / flatten lists."""
+        first = self.shards[0]
+        if isinstance(first, pd.DataFrame):
+            return pd.concat(self.shards, ignore_index=True)
+        if isinstance(first, np.ndarray):
+            return np.concatenate(self.shards)
+        out = []
+        for s in self.shards:
+            out.extend(s if isinstance(s, list) else [s])
+        return out
+
+    def repartition(self, num_partitions: int) -> "XShards":
+        df = self.collect()
+        if isinstance(df, pd.DataFrame):
+            parts = np.array_split(df, num_partitions)
+            return XShards([p.reset_index(drop=True) for p in parts],
+                           self.n_workers)
+        return XShards(list(np.array_split(df, num_partitions)), self.n_workers)
+
+    def num_partitions(self) -> int:
+        return len(self.shards)
+
+    def __len__(self):
+        return sum(len(s) for s in self.shards)
+
+    # -- training bridge ------------------------------------------------------
+    def to_feature_set(self, feature_cols: Sequence[str],
+                       label_col: Optional[str] = None) -> ArrayFeatureSet:
+        df = self.collect()
+        xs = []
+        for c in feature_cols:
+            first = df[c].iloc[0]
+            if np.isscalar(first):
+                xs.append(df[c].to_numpy(np.float32)[:, None])
+            else:
+                xs.append(np.stack([np.asarray(v, np.float32) for v in df[c]]))
+        y = df[label_col].to_numpy(np.float32)[:, None] if label_col else None
+        return ArrayFeatureSet(xs if len(xs) > 1 else xs[0], y)
+
+    @staticmethod
+    def partition(data, num_partitions: int = 4) -> "XShards":
+        """Shard an in-memory DataFrame/array (SparkXShards.partition analog)."""
+        if isinstance(data, pd.DataFrame):
+            parts = np.array_split(data, num_partitions)
+            return XShards([p.reset_index(drop=True) for p in parts])
+        return XShards(list(np.array_split(np.asarray(data), num_partitions)))
+
+
+def _expand(path: Union[str, Sequence[str]]) -> List[str]:
+    if isinstance(path, (list, tuple)):
+        return list(path)
+    if os.path.isdir(path):
+        return sorted(glob.glob(os.path.join(path, "*")))
+    return sorted(glob.glob(path)) or [path]
+
+
+def read_csv(path, n_workers: int = 4, **kwargs) -> XShards:
+    """One shard per file (pandas/preprocessing.py read_csv parity)."""
+    files = _expand(path)
+    with ThreadPoolExecutor(n_workers) as pool:
+        shards = list(pool.map(lambda f: pd.read_csv(f, **kwargs), files))
+    return XShards(shards, n_workers)
+
+
+def read_json(path, n_workers: int = 4, **kwargs) -> XShards:
+    files = _expand(path)
+    with ThreadPoolExecutor(n_workers) as pool:
+        shards = list(pool.map(lambda f: pd.read_json(f, **kwargs), files))
+    return XShards(shards, n_workers)
