@@ -91,6 +91,15 @@ class ArrayFeatureSet(FeatureSet):
 
     def batches(self, batch_size: int, *, shuffle=False, rng=None,
                 drop_remainder=False, pad_final=True):
+        """Yield (xs, ys, weight) batches; a short final batch is padded with
+        copies of sample 0 at weight 0 so every batch has a static shape.
+
+        Limitation: the pad rows are weight-masked out of the loss but still
+        enter unweighted batch reductions — BatchNormalization training
+        statistics see them, slightly biasing stats on the last partial batch.
+        Use drop_remainder=True when exact BN statistics matter, and for
+        ranking data (rank_hinge assumes an intact [pos, neg] interleave).
+        """
         n = self._n
         idx = np.arange(n)
         if shuffle:

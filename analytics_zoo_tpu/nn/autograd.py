@@ -43,15 +43,26 @@ def epsilon() -> float:
     return 1e-7
 
 
+def _nonbatch_axis(t, axis: int) -> int:
+    """Translate a user axis over the non-batch dims to the real array axis.
+    Negative axes count from the end of the non-batch dims (axis=-1 = last
+    feature axis), never reaching the batch dim at array axis 0."""
+    real = axis + 1 if axis >= 0 else t.ndim + axis
+    if not 1 <= real < t.ndim:
+        raise ValueError(
+            f"axis {axis} out of range for {t.ndim - 1} non-batch dim(s)")
+    return real
+
+
 def mean(x: SymTensor, axis: int = 0, keep_dims: bool = False) -> SymTensor:
     """Mean over a non-batch axis (AutoGrad.mean; axis 0 = first non-batch dim)."""
-    return Lambda(lambda t: jnp.mean(t, axis=axis + 1, keepdims=keep_dims),
-                  name="ag_mean")(x)
+    return Lambda(lambda t: jnp.mean(t, axis=_nonbatch_axis(t, axis),
+                                     keepdims=keep_dims), name="ag_mean")(x)
 
 
 def sum(x: SymTensor, axis: int = 0, keep_dims: bool = False) -> SymTensor:  # noqa: A001
-    return Lambda(lambda t: jnp.sum(t, axis=axis + 1, keepdims=keep_dims),
-                  name="ag_sum")(x)
+    return Lambda(lambda t: jnp.sum(t, axis=_nonbatch_axis(t, axis),
+                                    keepdims=keep_dims), name="ag_sum")(x)
 
 
 def clip(x: SymTensor, min_v: float, max_v: float) -> SymTensor:

@@ -85,13 +85,18 @@ class MultiHeadAttention(Layer):
         def heads(t):
             return jnp.transpose(t.reshape(B, T, nh, hd), (0, 2, 1, 3))
 
+        attn_rng = resid_rng = None
+        if training and rng is not None:
+            attn_rng, resid_rng = jax.random.split(rng)
         y = dot_product_attention(heads(q), heads(k), heads(v), mask=mask,
-                                  causal=self.causal)
+                                  causal=self.causal,
+                                  dropout_rate=self.attn_drop if training else 0.0,
+                                  dropout_rng=attn_rng)
         y = jnp.transpose(y, (0, 2, 1, 3)).reshape(B, T, H)
         y = _linear(params["out"], y)
-        if training and rng is not None and self.resid_drop > 0:
+        if training and resid_rng is not None and self.resid_drop > 0:
             keep = 1.0 - self.resid_drop
-            y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
+            y = jnp.where(jax.random.bernoulli(resid_rng, keep, y.shape),
                           y / keep, 0.0)
         return y
 

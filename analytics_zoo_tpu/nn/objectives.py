@@ -98,10 +98,18 @@ def squared_hinge(y_pred, y_true):
 
 def rank_hinge(y_pred, y_true, margin=1.0):
     """Pairwise ranking hinge for (pos, neg) interleaved batches
-    (objectives/RankHinge.scala): batch is [pos0, neg0, pos1, neg1, ...]."""
+    (objectives/RankHinge.scala): batch is [pos0, neg0, pos1, neg1, ...].
+
+    Returns a per-SAMPLE (B,) array — each pair's loss is charged to both its
+    pos and its neg row — so the Estimator's weighted mean over B samples
+    equals the reference's mean over B/2 pairs.  Use `drop_remainder=True` (or
+    pair-preserving padding) when batching ranking data: an odd final batch
+    would break the [pos, neg] interleave this loss assumes.
+    """
     pos = y_pred[0::2]
     neg = y_pred[1::2]
-    return jnp.maximum(0.0, margin - pos + neg).reshape(pos.shape[0], -1).mean(-1)
+    pair = jnp.maximum(0.0, margin - pos + neg).reshape(pos.shape[0], -1).mean(-1)
+    return jnp.repeat(pair, 2, axis=0)
 
 
 def kullback_leibler_divergence(y_pred, y_true):

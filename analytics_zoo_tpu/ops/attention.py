@@ -16,6 +16,7 @@ long-context work at all.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -23,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _attention_xla(q, k, v, mask=None, causal=False, scale=None):
+def _attention_xla(q, k, v, mask=None, causal=False, scale=None,
+                   dropout_rate=0.0, dropout_rng=None):
     """q,k,v: (B, H, T, D).  mask: broadcastable to (B, H, Tq, Tk), 1=keep."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -36,22 +38,42 @@ def _attention_xla(q, k, v, mask=None, causal=False, scale=None):
     if mask is not None:
         logits = jnp.where(mask.astype(bool), logits, -1e9)
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        probs = jnp.where(
+            jax.random.bernoulli(dropout_rng, keep, probs.shape),
+            probs / keep, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(v.dtype)
 
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False,
                           scale: Optional[float] = None,
-                          use_flash: Optional[bool] = None):
+                          use_flash: Optional[bool] = None,
+                          dropout_rate: float = 0.0, dropout_rng=None):
     """Multi-head attention core; picks the Pallas flash kernel on TPU for long
-    sequences, else the XLA path."""
+    sequences, else the XLA path.  Attention-probability dropout (dropout_rate >
+    0 with an rng) always routes to the XLA path — the flash kernel does not
+    implement it."""
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu" and q.shape[-2] >= 512
-                     and mask is None and q.shape[-1] <= 256)
+                     and mask is None and q.shape[-1] <= 256
+                     and not dropping)
+        if dropping and jax.default_backend() == "tpu" and q.shape[-2] >= 512:
+            warnings.warn(
+                "attention dropout forces the O(T^2) XLA attention path; the "
+                "flash kernel does not implement it — consider attn_drop=0 "
+                "for long sequences", stacklevel=2)
+    elif use_flash and (dropping or mask is not None):
+        # The flash kernel implements neither prob-dropout nor explicit masks;
+        # honouring use_flash=True here would silently compute the wrong thing.
+        use_flash = False
     if use_flash:
         try:
             from analytics_zoo_tpu.ops.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=causal, scale=scale)
         except Exception:
             pass
-    return _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)
+    return _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale,
+                          dropout_rate=dropout_rate, dropout_rng=dropout_rng)

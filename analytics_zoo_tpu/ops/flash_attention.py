@@ -25,8 +25,10 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float, seq_len: int, block_q: int):
+                scale: float, seq_len: int, block_q: int, kv_valid: int):
     # q_ref: (block_q, d); k_ref/v_ref: (T, d); o_ref: (block_q, d)
+    # kv_valid: number of real (non-padded) key positions; keys at or beyond it
+    # are zero padding added by `flash_attention` and must not receive weight.
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     d = q.shape[-1]
@@ -38,11 +40,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if kv_valid < seq_len:
+            s = jnp.where(k_pos < kv_valid, s, NEG_INF)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -67,32 +71,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
 def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
                block_k: int, interpret: bool):
     B, H, T, D = q.shape
-    q3 = q.reshape(B * H, T, D)
-    k3 = k.reshape(B * H, T, D)
-    v3 = v.reshape(B * H, T, D)
-    grid = (B * H, T // block_q)
+    # Pad each side of the sequence axis up to its own block grid: padded query
+    # rows are sliced off the output; padded key rows are masked inside the
+    # kernel (kv_valid) — in causal mode they're already unreachable
+    # (k_pos >= T > q_pos).
+    Tq_pad = -(-T // block_q) * block_q
+    Tk_pad = -(-T // block_k) * block_k
+    if Tq_pad != T:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, Tq_pad - T), (0, 0)])
+    if Tk_pad != T:
+        cfg = [(0, 0), (0, 0), (0, Tk_pad - T), (0, 0)]
+        k, v = jnp.pad(k, cfg), jnp.pad(v, cfg)
+    q3 = q.reshape(B * H, Tq_pad, D)
+    k3 = k.reshape(B * H, Tk_pad, D)
+    v3 = v.reshape(B * H, Tk_pad, D)
+    grid = (B * H, Tq_pad // block_q)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                          scale=scale, seq_len=T, block_q=block_q),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+                          scale=scale, seq_len=Tk_pad, block_q=block_q,
+                          kv_valid=T),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_pad, D), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_pad, D), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(B, H, T, D)
+    return out.reshape(B, H, Tq_pad, D)[:, :, :T, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
-    """q/k/v: (B, H, T, D).  T must be a multiple of the block sizes (the attention
-    layers pad/bucket to this).  Returns softmax(qk^T * scale) v."""
+    """q/k/v: (B, H, T, D).  Any T: the sequence axis is padded to the block grid
+    internally (padded keys masked, padded query rows sliced off).  Returns
+    softmax(qk^T * scale) v."""
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     interp = (jax.default_backend() != "tpu") if interpret is None else interpret
     bq = min(block_q, q.shape[2])

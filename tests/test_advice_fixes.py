@@ -1,0 +1,97 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md):
+
+1. flash_attention must be correct for ANY sequence length (it now pads to the
+   block grid internally; previously non-multiple T silently truncated keys and
+   returned uninitialized tail query rows).
+2. rank_hinge must return per-sample (B,) losses so the Estimator's weighted-mean
+   `per * w` contract holds; training with loss='rank_hinge' must run.
+3. MultiHeadAttention must actually apply attention-probability dropout when
+   attn_drop > 0 (previously a silent no-op).
+4. autograd mean/sum must treat negative axes as counting from the last feature
+   axis, never silently reducing the batch dim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.nn import autograd
+from analytics_zoo_tpu.nn.layers.attention import MultiHeadAttention
+from analytics_zoo_tpu.nn.objectives import rank_hinge
+from analytics_zoo_tpu.ops.attention import _attention_xla
+from analytics_zoo_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize("T", [100, 192, 600])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_non_block_multiple_T(rng, T, causal):
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 2, T, 16)), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _attention_xla(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_grad_non_multiple_T(rng):
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 192, 8)), jnp.float32)
+               for _ in range(3))
+    gf = jax.grad(lambda q_: flash_attention(q_, k, v, causal=True).sum())(q)
+    gr = jax.grad(lambda q_: _attention_xla(q_, k, v, causal=True).sum())(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_rank_hinge_returns_per_sample_losses(rng):
+    y_pred = jnp.asarray(rng.normal(size=(8, 1)), jnp.float32)
+    per = rank_hinge(y_pred, jnp.zeros((8, 1)))
+    assert per.shape == (8,)
+    # Mean over B samples equals the reference's mean over B/2 pairs.
+    pos, neg = y_pred[0::2, 0], y_pred[1::2, 0]
+    pair = np.maximum(0.0, 1.0 - np.asarray(pos) + np.asarray(neg))
+    np.testing.assert_allclose(float(per.mean()), float(pair.mean()), rtol=1e-6)
+
+
+def test_estimator_trains_with_rank_hinge(ctx):
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.nn.optimizers import Adam
+
+    g = np.random.default_rng(0)
+    x = g.normal(size=(64, 6)).astype(np.float32)
+    y = np.zeros((64, 1), np.float32)
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(6,)))
+    model.add(Dense(1))
+    est = Estimator(model, optimizer=Adam(lr=0.01), loss="rank_hinge", ctx=ctx)
+    hist = est.fit(x, y, batch_size=16, epochs=2, verbose=False)
+    assert np.isfinite(hist.history["loss"]).all()
+
+
+def test_attention_dropout_is_applied(rng):
+    mha = MultiHeadAttention(hidden_size=16, n_head=2, attn_drop=0.9)
+    x = jnp.asarray(rng.normal(size=(2, 6, 16)), jnp.float32)
+    params = mha.init_params(jax.random.PRNGKey(0), (2, 6, 16)) \
+        if hasattr(mha, "init_params") else mha.build(jax.random.PRNGKey(0), (2, 6, 16))
+    train1 = mha.call(params, x, training=True, rng=jax.random.PRNGKey(1))
+    train2 = mha.call(params, x, training=True, rng=jax.random.PRNGKey(2))
+    infer1 = mha.call(params, x, training=False)
+    infer2 = mha.call(params, x, training=False)
+    # dropout at 0.9 must perturb training outputs; inference is deterministic
+    assert float(jnp.abs(train1 - train2).max()) > 1e-4
+    assert float(jnp.abs(train1 - infer1).max()) > 1e-4
+    np.testing.assert_array_equal(np.asarray(infer1), np.asarray(infer2))
+
+
+def test_autograd_negative_axis(rng):
+    from analytics_zoo_tpu.nn import Input, Model
+
+    x = jnp.asarray(rng.normal(size=(4, 3, 5)), jnp.float32)
+    v = Input(shape=(3, 5))
+    m = Model(input=v, output=autograd.mean(v, axis=-1))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    got = m.call(params, x, training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x.mean(axis=-1)),
+                               rtol=1e-6, atol=1e-6)
