@@ -31,6 +31,15 @@ def param_dtype():
     return _policy["param"]
 
 
+def conv_out_dtype():
+    """Output dtype for lax convolutions.  Unlike jnp.matmul (which promotes),
+    lax.conv's VJP requires the cotangent and operand dtypes to MATCH, so a
+    float32-accumulated conv over bfloat16 inputs fails in the backward pass.
+    Under a mixed policy convs therefore emit the compute dtype — the TPU MXU
+    still accumulates in float32 internally — and plain float32 otherwise."""
+    return _policy["compute"] or _policy["param"]
+
+
 def cast_compute(*arrays):
     """Cast arrays to the compute dtype (no-op when policy is unset)."""
     c = _policy["compute"]

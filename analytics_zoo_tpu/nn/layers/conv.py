@@ -95,7 +95,7 @@ class _ConvND(Layer):
         y = jax.lax.conv_general_dilated(
             xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode),
             rhs_dilation=self.dilation, dimension_numbers=self._dn(),
-            preferred_element_type=dtypes.param_dtype())
+            preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
         return self._from_tf(self.activation(y))
@@ -158,7 +158,7 @@ class Deconvolution2D(Layer):
         y = jax.lax.conv_transpose(
             xw, W, strides=self.subsample, padding=_pad_str(self.border_mode),
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
-            preferred_element_type=dtypes.param_dtype())
+            preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
         y = self.activation(y)
@@ -212,12 +212,12 @@ class SeparableConvolution2D(Layer):
         y = jax.lax.conv_general_dilated(
             xw, dw, window_strides=self.subsample,
             padding=_pad_str(self.border_mode), dimension_numbers=dn,
-            feature_group_count=cin, preferred_element_type=dtypes.param_dtype())
+            feature_group_count=cin, preferred_element_type=dtypes.conv_out_dtype())
         y = jax.lax.conv_general_dilated(
             dtypes.cast_compute(y), pw, window_strides=(1, 1), padding="VALID",
             dimension_numbers=jax.lax.conv_dimension_numbers(
                 y.shape, params["pointwise"].shape, ("NHWC", "HWIO", "NHWC")),
-            preferred_element_type=dtypes.param_dtype())
+            preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
         y = self.activation(y)

