@@ -73,13 +73,24 @@ class InferenceModel:
         return self
 
     def do_load_onnx(self, onnx_path: str):
-        try:
-            import onnx  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "onnx is not available in this environment; export to a zoo "
-                "weights file or TF SavedModel instead") from e
-        raise NotImplementedError("onnx import lands with the interop wave")
+        """ONNX model -> native predict function (reference: doLoadOpenVINO /
+        onnx_loader.py ModelLoader; here via interop/onnx_loader.py)."""
+        from analytics_zoo_tpu.interop.onnx_loader import load_onnx
+        net = load_onnx(onnx_path)
+        params = net.build(None, None)
+        return self.do_load_model(net, params, {})
+
+    def do_load_pytorch(self, model_or_path, example_input=None):
+        """PyTorch model -> native predict function (reference: doLoadPyTorch,
+        TorchNet.scala:39-242; here the TorchScript graph is imported into
+        jnp via interop/torchnet.py — no libtorch at serve time)."""
+        from analytics_zoo_tpu.interop.torchnet import TorchNet
+        if isinstance(model_or_path, str):
+            net = TorchNet(model_or_path)
+        else:
+            net = TorchNet.from_pytorch(model_or_path, example_input)
+        params = net.build(None, None)
+        return self.do_load_model(net, params, {})
 
     # -- predict --------------------------------------------------------------
     def do_predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:

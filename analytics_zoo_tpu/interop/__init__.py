@@ -1,0 +1,20 @@
+"""Foreign-model interop: TF (tfnet/tfpark/keras_import), PyTorch (torchnet),
+ONNX (onnx_loader) — the reference's three foreign-model pillars
+(pipeline/api/net/TFNet.scala, TorchNet.scala, pyzoo/zoo/pipeline/api/onnx/).
+
+Imports are lazy: each bridge pulls its host framework (tensorflow/torch) only
+when used, so the core framework never depends on them.
+"""
+
+
+def __getattr__(name):
+    if name in ("TorchNet", "TorchCriterion"):
+        from analytics_zoo_tpu.interop import torchnet
+        return getattr(torchnet, name)
+    if name in ("OnnxNet", "load_onnx"):
+        from analytics_zoo_tpu.interop import onnx_loader
+        return getattr(onnx_loader, name)
+    if name == "TFNet":
+        from analytics_zoo_tpu.interop.tfnet import TFNet
+        return TFNet
+    raise AttributeError(name)

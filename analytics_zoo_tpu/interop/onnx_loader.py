@@ -1,0 +1,399 @@
+"""ONNX graph -> native layer import (op-mapper registry).
+
+Reference parity: pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:32-128 plus the 43
+per-op mappers in pyzoo/zoo/pipeline/api/onnx/mapper/*.py.  The reference maps
+ONNX nodes onto BigDL Keras layers; here each ONNX node lowers to a jnp closure
+in a Step program (shared executor with the TorchScript importer), so an
+imported model is a first-class trainable `Layer` that jits/shards on TPU.
+Initializer tensors become the param pytree; ONNX NCHW conv/pool semantics are
+preserved exactly.
+
+Covered op set (superset of the reference's mapper directory): Abs Add
+AveragePool BatchNormalization Cast Clip Concat Constant Conv Div Dropout Elu
+Exp Flatten Gather Gemm GlobalAveragePool Greater HardSigmoid Identity
+LeakyRelu Log LogSoftmax LRN MatMul MaxPool Mul Neg Pow ReduceMean ReduceSum
+Relu Reshape Shape Sigmoid Slice Softmax Sqrt Squeeze Sub Tanh Transpose
+Unsqueeze.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.interop import onnx_pb
+from analytics_zoo_tpu.interop.torch_graph import (
+    ConvertedGraph, Step, _aten_batch_norm, _aten_elu, _aten_leaky_relu,
+    run_graph)
+from analytics_zoo_tpu.nn.module import Layer
+
+# Each mapper: fn(attrs) -> callable(*inputs) -> output(s).
+ONNX_OPS: Dict[str, Callable[[Dict[str, Any]], Callable]] = {}
+
+
+def register(op_type: str):
+    def deco(fn):
+        ONNX_OPS[op_type] = fn
+        return fn
+    return deco
+
+
+def _auto_pads(attrs, spatial_shape, kernel, strides):
+    ap = attrs.get("auto_pad", "NOTSET")
+    if ap in ("NOTSET", ""):
+        pads = attrs.get("pads")
+        nd = len(kernel)
+        if pads is None:
+            return [(0, 0)] * nd
+        return [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+    if ap == "VALID":
+        return [(0, 0)] * len(kernel)
+    # SAME_UPPER / SAME_LOWER
+    out = []
+    for s, k, st in zip(spatial_shape, kernel, strides):
+        total = max(0, (int(np.ceil(s / st)) - 1) * st + k - s)
+        lo = total // 2
+        hi = total - lo
+        out.append((hi, lo) if ap == "SAME_LOWER" else (lo, hi))
+    return out
+
+
+@register("Conv")
+def _conv(attrs):
+    def fn(x, w, b=None):
+        nd = x.ndim - 2
+        kernel = attrs.get("kernel_shape", w.shape[2:])
+        strides = tuple(attrs.get("strides", [1] * nd))
+        dil = tuple(attrs.get("dilations", [1] * nd))
+        groups = int(attrs.get("group", 1))
+        pads = _auto_pads(attrs, x.shape[2:], kernel, strides)
+        spatial = "DHW"[-nd:]
+        dn = jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=pads, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b is not None:
+            y = y + b.reshape((1, -1) + (1,) * nd)
+        return y
+    return fn
+
+
+@register("Gemm")
+def _gemm(attrs):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    ta, tb = attrs.get("transA", 0), attrs.get("transB", 0)
+
+    def fn(a, b, c=None):
+        a_ = a.T if ta else a
+        b_ = b.T if tb else b
+        y = alpha * jnp.matmul(a_, b_)
+        return y if c is None else y + beta * c
+    return fn
+
+
+def _pool(attrs, reducer, init, is_avg):
+    kernel = tuple(attrs["kernel_shape"])
+    nd = len(kernel)
+    strides = tuple(attrs.get("strides", [1] * nd))
+    count_include_pad = int(attrs.get("count_include_pad", 0))
+
+    def fn(x):
+        pads = _auto_pads(attrs, x.shape[2:], kernel, strides)
+        dims = (1, 1) + kernel
+        st = (1, 1) + strides
+        pd = ((0, 0), (0, 0)) + tuple(pads)
+        y = jax.lax.reduce_window(x, init, reducer, dims, st, pd)
+        if is_avg:
+            if count_include_pad or all(p == (0, 0) for p in pads):
+                y = y / float(np.prod(kernel))
+            else:
+                ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, st, pd)
+                y = y / cnt
+        return y
+    return fn
+
+
+@register("MaxPool")
+def _maxpool(attrs):
+    return _pool(attrs, jax.lax.max, -jnp.inf, False)
+
+
+@register("AveragePool")
+def _avgpool(attrs):
+    return _pool(attrs, jax.lax.add, 0.0, True)
+
+
+@register("GlobalAveragePool")
+def _gap(attrs):
+    return lambda x: x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@register("BatchNormalization")
+def _bn(attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    # shared numeric kernel with the TorchScript importer
+    return lambda x, scale, b, mean, var: _aten_batch_norm(
+        x, scale, b, mean, var, False, 0.0, eps)
+
+
+@register("LRN")
+def _lrn(attrs):
+    size = int(attrs["size"])
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    bias = attrs.get("bias", 1.0)
+
+    def fn(x):
+        sq = x * x
+        half = size // 2
+        acc = jnp.zeros_like(x)
+        C = x.shape[1]
+        for off in range(-half, size - half):
+            lo, hi = max(0, -off), min(C, C - off)
+            acc = acc.at[:, lo:hi].add(sq[:, lo + off:hi + off])
+        return x / jnp.power(bias + (alpha / size) * acc, beta)
+    return fn
+
+
+@register("Reshape")
+def _reshape(attrs):
+    def fn(x, shape=None):
+        if shape is None:
+            shape = attrs["shape"]
+        tgt = [int(s) for s in np.asarray(shape).tolist()]
+        tgt = [x.shape[i] if s == 0 else s for i, s in enumerate(tgt)]
+        return x.reshape(tgt)
+    return fn
+
+
+@register("Flatten")
+def _flatten(attrs):
+    ax = int(attrs.get("axis", 1))
+    return lambda x: x.reshape((int(np.prod(x.shape[:ax])) or 1, -1))
+
+
+@register("Transpose")
+def _transpose(attrs):
+    perm = attrs.get("perm")
+    return lambda x: jnp.transpose(x, perm)
+
+
+@register("Concat")
+def _concat(attrs):
+    ax = int(attrs["axis"])
+    return lambda *xs: jnp.concatenate(xs, axis=ax)
+
+
+@register("Slice")
+def _slice(attrs):
+    def fn(x, starts=None, ends=None, axes=None, steps=None):
+        starts = attrs.get("starts") if starts is None else np.asarray(starts).tolist()
+        ends = attrs.get("ends") if ends is None else np.asarray(ends).tolist()
+        axes = (attrs.get("axes") if axes is None else np.asarray(axes).tolist()) \
+            or list(range(len(starts)))
+        steps = (np.asarray(steps).tolist() if steps is not None
+                 else [1] * len(starts))
+        idx = [slice(None)] * x.ndim
+        for a, s, e, st in zip(axes, starts, ends, steps):
+            e = None if e >= 2 ** 31 - 1 else int(e)
+            idx[int(a)] = slice(int(s), e, int(st))
+        return x[tuple(idx)]
+    return fn
+
+
+@register("Gather")
+def _gather(attrs):
+    ax = int(attrs.get("axis", 0))
+    return lambda x, idx: jnp.take(x, idx.astype(jnp.int32), axis=ax)
+
+
+@register("Squeeze")
+def _squeeze(attrs):
+    def fn(x, axes=None):
+        axes = attrs.get("axes") if axes is None else np.asarray(axes).tolist()
+        if not axes:
+            return jnp.squeeze(x)
+        return jnp.squeeze(x, tuple(int(a) for a in axes))
+    return fn
+
+
+@register("Unsqueeze")
+def _unsqueeze(attrs):
+    def fn(x, axes=None):
+        axes = attrs.get("axes") if axes is None else np.asarray(axes).tolist()
+        for a in sorted(int(a) for a in axes):
+            x = jnp.expand_dims(x, a)
+        return x
+    return fn
+
+
+@register("Cast")
+def _cast(attrs):
+    np_dt = onnx_pb._DT_NP[int(attrs["to"])]
+    return lambda x: x.astype(np_dt)
+
+
+@register("Clip")
+def _clip(attrs):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    return lambda x, mn=None, mx=None: jnp.clip(
+        x, lo if mn is None else mn, hi if mx is None else mx)
+
+
+@register("Constant")
+def _constant(attrs):
+    v = attrs.get("value")
+    if v is None:
+        v = np.asarray(attrs.get("value_float", attrs.get("value_int")))
+    arr = jnp.asarray(v)
+    return lambda: arr
+
+
+@register("Shape")
+def _shape(attrs):
+    return lambda x: jnp.asarray(x.shape, jnp.int64)
+
+
+def _reduce_op(jnp_fn):
+    def mapper(attrs):
+        axes = attrs.get("axes")
+        keep = bool(attrs.get("keepdims", 1))
+
+        def fn(x, ax_in=None):
+            ax = axes if ax_in is None else np.asarray(ax_in).tolist()
+            ax = None if not ax else tuple(int(a) for a in ax)
+            return jnp_fn(x, axis=ax, keepdims=keep)
+        return fn
+    return mapper
+
+
+ONNX_OPS["ReduceMean"] = _reduce_op(jnp.mean)
+ONNX_OPS["ReduceSum"] = _reduce_op(jnp.sum)
+ONNX_OPS["ReduceMax"] = _reduce_op(jnp.max)
+ONNX_OPS["ReduceMin"] = _reduce_op(jnp.min)
+
+
+@register("Softmax")
+def _softmax(attrs):
+    ax = int(attrs.get("axis", -1))
+    return lambda x: jax.nn.softmax(x, axis=ax)
+
+
+@register("LogSoftmax")
+def _log_softmax(attrs):
+    ax = int(attrs.get("axis", -1))
+    return lambda x: jax.nn.log_softmax(x, axis=ax)
+
+
+@register("LeakyRelu")
+def _leaky(attrs):
+    alpha = attrs.get("alpha", 0.01)
+    return lambda x: _aten_leaky_relu(x, alpha)
+
+
+@register("Elu")
+def _elu(attrs):
+    alpha = attrs.get("alpha", 1.0)
+    return lambda x: _aten_elu(x, alpha)
+
+
+@register("HardSigmoid")
+def _hardsig(attrs):
+    alpha = attrs.get("alpha", 0.2)
+    beta = attrs.get("beta", 0.5)
+    return lambda x: jnp.clip(alpha * x + beta, 0, 1)
+
+
+def _simple(fn):
+    return lambda attrs: fn
+
+
+for _name, _fn in {
+    "Abs": jnp.abs, "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power, "Neg": jnp.negative,
+    "Exp": jnp.exp, "Log": jnp.log, "Sqrt": jnp.sqrt,
+    "Relu": jax.nn.relu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+    "MatMul": jnp.matmul, "Identity": lambda x: x,
+    "Greater": jnp.greater, "Less": jnp.less, "Equal": jnp.equal,
+    "Erf": jax.lax.erf, "Floor": jnp.floor, "Ceil": jnp.ceil,
+}.items():
+    ONNX_OPS[_name] = _simple(_fn)
+
+
+@register("Dropout")
+def _dropout(attrs):
+    return lambda x, *a: x  # inference semantics; mask output unsupported
+
+
+# --------------------------------------------------------------------------
+# loader
+# --------------------------------------------------------------------------
+
+def convert_onnx(model: onnx_pb.Model) -> ConvertedGraph:
+    g = model.graph
+    params: Dict[str, np.ndarray] = {}
+    consts: Dict[str, Any] = {}
+    for name, arr in g.initializers.items():
+        if np.issubdtype(arr.dtype, np.floating):
+            params[name] = arr
+        else:
+            consts[name] = jnp.asarray(arr)  # index/shape tensors: not trained
+    steps: List[Step] = []
+    for node in g.nodes:
+        if node.op_type not in ONNX_OPS:
+            raise NotImplementedError(
+                f"ONNX op {node.op_type} has no mapper yet "
+                f"(add it to onnx_loader.ONNX_OPS)")
+        fn = ONNX_OPS[node.op_type](node.attrs)
+        # ONNX optional trailing inputs appear as "" — drop them
+        ins = tuple(i for i in node.inputs if i)
+        steps.append(Step("onnx::" + node.op_type, fn, ins,
+                          tuple(node.outputs)))
+    init_names = set(g.initializers)
+    input_names = tuple(vi.name for vi in g.inputs if vi.name not in init_names)
+    output_names = tuple(vi.name for vi in g.outputs)
+    return ConvertedGraph(params, consts, steps, input_names, output_names)
+
+
+class OnnxNet(Layer):
+    """An ONNX model imported as a native trainable layer (NCHW semantics)."""
+
+    def __init__(self, model: onnx_pb.Model, input_shape=None, **kwargs):
+        self.graph = convert_onnx(model)
+        self.onnx_model = model
+        if input_shape is None:
+            shapes = [tuple(vi.shape[1:]) for vi in model.graph.inputs
+                      if vi.name in self.graph.input_names]
+            if len(shapes) == 1:
+                input_shape = shapes[0]
+            elif shapes:
+                input_shape = shapes
+        super().__init__(input_shape=input_shape, **kwargs)
+
+    @staticmethod
+    def load(path_or_bytes) -> "OnnxNet":
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            data = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                data = f.read()
+        return OnnxNet(onnx_pb.load_model(data))
+
+    def build(self, rng, input_shape):
+        return {k: jnp.asarray(v) for k, v in self.graph.params.items()}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return run_graph(self.graph, params, xs)
+
+
+def load_onnx(path_or_bytes) -> OnnxNet:
+    """Net.load_onnx analog (reference: onnx_loader.py `ModelLoader`)."""
+    return OnnxNet.load(path_or_bytes)

@@ -1,0 +1,465 @@
+"""Dependency-free ONNX protobuf codec (reader + writer).
+
+The environment ships no `onnx` package, so — like the hand-rolled TensorBoard
+event writer (utils/tbwriter.py) — the ONNX ModelProto subset the importer
+needs is decoded/encoded directly at the protobuf wire level.  Covers:
+ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto, ValueInfoProto
+(+ TypeProto tensor shapes), OperatorSetId.  Reference analog:
+pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:32-128 (which used the onnx pkg).
+
+The writer side doubles as a model EXPORT path and as the test-fixture factory
+(`make_node` / `make_tensor` / `make_graph` / `make_model` mirror onnx.helper).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# wire-level primitives
+# --------------------------------------------------------------------------
+
+_WIRE_VARINT, _WIRE_I64, _WIRE_LEN, _WIRE_I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(v: int) -> bytes:
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _signed(v: int) -> int:
+    """Interpret a 64-bit varint as two's-complement signed."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a serialized message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        fnum, wtype = tag >> 3, tag & 7
+        if wtype == _WIRE_VARINT:
+            v, pos = _read_varint(buf, pos)
+        elif wtype == _WIRE_I64:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wtype == _WIRE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == _WIRE_I32:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        yield fnum, wtype, v
+
+
+def _field(fnum: int, wtype: int, payload: bytes) -> bytes:
+    return _write_varint((fnum << 3) | wtype) + payload
+
+
+def _f_varint(fnum: int, v: int) -> bytes:
+    return _field(fnum, _WIRE_VARINT, _write_varint(v))
+
+
+def _f_bytes(fnum: int, v: bytes) -> bytes:
+    return _field(fnum, _WIRE_LEN, _write_varint(len(v)) + v)
+
+
+def _f_str(fnum: int, v: str) -> bytes:
+    return _f_bytes(fnum, v.encode("utf-8"))
+
+
+def _f_float(fnum: int, v: float) -> bytes:
+    return _field(fnum, _WIRE_I32, struct.pack("<f", v))
+
+
+# --------------------------------------------------------------------------
+# ONNX data model (the subset the importer uses)
+# --------------------------------------------------------------------------
+
+# TensorProto.DataType enum
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT16, DT_INT32, DT_INT64 = 1, 2, 3, 5, 6, 7
+DT_BOOL, DT_FLOAT16, DT_DOUBLE, DT_BFLOAT16 = 9, 10, 11, 16
+
+_DT_NP = {
+    DT_FLOAT: np.float32, DT_UINT8: np.uint8, DT_INT8: np.int8,
+    DT_INT16: np.int16, DT_INT32: np.int32, DT_INT64: np.int64,
+    DT_BOOL: np.bool_, DT_FLOAT16: np.float16, DT_DOUBLE: np.float64,
+}
+_NP_DT = {np.dtype(v): k for k, v in _DT_NP.items()}
+
+# AttributeProto.AttributeType enum
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+@dataclass
+class Node:
+    op_type: str
+    inputs: List[str] = field(default_factory=list)
+    outputs: List[str] = field(default_factory=list)
+    name: str = ""
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ValueInfo:
+    name: str
+    elem_type: int = DT_FLOAT
+    shape: Tuple[Optional[int], ...] = ()
+
+
+@dataclass
+class Graph:
+    nodes: List[Node] = field(default_factory=list)
+    initializers: Dict[str, np.ndarray] = field(default_factory=dict)
+    inputs: List[ValueInfo] = field(default_factory=list)
+    outputs: List[ValueInfo] = field(default_factory=list)
+    name: str = "graph"
+
+
+@dataclass
+class Model:
+    graph: Graph
+    ir_version: int = 8
+    opset: int = 13
+    producer: str = "analytics-zoo-tpu"
+
+
+# --------------------------------------------------------------------------
+# decoding
+# --------------------------------------------------------------------------
+
+def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    dims: List[int] = []
+    dtype = DT_FLOAT
+    name = ""
+    raw = None
+    floats: List[float] = []
+    int32s: List[int] = []
+    int64s: List[int] = []
+    doubles: List[float] = []
+    for fnum, wtype, v in iter_fields(buf):
+        if fnum == 1:
+            if wtype == _WIRE_VARINT:
+                dims.append(_signed(v))
+            else:  # packed
+                pos = 0
+                while pos < len(v):
+                    d, pos = _read_varint(v, pos)
+                    dims.append(_signed(d))
+        elif fnum == 2:
+            dtype = v
+        elif fnum == 4:
+            if wtype == _WIRE_I32:
+                floats.append(struct.unpack("<f", v)[0])
+            else:
+                floats.extend(np.frombuffer(v, "<f4").tolist())
+        elif fnum == 5:
+            if wtype == _WIRE_VARINT:
+                int32s.append(_signed(v))
+            else:
+                pos = 0
+                while pos < len(v):
+                    d, pos = _read_varint(v, pos)
+                    int32s.append(_signed(d))
+        elif fnum == 7:
+            if wtype == _WIRE_VARINT:
+                int64s.append(_signed(v))
+            else:
+                pos = 0
+                while pos < len(v):
+                    d, pos = _read_varint(v, pos)
+                    int64s.append(_signed(d))
+        elif fnum == 8:
+            name = v.decode("utf-8")
+        elif fnum == 9:
+            raw = v
+        elif fnum == 10:
+            if wtype == _WIRE_I64:
+                doubles.append(struct.unpack("<d", v)[0])
+            else:
+                doubles.extend(np.frombuffer(v, "<f8").tolist())
+    np_dtype = _DT_NP.get(dtype)
+    if np_dtype is None:
+        raise NotImplementedError(f"ONNX tensor dtype {dtype}")
+    if raw is not None:
+        arr = np.frombuffer(raw, np_dtype).reshape(dims)
+    elif floats:
+        arr = np.asarray(floats, np.float32).astype(np_dtype).reshape(dims)
+    elif int64s:
+        arr = np.asarray(int64s, np.int64).astype(np_dtype).reshape(dims)
+    elif int32s:
+        arr = np.asarray(int32s, np.int32).astype(np_dtype).reshape(dims)
+    elif doubles:
+        arr = np.asarray(doubles, np.float64).astype(np_dtype).reshape(dims)
+    else:
+        arr = np.zeros(dims, np_dtype)
+    return name, arr
+
+
+def _decode_attr(buf: bytes) -> Tuple[str, Any]:
+    name = ""
+    atype = None
+    scalars: Dict[str, Any] = {}
+    floats: List[float] = []
+    ints: List[int] = []
+    strings: List[bytes] = []
+    tensor = None
+    for fnum, wtype, v in iter_fields(buf):
+        if fnum == 1:
+            name = v.decode("utf-8")
+        elif fnum == 20:
+            atype = v
+        elif fnum == 2:
+            scalars["f"] = struct.unpack("<f", v)[0]
+        elif fnum == 3:
+            scalars["i"] = _signed(v)
+        elif fnum == 4:
+            scalars["s"] = v
+        elif fnum == 5:
+            tensor = _decode_tensor(v)[1]
+        elif fnum == 7:
+            if wtype == _WIRE_I32:
+                floats.append(struct.unpack("<f", v)[0])
+            else:
+                floats.extend(np.frombuffer(v, "<f4").tolist())
+        elif fnum == 8:
+            if wtype == _WIRE_VARINT:
+                ints.append(_signed(v))
+            else:
+                pos = 0
+                while pos < len(v):
+                    d, pos = _read_varint(v, pos)
+                    ints.append(_signed(d))
+        elif fnum == 9:
+            strings.append(v)
+    if atype == AT_FLOAT or (atype is None and "f" in scalars):
+        return name, scalars.get("f", 0.0)
+    if atype == AT_INT or (atype is None and "i" in scalars):
+        return name, scalars.get("i", 0)
+    if atype == AT_STRING or (atype is None and "s" in scalars):
+        return name, scalars.get("s", b"").decode("utf-8", "replace")
+    if atype == AT_TENSOR or tensor is not None:
+        return name, tensor
+    if atype == AT_FLOATS or floats:
+        return name, list(floats)
+    if atype == AT_STRINGS or strings:
+        return name, [s.decode("utf-8", "replace") for s in strings]
+    return name, list(ints)
+
+
+def _decode_value_info(buf: bytes) -> ValueInfo:
+    vi = ValueInfo(name="")
+    for fnum, _, v in iter_fields(buf):
+        if fnum == 1:
+            vi.name = v.decode("utf-8")
+        elif fnum == 2:  # TypeProto
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:  # tensor_type
+                    shape: List[Optional[int]] = []
+                    for f3, _, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            vi.elem_type = v3
+                        elif f3 == 2:  # TensorShapeProto
+                            for f4, _, v4 in iter_fields(v3):
+                                if f4 == 1:  # Dimension
+                                    dim: Optional[int] = None
+                                    for f5, _, v5 in iter_fields(v4):
+                                        if f5 == 1:
+                                            dim = _signed(v5)
+                                    shape.append(dim)
+                    vi.shape = tuple(shape)
+    return vi
+
+
+def _decode_node(buf: bytes) -> Node:
+    node = Node(op_type="")
+    for fnum, _, v in iter_fields(buf):
+        if fnum == 1:
+            node.inputs.append(v.decode("utf-8"))
+        elif fnum == 2:
+            node.outputs.append(v.decode("utf-8"))
+        elif fnum == 3:
+            node.name = v.decode("utf-8")
+        elif fnum == 4:
+            node.op_type = v.decode("utf-8")
+        elif fnum == 5:
+            k, val = _decode_attr(v)
+            node.attrs[k] = val
+    return node
+
+
+def _decode_graph(buf: bytes) -> Graph:
+    g = Graph()
+    for fnum, _, v in iter_fields(buf):
+        if fnum == 1:
+            g.nodes.append(_decode_node(v))
+        elif fnum == 2:
+            g.name = v.decode("utf-8")
+        elif fnum == 5:
+            name, arr = _decode_tensor(v)
+            g.initializers[name] = arr
+        elif fnum == 11:
+            g.inputs.append(_decode_value_info(v))
+        elif fnum == 12:
+            g.outputs.append(_decode_value_info(v))
+    return g
+
+
+def load_model(data: bytes) -> Model:
+    """Parse a serialized ONNX ModelProto."""
+    graph = None
+    ir_version = 0
+    opset = 0
+    producer = ""
+    for fnum, wtype, v in iter_fields(data):
+        if fnum == 1:
+            ir_version = v
+        elif fnum == 2:
+            producer = v.decode("utf-8", "replace")
+        elif fnum == 7:
+            graph = _decode_graph(v)
+        elif fnum == 8:  # OperatorSetId
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 2:
+                    opset = max(opset, _signed(v2))
+    if graph is None:
+        raise ValueError("no GraphProto in ONNX model")
+    return Model(graph=graph, ir_version=ir_version, opset=opset or 13,
+                 producer=producer)
+
+
+# --------------------------------------------------------------------------
+# encoding (onnx.helper-style factories + serializer)
+# --------------------------------------------------------------------------
+
+def make_node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+              name: str = "", **attrs) -> Node:
+    return Node(op_type=op_type, inputs=list(inputs), outputs=list(outputs),
+                name=name, attrs=attrs)
+
+
+def make_tensor_value_info(name: str, elem_type: int = DT_FLOAT,
+                           shape: Sequence[Optional[int]] = ()) -> ValueInfo:
+    return ValueInfo(name=name, elem_type=elem_type, shape=tuple(shape))
+
+
+def make_graph(nodes, name, inputs, outputs, initializers=None) -> Graph:
+    return Graph(nodes=list(nodes), name=name, inputs=list(inputs),
+                 outputs=list(outputs),
+                 initializers=dict(initializers or {}))
+
+
+def make_model(graph: Graph, opset: int = 13) -> Model:
+    return Model(graph=graph, opset=opset)
+
+
+def _encode_tensor(name: str, arr: np.ndarray) -> bytes:
+    out = b"".join(_f_varint(1, int(d)) for d in arr.shape)
+    dt = _NP_DT.get(np.dtype(arr.dtype))
+    if dt is None:
+        raise NotImplementedError(f"dtype {arr.dtype}")
+    out += _f_varint(2, dt)
+    out += _f_str(8, name)
+    out += _f_bytes(9, np.ascontiguousarray(arr).tobytes())
+    return out
+
+
+def _encode_attr(name: str, v: Any) -> bytes:
+    out = _f_str(1, name)
+    if isinstance(v, bool):
+        out += _f_varint(3, int(v)) + _f_varint(20, AT_INT)
+    elif isinstance(v, int):
+        out += _f_varint(3, v) + _f_varint(20, AT_INT)
+    elif isinstance(v, float):
+        out += _f_float(2, v) + _f_varint(20, AT_FLOAT)
+    elif isinstance(v, str):
+        out += _f_bytes(4, v.encode()) + _f_varint(20, AT_STRING)
+    elif isinstance(v, np.ndarray):
+        out += _f_bytes(5, _encode_tensor("", v)) + _f_varint(20, AT_TENSOR)
+    elif isinstance(v, (list, tuple)):
+        if v and isinstance(v[0], float):
+            for x in v:
+                out += _f_float(7, x)
+            out += _f_varint(20, AT_FLOATS)
+        elif v and isinstance(v[0], str):
+            for x in v:
+                out += _f_bytes(9, x.encode())
+            out += _f_varint(20, AT_STRINGS)
+        else:
+            for x in v:
+                out += _f_varint(8, int(x))
+            out += _f_varint(20, AT_INTS)
+    else:
+        raise NotImplementedError(f"attribute type {type(v)}")
+    return out
+
+
+def _encode_value_info(vi: ValueInfo) -> bytes:
+    dims = b""
+    for d in vi.shape:
+        dims += _f_bytes(1, _f_varint(1, int(d)) if d is not None else b"")
+    shape = _f_bytes(2, dims)
+    tensor_type = _f_varint(1, vi.elem_type) + shape
+    return _f_str(1, vi.name) + _f_bytes(2, _f_bytes(1, tensor_type))
+
+
+def _encode_node(n: Node) -> bytes:
+    out = b""
+    for i in n.inputs:
+        out += _f_str(1, i)
+    for o in n.outputs:
+        out += _f_str(2, o)
+    if n.name:
+        out += _f_str(3, n.name)
+    out += _f_str(4, n.op_type)
+    for k, v in n.attrs.items():
+        out += _f_bytes(5, _encode_attr(k, v))
+    return out
+
+
+def _encode_graph(g: Graph) -> bytes:
+    out = b""
+    for n in g.nodes:
+        out += _f_bytes(1, _encode_node(n))
+    out += _f_str(2, g.name)
+    for name, arr in g.initializers.items():
+        out += _f_bytes(5, _encode_tensor(name, np.asarray(arr)))
+    for vi in g.inputs:
+        out += _f_bytes(11, _encode_value_info(vi))
+    for vi in g.outputs:
+        out += _f_bytes(12, _encode_value_info(vi))
+    return out
+
+
+def save_model(model: Model) -> bytes:
+    out = _f_varint(1, model.ir_version)
+    out += _f_str(2, model.producer)
+    out += _f_bytes(7, _encode_graph(model.graph))
+    out += _f_bytes(8, _f_str(1, "") + _f_varint(2, model.opset))
+    return out

@@ -1,0 +1,480 @@
+"""TorchScript graph -> pure JAX function converter (the TorchNet core).
+
+Reference parity: TorchNet/TorchCriterion embed libtorch via JNI and run the
+TorchScript interpreter natively (pipeline/api/net/TorchNet.scala:39-242,
+TorchCriterion.scala:1-130, PytorchModelWrapper.java).  The TPU rebuild cannot
+(and should not) embed libtorch on TPU hosts — instead the TorchScript graph is
+IMPORTED: we freeze+inline the scripted module, walk its aten IR, and emit an
+equivalent pure jnp program whose weights are ordinary trainable param pytrees.
+The imported model therefore jits, shards, and fine-tunes like any native layer
+(the reference could only forward/backward through the interpreter).
+
+Semantics notes:
+- Imported graphs keep torch's NCHW layout and exact op semantics; the oracle
+  tests compare against torch CPU forward to 1e-4.
+- Tracing specializes control flow exactly like jit tracing does — the same
+  contract as the reference's `torch.jit.trace`-produced TorchNet models.
+- Supported surface: the aten op registry below (conv/linear/norm/pool/
+  activations/elementwise/shape ops — the TorchNet-class model families).
+  Unmapped ops raise with the op name so gaps are loud, not silent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Step(NamedTuple):
+    kind: str
+    fn: Callable
+    in_names: Tuple[str, ...]
+    out_names: Tuple[str, ...]
+
+
+class ConvertedGraph(NamedTuple):
+    params: Dict[str, np.ndarray]   # trainable tensor constants
+    consts: Dict[str, Any]          # python scalars/lists/None
+    steps: List[Step]
+    input_names: Tuple[str, ...]
+    output_names: Tuple[str, ...]
+    input_shapes: Tuple[Optional[Tuple[int, ...]], ...] = ()  # traced, incl. batch
+
+
+# --------------------------------------------------------------------------
+# aten op implementations (NCHW, torch semantics)
+# --------------------------------------------------------------------------
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv(x, w, b, stride, padding, dilation, transposed, output_padding,
+          groups):
+    nd = x.ndim - 2
+    stride, dilation = _pair(stride, nd), _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+        if pad == "VALID":
+            padding = [0] * nd
+        else:
+            raise NotImplementedError("conv padding='same' string")
+    padding = _pair(padding, nd)
+    pads = [(p, p) for p in padding]
+    spatial = "DHW"[-nd:]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    if transposed:
+        # torch ConvTranspose: w is (IN, OUT/groups, *k)
+        out_padding = _pair(output_padding, nd)
+        y = jax.lax.conv_general_dilated(
+            x, jnp.flip(w, axis=tuple(range(2, w.ndim))).swapaxes(0, 1),
+            window_strides=(1,) * nd,
+            padding=[(d * (k - 1) - p, d * (k - 1) - p + op)
+                     for k, d, p, op in zip(w.shape[2:], dilation, padding,
+                                            out_padding)],
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=int(groups))
+    else:
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pads, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=int(groups))
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+def _aten_convolution(x, w, b, stride, padding, dilation, transposed,
+                      output_padding, groups, *_ignored):
+    return _conv(x, w, b, stride, padding, dilation, bool(transposed),
+                 output_padding, groups)
+
+
+def _aten_convnd(x, w, b=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv(x, w, b, stride, padding, dilation, False, 0, groups)
+
+
+def _aten_linear(x, w, b=None):
+    y = jnp.matmul(x, w.T)
+    return y if b is None else y + b
+
+
+def _aten_addmm(b, x, w, beta=1, alpha=1):
+    return beta * b + alpha * jnp.matmul(x, w)
+
+
+def _aten_batch_norm(x, w, b, mean, var, training, momentum, eps, *_):
+    if training:
+        raise NotImplementedError(
+            "imported TorchScript graphs must be traced in eval() mode")
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = jax.lax.rsqrt(var.reshape(shape) + eps)
+    y = (x - mean.reshape(shape)) * inv
+    if w is not None:
+        y = y * w.reshape(shape)
+    if b is not None:
+        y = y + b.reshape(shape)
+    return y
+
+
+def _aten_layer_norm(x, normalized_shape, w=None, b=None, eps=1e-5, *_):
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _pool_window(x, kernel, stride, padding, init, op, ceil_mode=False):
+    nd = x.ndim - 2
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd) if stride not in (None, []) else kernel
+    padding = _pair(padding, nd)
+    if ceil_mode:
+        raise NotImplementedError("ceil_mode pooling")
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    return jax.lax.reduce_window(x, init, op, dims, strides, pads)
+
+
+def _aten_max_poolnd(x, kernel, stride=None, padding=0, dilation=1,
+                     ceil_mode=False):
+    if set(_pair(dilation, x.ndim - 2)) != {1}:
+        raise NotImplementedError("dilated max_pool")
+    return _pool_window(x, kernel, stride, padding, -jnp.inf, jax.lax.max,
+                        ceil_mode)
+
+
+def _aten_avg_poolnd(x, kernel, stride=None, padding=0, ceil_mode=False,
+                     count_include_pad=True, divisor_override=None):
+    nd = x.ndim - 2
+    kernel = _pair(kernel, nd)
+    if not count_include_pad and set(_pair(padding, nd)) != {0}:
+        raise NotImplementedError("avg_pool count_include_pad=False with pad")
+    s = _pool_window(x, kernel, stride, padding, 0.0, jax.lax.add, ceil_mode)
+    div = divisor_override or int(np.prod(kernel))
+    return s / div
+
+
+def _aten_adaptive_avg_pool(x, output_size):
+    nd = x.ndim - 2
+    out = _pair(output_size, nd)
+    if all(o == 1 for o in out):
+        return x.mean(axis=tuple(range(2, x.ndim)), keepdims=True)
+    if any(s % o for s, o in zip(x.shape[2:], out)):
+        raise NotImplementedError("adaptive pool with non-divisible output")
+    kernel = tuple(s // o for s, o in zip(x.shape[2:], out))
+    return _aten_avg_poolnd(x, kernel, kernel, 0)
+
+
+def _aten_flatten(x, start_dim=0, end_dim=-1):
+    start = start_dim % x.ndim
+    end = end_dim % x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[end + 1:]
+    return x.reshape(shape)
+
+
+def _aten_reshape(x, shape):
+    return x.reshape([int(s) for s in shape])
+
+
+def _aten_permute(x, dims):
+    return jnp.transpose(x, [int(d) for d in dims])
+
+
+def _aten_transpose(x, d0, d1):
+    return jnp.swapaxes(x, int(d0), int(d1))
+
+
+def _aten_cat(tensors, dim=0):
+    return jnp.concatenate(tensors, axis=int(dim))
+
+
+def _aten_slice(x, dim=0, start=None, end=None, step=1):
+    idx = [slice(None)] * x.ndim
+    end = None if end in (None,) or end > 2 ** 62 else end
+    idx[int(dim)] = slice(start, end, step)
+    return x[tuple(idx)]
+
+
+def _aten_select(x, dim, index):
+    return jnp.take(x, int(index), axis=int(dim))
+
+
+def _aten_embedding(w, idx, padding_idx=-1, scale_grad=False, sparse=False):
+    return jnp.take(w, idx.astype(jnp.int32), axis=0)
+
+
+def _aten_clamp(x, lo=None, hi=None):
+    return jnp.clip(x, lo, hi)
+
+
+def _aten_mean(x, dim=None, keepdim=False, dtype=None):
+    if dim is None:
+        return x.mean()
+    return x.mean(axis=tuple(int(d) for d in (dim if isinstance(dim, (list, tuple)) else [dim])),
+                  keepdims=bool(keepdim))
+
+
+def _aten_sum(x, dim=None, keepdim=False, dtype=None):
+    if dim is None:
+        return x.sum()
+    return x.sum(axis=tuple(int(d) for d in (dim if isinstance(dim, (list, tuple)) else [dim])),
+                 keepdims=bool(keepdim))
+
+
+def _aten_to(x, *args):
+    """aten::to has many overloads; honour a dtype arg when present."""
+    _DT = {3: jnp.int32, 4: jnp.int64, 5: jnp.float16, 6: jnp.float32,
+           7: jnp.float64, 11: jnp.bool_, 15: jnp.bfloat16}
+    for a in args:
+        if isinstance(a, int) and a in _DT:
+            return x.astype(_DT[a])
+    return x
+
+
+def _aten_softmax(x, dim, dtype=None):
+    return jax.nn.softmax(x, axis=int(dim))
+
+
+def _aten_log_softmax(x, dim, dtype=None):
+    return jax.nn.log_softmax(x, axis=int(dim))
+
+
+def _aten_hardtanh(x, lo=-1.0, hi=1.0):
+    return jnp.clip(x, lo, hi)
+
+
+def _aten_leaky_relu(x, slope=0.01):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def _aten_elu(x, alpha=1.0, scale=1.0, input_scale=1.0):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(input_scale * x))
+
+
+def _aten_gelu(x, approximate="none"):
+    return jax.nn.gelu(x, approximate=(approximate == "tanh"))
+
+
+def _aten_chunk(x, n, dim):
+    """torch.chunk semantics: ceil-sized chunks, last one may be smaller."""
+    size = x.shape[dim]
+    step = -(-size // n)
+    return jnp.split(x, list(range(step, size, step)), axis=dim)
+
+
+def _aten_minmax(x, reduce_fn, arg_fn, a):
+    if not a:
+        return reduce_fn(x)
+    dim = int(a[0])
+    keep = bool(a[1]) if len(a) > 1 else False
+    return (reduce_fn(x, axis=dim, keepdims=keep),
+            arg_fn(x, axis=dim, keepdims=keep))
+
+
+def _binop(fn):
+    return lambda x, y, *alpha: fn(x, (y if not alpha else y * alpha[0]))
+
+
+ATEN_OPS: Dict[str, Callable] = {
+    "aten::_convolution": _aten_convolution,
+    "aten::conv1d": _aten_convnd,
+    "aten::conv2d": _aten_convnd,
+    "aten::conv3d": _aten_convnd,
+    "aten::linear": _aten_linear,
+    "aten::addmm": _aten_addmm,
+    "aten::matmul": jnp.matmul,
+    "aten::mm": jnp.matmul,
+    "aten::bmm": jnp.matmul,
+    "aten::batch_norm": _aten_batch_norm,
+    "aten::layer_norm": _aten_layer_norm,
+    "aten::max_pool1d": _aten_max_poolnd,
+    "aten::max_pool2d": _aten_max_poolnd,
+    "aten::max_pool3d": _aten_max_poolnd,
+    "aten::avg_pool1d": _aten_avg_poolnd,
+    "aten::avg_pool2d": _aten_avg_poolnd,
+    "aten::avg_pool3d": _aten_avg_poolnd,
+    "aten::adaptive_avg_pool1d": _aten_adaptive_avg_pool,
+    "aten::adaptive_avg_pool2d": _aten_adaptive_avg_pool,
+    "aten::relu": jax.nn.relu, "aten::relu_": jax.nn.relu,
+    "aten::relu6": lambda x: jnp.clip(x, 0, 6),
+    "aten::hardtanh": _aten_hardtanh, "aten::hardtanh_": _aten_hardtanh,
+    "aten::sigmoid": jax.nn.sigmoid, "aten::tanh": jnp.tanh,
+    "aten::gelu": _aten_gelu, "aten::silu": jax.nn.silu,
+    "aten::silu_": jax.nn.silu,
+    "aten::elu": _aten_elu, "aten::leaky_relu": _aten_leaky_relu,
+    "aten::leaky_relu_": _aten_leaky_relu,
+    "aten::softplus": lambda x, beta=1, thr=20: jax.nn.softplus(x * beta) / beta,
+    "aten::hardsigmoid": lambda x: jnp.clip(x / 6 + 0.5, 0, 1),
+    "aten::hardswish": lambda x: x * jnp.clip(x / 6 + 0.5, 0, 1),
+    "aten::erf": jax.lax.erf,
+    "aten::softmax": _aten_softmax, "aten::log_softmax": _aten_log_softmax,
+    "aten::flatten": _aten_flatten,
+    "aten::reshape": _aten_reshape, "aten::view": _aten_reshape,
+    "aten::permute": _aten_permute, "aten::transpose": _aten_transpose,
+    "aten::t": lambda x: x.T,
+    "aten::contiguous": lambda x, *a: x,
+    "aten::squeeze": lambda x, *dims: (
+        jnp.squeeze(x, tuple(int(d) for d in dims)) if dims else jnp.squeeze(x)),
+    "aten::unsqueeze": lambda x, d: jnp.expand_dims(x, int(d)),
+    "aten::cat": _aten_cat, "aten::stack": lambda ts, dim=0: jnp.stack(ts, int(dim)),
+    "aten::slice": _aten_slice, "aten::select": _aten_select,
+    "aten::chunk": lambda x, n, dim=0: _aten_chunk(x, int(n), int(dim)),
+    "aten::embedding": _aten_embedding,
+    "aten::dropout": lambda x, p, train: x,
+    "aten::dropout_": lambda x, p, train: x,
+    "aten::feature_dropout": lambda x, p, train: x,
+    "aten::add": _binop(jnp.add), "aten::add_": _binop(jnp.add),
+    "aten::sub": _binop(jnp.subtract), "aten::sub_": _binop(jnp.subtract),
+    "aten::rsub": lambda x, y, *alpha: y - (x if not alpha else x * alpha[0]),
+    "aten::mul": jnp.multiply, "aten::mul_": jnp.multiply,
+    "aten::div": jnp.divide, "aten::div_": jnp.divide,
+    "aten::pow": jnp.power,
+    "aten::neg": jnp.negative, "aten::abs": jnp.abs,
+    "aten::exp": jnp.exp, "aten::log": jnp.log, "aten::sqrt": jnp.sqrt,
+    "aten::rsqrt": jax.lax.rsqrt,
+    "aten::floor": jnp.floor, "aten::round": jnp.round,
+    "aten::clamp": _aten_clamp, "aten::clamp_": _aten_clamp,
+    "aten::clamp_min": lambda x, lo: jnp.clip(x, lo, None),
+    "aten::mean": _aten_mean, "aten::sum": _aten_sum,
+    "aten::to": _aten_to, "aten::type_as": lambda x, y: x.astype(y.dtype),
+    "aten::size": lambda x, dim=None: (x.shape if dim is None else x.shape[int(dim)]),
+    "aten::Int": lambda v: int(v),
+    "aten::ScalarImplicit": lambda v: v,
+    "aten::detach": lambda x: jax.lax.stop_gradient(x),
+    "aten::broadcast_tensors": lambda ts: list(jnp.broadcast_arrays(*ts)),
+    "aten::expand": lambda x, shape, implicit=False: jnp.broadcast_to(
+        x, [x.shape[i] if int(s) == -1 else int(s) for i, s in enumerate(shape)]),
+    "aten::expand_as": lambda x, y: jnp.broadcast_to(x, y.shape),
+    "aten::where": jnp.where,
+    "aten::masked_fill": lambda x, m, v: jnp.where(m, v, x),
+    "aten::maximum": jnp.maximum, "aten::minimum": jnp.minimum,
+    "aten::max": lambda x, *a: _aten_minmax(x, jnp.max, jnp.argmax, a),
+    "aten::min": lambda x, *a: _aten_minmax(x, jnp.min, jnp.argmin, a),
+    "aten::argmax": lambda x, dim=None, keepdim=False: jnp.argmax(
+        x, axis=None if dim is None else int(dim)),
+    "aten::mse_loss": lambda p, t, reduction=1: _reduce((p - t) ** 2, reduction),
+    "aten::l1_loss": lambda p, t, reduction=1: _reduce(jnp.abs(p - t), reduction),
+    "aten::binary_cross_entropy": lambda p, t, w=None, reduction=1: _reduce(
+        -(t * jnp.log(jnp.clip(p, 1e-12, 1.0))
+          + (1 - t) * jnp.log(jnp.clip(1 - p, 1e-12, 1.0))) * (1.0 if w is None else w),
+        reduction),
+    "aten::nll_loss": lambda logp, t, w=None, reduction=1, ignore=-100: _reduce(
+        -jnp.take_along_axis(logp, t.astype(jnp.int32)[:, None], axis=1)[:, 0],
+        reduction),
+}
+
+
+def _reduce(per, reduction):
+    # torch reduction enum: 0=none, 1=mean, 2=sum
+    if reduction == 0:
+        return per
+    return per.mean() if reduction == 1 else per.sum()
+
+
+# --------------------------------------------------------------------------
+# Graph walking
+# --------------------------------------------------------------------------
+
+def convert_torchscript(scripted) -> ConvertedGraph:
+    """Freeze+inline a ScriptModule and lower its graph to a Step program."""
+    import torch
+
+    if not isinstance(scripted, torch.jit.ScriptModule):
+        raise TypeError("expected a torch.jit.ScriptModule (trace/script first)")
+    mod = scripted
+    if getattr(mod, "training", False):
+        mod = mod.eval()
+    try:
+        mod = torch.jit.freeze(mod)
+    except RuntimeError:
+        pass  # already frozen
+    graph = mod.graph
+    torch._C._jit_pass_inline(graph)
+
+    params: Dict[str, np.ndarray] = {}
+    consts: Dict[str, Any] = {}
+    steps: List[Step] = []
+
+    real_inputs = [i for i in graph.inputs()
+                   if not i.debugName().startswith("self")]
+    input_names = tuple(i.debugName() for i in real_inputs)
+
+    def _sizes(v):
+        try:
+            s = v.type().sizes()
+            return tuple(s) if s is not None else None
+        except RuntimeError:
+            return None
+    input_shapes = tuple(_sizes(i) for i in real_inputs)
+
+    for node in graph.nodes():
+        kind = node.kind()
+        outs = tuple(o.debugName() for o in node.outputs())
+        ins = tuple(i.debugName() for i in node.inputs())
+        if kind == "prim::Constant":
+            import torch
+            v = node.output().toIValue()
+            if isinstance(v, torch.Tensor):
+                arr = v.detach().cpu().numpy()
+                # Only float tensors are trainable; int/bool buffers (index
+                # tables, masks) go to consts so jax.grad over params works.
+                if np.issubdtype(arr.dtype, np.floating):
+                    params[outs[0]] = arr
+                else:
+                    consts[outs[0]] = jnp.asarray(arr)
+            else:
+                consts[outs[0]] = v
+        elif kind == "prim::ListConstruct":
+            steps.append(Step(kind, lambda *xs: list(xs), ins, outs))
+        elif kind == "prim::TupleConstruct":
+            steps.append(Step(kind, lambda *xs: tuple(xs), ins, outs))
+        elif kind in ("prim::ListUnpack", "prim::TupleUnpack"):
+            steps.append(Step(kind, lambda xs: tuple(xs), ins, outs))
+        elif kind == "prim::NumToTensor":
+            steps.append(Step(kind, lambda v: v, ins, outs))
+        elif kind == "prim::GetAttr":
+            raise NotImplementedError(
+                "prim::GetAttr survived freezing — load the module in eval() "
+                "mode and re-trace")
+        elif kind in ATEN_OPS:
+            steps.append(Step(kind, ATEN_OPS[kind], ins, outs))
+        else:
+            raise NotImplementedError(
+                f"TorchScript op {kind} has no JAX mapping yet "
+                f"(add it to torch_graph.ATEN_OPS)")
+
+    output_names = tuple(o.debugName() for o in graph.outputs())
+    return ConvertedGraph(params, consts, steps, input_names, output_names,
+                          input_shapes)
+
+
+def run_graph(cg: ConvertedGraph, params, inputs: Sequence):
+    """Execute the Step program as a pure function of (params, inputs)."""
+    env: Dict[str, Any] = dict(cg.consts)
+    env.update(params)
+    if len(inputs) != len(cg.input_names):
+        raise ValueError(
+            f"graph expects {len(cg.input_names)} inputs, got {len(inputs)}")
+    env.update(zip(cg.input_names, inputs))
+    for step in cg.steps:
+        args = [env[n] for n in step.in_names]
+        out = step.fn(*args)
+        if len(step.out_names) == 1:
+            env[step.out_names[0]] = out
+        else:
+            env.update(zip(step.out_names, out))
+    outs = [env[n] for n in cg.output_names]
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,115 @@
+"""TorchNet / TorchCriterion — PyTorch model import as native trainable layers.
+
+Reference parity: `TorchNet.from_pytorch(module, input)` / `TorchNet(path)` and
+`TorchCriterion.from_pytorch(loss, input, label)`
+(pyzoo/zoo/pipeline/api/net/torch_net.py:36-80, torch_criterion.py:39-60,
+TorchNet.scala:39-242).  The reference runs TorchScript through an embedded
+libtorch JNI; here the graph is IMPORTED into pure jnp (interop/torch_graph.py),
+so the result is a first-class `Layer`: it jits onto the TPU, its weights are a
+trainable param pytree (fine-tuning via Estimator works), and it composes with
+Sequential/Model like any native layer.  Layout stays NCHW per torch semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.interop.torch_graph import (
+    ConvertedGraph, convert_torchscript, run_graph)
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _trace(module, example_input, check_trace=True):
+    import torch
+
+    if isinstance(module, torch.jit.ScriptModule):
+        return module
+    module = module.eval()
+    ex = example_input
+    if isinstance(ex, np.ndarray):
+        ex = torch.as_tensor(ex)
+    if not isinstance(ex, (tuple, list)):
+        ex = (ex,)
+    ex = tuple(torch.as_tensor(e) if isinstance(e, np.ndarray) else e
+               for e in ex)
+    return torch.jit.trace(module, ex, check_trace=check_trace)
+
+
+class TorchNet(Layer):
+    """A PyTorch model imported as a native layer.
+
+    `TorchNet(path)` loads a TorchScript file (torch.jit.save output);
+    `TorchNet.from_pytorch(module, input)` traces a live nn.Module.
+    """
+
+    def __init__(self, path: Optional[str] = None, *, scripted=None,
+                 input_shape=None, **kwargs):
+        if scripted is None:
+            if path is None:
+                raise ValueError("TorchNet needs a TorchScript path or module")
+            import torch
+            scripted = torch.jit.load(path, map_location="cpu")
+        self.graph: ConvertedGraph = convert_torchscript(scripted)
+        if input_shape is None:
+            shapes = [s[1:] if s else None for s in self.graph.input_shapes]
+            if len(shapes) == 1:
+                input_shape = shapes[0]
+            elif shapes and all(s is not None for s in shapes):
+                input_shape = shapes
+        super().__init__(input_shape=input_shape, **kwargs)
+
+    @staticmethod
+    def from_pytorch(module, input, check_trace: bool = True,
+                     **kwargs) -> "TorchNet":
+        """Trace a live torch.nn.Module on `input` (tensor/ndarray or tuple)."""
+        scripted = _trace(module, input, check_trace)
+        shapes = [tuple(t.shape[1:]) for t in
+                  (input if isinstance(input, (tuple, list)) else [input])]
+        return TorchNet(scripted=scripted,
+                        input_shape=shapes[0] if len(shapes) == 1 else shapes,
+                        **kwargs)
+
+    def build(self, rng, input_shape):
+        return {k: jnp.asarray(v) for k, v in self.graph.params.items()}
+
+    def init(self, rng=None, input_shape=None):
+        # Unlike native layers the params are fully determined by the imported
+        # graph, so init works without an input shape (torch.jit.load drops
+        # the traced shape metadata).
+        return self.build(rng, input_shape), {}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return run_graph(self.graph, params, xs)
+
+
+class TorchCriterion:
+    """A torch loss module imported as a pure (y_pred, y_true) -> loss callable,
+    usable directly as an Estimator `loss`.  Scalar (reduced) torch losses work
+    under the Estimator's weighted-mean contract because the scalar broadcasts
+    over the per-sample weights.
+    """
+
+    def __init__(self, scripted):
+        self.graph = convert_torchscript(scripted)
+        if len(self.graph.input_names) != 2:
+            raise ValueError("TorchCriterion expects a (input, target) graph, "
+                             f"got inputs {self.graph.input_names}")
+        self._params = {k: jnp.asarray(v) for k, v in self.graph.params.items()}
+
+    @staticmethod
+    def from_pytorch(loss, input=None, label=None) -> "TorchCriterion":
+        import torch
+
+        if isinstance(loss, torch.jit.ScriptModule):
+            return TorchCriterion(loss)
+        ex_in = torch.as_tensor(input) if isinstance(input, np.ndarray) else input
+        ex_lbl = torch.as_tensor(label) if isinstance(label, np.ndarray) else label
+        return TorchCriterion(torch.jit.trace(loss, (ex_in, ex_lbl)))
+
+    def __call__(self, y_pred, y_true):
+        return run_graph(self.graph, self._params, [y_pred, y_true])

@@ -1,0 +1,56 @@
+"""Net — the unified model-loader facade.
+
+Reference parity: `Net.load` / `loadBigDL` / `loadCaffe` / `loadTF` /
+`loadTorch` (pipeline/api/Net.scala:103-277, pyzoo net_load.py).  Each loader
+returns a native layer/model ready for predict or fine-tune:
+
+- `Net.load(path)`            — native zoo weights (save_weights output)
+  applied onto a provided architecture
+- `Net.load_tf(path)`         — TF SavedModel via the TFNet bridge
+- `Net.load_keras(model)`     — structural tf.keras import (weights copied)
+- `Net.load_torch(path)`      — TorchScript file imported to pure jnp
+- `Net.load_onnx(path)`       — ONNX file imported to pure jnp
+- `Net.load_caffe(...)`       — prototxt+caffemodel import (interop/caffe)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Net:
+    @staticmethod
+    def load(weights_path: str, model):
+        """Load native saved weights onto `model` (Sequential/Model)."""
+        return model.load_weights(weights_path)
+
+    @staticmethod
+    def load_tf(saved_model_path: str, signature: str = "serving_default"):
+        from analytics_zoo_tpu.interop.tfnet import TFNet
+        return TFNet.from_saved_model(saved_model_path, signature=signature)
+
+    @staticmethod
+    def load_keras(tf_model):
+        from analytics_zoo_tpu.interop.keras_import import from_tf_keras
+        return from_tf_keras(tf_model)
+
+    @staticmethod
+    def load_torch(path_or_module, example_input=None):
+        from analytics_zoo_tpu.interop.torchnet import TorchNet
+        if isinstance(path_or_module, str):
+            return TorchNet(path_or_module)
+        return TorchNet.from_pytorch(path_or_module, example_input)
+
+    @staticmethod
+    def load_onnx(path_or_bytes):
+        from analytics_zoo_tpu.interop.onnx_loader import load_onnx
+        return load_onnx(path_or_bytes)
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        try:
+            from analytics_zoo_tpu.interop.caffe import load_caffe
+        except ImportError as e:
+            raise NotImplementedError(
+                "Caffe import is not available yet (interop/caffe)") from e
+        return load_caffe(def_path, model_path)
