@@ -11,7 +11,9 @@ _ACTIVATIONS = {
     "relu6": jax.nn.relu6,
     "tanh": jnp.tanh,
     "sigmoid": jax.nn.sigmoid,
-    "hard_sigmoid": jax.nn.hard_sigmoid,
+    # Keras hard_sigmoid is clip(0.2x+0.5, 0, 1) — NOT jax.nn.hard_sigmoid,
+    # which uses slope 1/6 (relu6(x+3)/6).  RNN defaults depend on this.
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "softmax": lambda x: jax.nn.softmax(x, axis=-1),
     "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
     "softplus": jax.nn.softplus,
