@@ -24,9 +24,17 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
-def ctx():
+def _session_ctx():
     from analytics_zoo_tpu.common.context import init_context
     return init_context(seed=42)
+
+
+@pytest.fixture()
+def ctx(_session_ctx):
+    # Re-seed per test so each test sees a deterministic rng stream regardless
+    # of which (or how many) other tests ran before it.
+    _session_ctx.set_seed(42)
+    return _session_ctx
 
 
 @pytest.fixture()
