@@ -55,6 +55,9 @@ class ZooConf:
     log_every_n_steps: int = 10
     # Data layer
     prefetch_buffers: int = 2             # double-buffered device infeed
+    # Profiling: directory for jax.profiler traces; empty = disabled.  Also
+    # switchable via ZOO_TPU_PROFILE=1 (traces land in ./zoo_tpu_profile).
+    profile_dir: str = ""
 
     @staticmethod
     def from_env(**overrides) -> "ZooConf":
@@ -63,10 +66,24 @@ class ZooConf:
             env_key = "ZOO_TPU_" + f.name.upper()
             if env_key in os.environ and f.name not in overrides:
                 raw = os.environ[env_key]
-                if f.type in ("int", int):
+                default = getattr(ZooConf, f.name)
+                if isinstance(default, bool):
+                    setattr(conf, f.name, raw.lower() in ("1", "true", "yes"))
+                elif isinstance(default, int):
                     setattr(conf, f.name, int(raw))
-                elif f.type in ("str", str):
+                elif isinstance(default, tuple):
+                    # comma-separated: ZOO_TPU_MESH_AXES=data,model
+                    # ZOO_TPU_MESH_SHAPE=-1,2 (ints where the default is ints)
+                    parts = [p.strip() for p in raw.split(",") if p.strip()]
+                    if default and all(isinstance(d, int) for d in default):
+                        setattr(conf, f.name, tuple(int(p) for p in parts))
+                    else:
+                        setattr(conf, f.name, tuple(parts))
+                else:
                     setattr(conf, f.name, raw)
+        if os.environ.get("ZOO_TPU_PROFILE", "").lower() in ("1", "true", "yes") \
+                and not conf.profile_dir:
+            conf.profile_dir = "zoo_tpu_profile"
         return conf
 
 

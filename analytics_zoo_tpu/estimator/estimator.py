@@ -25,6 +25,8 @@ step — no dynamic-shape recompiles.
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -289,6 +291,21 @@ class Estimator:
 
         tstate = TrainState(epoch=self.epoch, iteration=self.global_step)
         retries_left = self.ctx.conf.failure_retry_times
+        profile_cm = contextlib.nullcontext()
+        if self.ctx.conf.profile_dir:
+            # jax.profiler trace of the whole fit (InferenceSupportive.timing /
+            # per-layer BigDL Metrics analog — SURVEY.md §5 tracing); view with
+            # tensorboard or xprof.  Flag-gated: ZOO_TPU_PROFILE=1.
+            profile_cm = jax.profiler.trace(self.ctx.conf.profile_dir)
+        with profile_cm:
+            return self._fit_loop(data, batch_size, epochs, validation_data,
+                                  shuffle, verbose, log_every, end_trigger,
+                                  steps_per_call, hist, np_rng, tstate,
+                                  retries_left)
+
+    def _fit_loop(self, data, batch_size, epochs, validation_data, shuffle,
+                  verbose, log_every, end_trigger, steps_per_call, hist,
+                  np_rng, tstate, retries_left) -> History:
         epoch = 0
         while epoch < epochs:
             t0 = time.time()
@@ -343,15 +360,23 @@ class Estimator:
                         break
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except Exception:
+            except Exception as e:
                 # failure-retry with checkpoint restore
                 # (Topology.scala:1180-1262 semantics)
                 if retries_left > 0 and self._ckpt_mgr is not None \
                         and self._ckpt_mgr.latest_step() is not None:
                     retries_left -= 1
+                    logging.getLogger(__name__).warning(
+                        "training step failed (%s: %s); restoring latest "
+                        "checkpoint and retrying (%d retries left)",
+                        type(e).__name__, e, retries_left)
                     self._train_step = None
+                    self._scan_step = None
                     self.maybe_restore_checkpoint()
-                    self._train_step = self._build_train_step()
+                    if steps_per_call > 1:
+                        self._scan_step = self._build_scanned_train_step()
+                    else:
+                        self._train_step = self._build_train_step()
                     continue
                 raise
 

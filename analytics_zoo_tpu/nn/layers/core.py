@@ -232,8 +232,14 @@ class Merge(Layer):
         return self._merge(xs), new_state
 
     def call(self, params, inputs, *, training=False, rng=None):
-        y, _ = self.apply(params, self.init_state(self._declared_input_shape),
-                          inputs, training=training, rng=rng)
+        state = self.init_state(self._declared_input_shape)
+        if training and len(jax.tree.leaves(state)) > 0:
+            # A stateful branch (e.g. BatchNormalization) would silently drop
+            # its state updates on this path — the caller must use apply().
+            raise RuntimeError(
+                f"Merge {self.name!r} has stateful branches; call apply() "
+                "with explicit state instead of call() when training")
+        y, _ = self.apply(params, state, inputs, training=training, rng=rng)
         return y
 
 
