@@ -59,28 +59,33 @@ class ZooConf:
     # switchable via ZOO_TPU_PROFILE=1 (traces land in ./zoo_tpu_profile).
     profile_dir: str = ""
 
-    @staticmethod
-    def from_env(**overrides) -> "ZooConf":
-        conf = ZooConf(**overrides)
+    @classmethod
+    def from_env(cls, **overrides) -> "ZooConf":
+        conf = cls(**overrides)
         for f in dataclasses.fields(conf):
             env_key = "ZOO_TPU_" + f.name.upper()
             if env_key in os.environ and f.name not in overrides:
                 raw = os.environ[env_key]
-                default = getattr(ZooConf, f.name)
+                if f.default is not dataclasses.MISSING:
+                    default = f.default
+                elif f.default_factory is not dataclasses.MISSING:
+                    default = f.default_factory()
+                else:
+                    continue
                 if isinstance(default, bool):
                     setattr(conf, f.name, raw.lower() in ("1", "true", "yes"))
                 elif isinstance(default, int):
                     setattr(conf, f.name, int(raw))
-                elif isinstance(default, tuple):
+                elif isinstance(default, (tuple, list)):
                     # comma-separated: ZOO_TPU_MESH_AXES=data,model
                     # ZOO_TPU_MESH_SHAPE=-1,2 (ints where the default is ints)
                     parts = [p.strip() for p in raw.split(",") if p.strip()]
                     if default and all(isinstance(d, int) for d in default):
-                        setattr(conf, f.name, tuple(int(p) for p in parts))
-                    else:
-                        setattr(conf, f.name, tuple(parts))
-                else:
-                    setattr(conf, f.name, raw)
+                        parts = [int(p) for p in parts]
+                    setattr(conf, f.name, type(default)(parts))
+                elif isinstance(default, (str, float)):
+                    setattr(conf, f.name, type(default)(raw))
+                # other field types (dicts, objects) are not env-parseable: skip
         if os.environ.get("ZOO_TPU_PROFILE", "").lower() in ("1", "true", "yes") \
                 and not conf.profile_dir:
             conf.profile_dir = "zoo_tpu_profile"

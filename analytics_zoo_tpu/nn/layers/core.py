@@ -231,14 +231,23 @@ class Merge(Layer):
             xs = ys
         return self._merge(xs), new_state
 
-    def call(self, params, inputs, *, training=False, rng=None):
-        state = self.init_state(self._declared_input_shape)
-        if training and len(jax.tree.leaves(state)) > 0:
-            # A stateful branch (e.g. BatchNormalization) would silently drop
-            # its state updates on this path — the caller must use apply().
+    def call(self, params, inputs, *, training=False, rng=None, state=None):
+        if state is None:
+            state = self.init_state(self._declared_input_shape)
+            if len(jax.tree.leaves(state)) > 0:
+                # A stateful branch (e.g. BatchNormalization) would train with
+                # freshly-initialised statistics here (and drop updates when
+                # training) — the caller must use apply() with explicit state,
+                # or pass the trained state via state= for inference.
+                raise RuntimeError(
+                    f"Merge {self.name!r} has stateful branches; call apply() "
+                    "with explicit state, or pass state= (inference only)")
+        elif training and len(jax.tree.leaves(state)) > 0:
+            # call() drops state updates; a training step through this path
+            # would silently freeze BN statistics.
             raise RuntimeError(
-                f"Merge {self.name!r} has stateful branches; call apply() "
-                "with explicit state instead of call() when training")
+                f"Merge {self.name!r}: state= is inference-only; use apply() "
+                "to carry state updates when training")
         y, _ = self.apply(params, state, inputs, training=training, rng=rng)
         return y
 

@@ -1,0 +1,61 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md round 2):
+
+1. Merge.call with stateful branches must refuse at *inference* too (previously
+   only training=True raised; inference silently used freshly-initialised
+   BatchNorm statistics), and must accept an explicit trained state= kwarg.
+2. ZooConf.from_env must tolerate dataclass fields declared with
+   default_factory (previously getattr(ZooConf, name) raised AttributeError).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import ZooConf
+from analytics_zoo_tpu.nn.layers.core import BatchNormalization, Dense, Merge
+
+
+def _stateful_merge():
+    m = Merge(mode="sum")
+    m.branches = [BatchNormalization(input_shape=(4,), name="bn0"),
+                  Dense(4, input_shape=(4,), name="d0")]
+    m._declared_input_shape = [(None, 4), (None, 4)]
+    return m
+
+
+def test_merge_call_stateful_raises_at_inference(rng):
+    m = _stateful_merge()
+    params = {b.name: b.build(jax.random.PRNGKey(i), (2, 4))
+              for i, b in enumerate(m.branches)}
+    x = [np.asarray(rng.normal(size=(2, 4)), np.float32)] * 2
+    with pytest.raises(RuntimeError, match="stateful"):
+        m.call(params, x, training=False)
+    with pytest.raises(RuntimeError, match="stateful"):
+        m.call(params, x, training=True)
+
+
+def test_merge_call_accepts_explicit_state(rng):
+    m = _stateful_merge()
+    params = {b.name: b.build(jax.random.PRNGKey(i), (2, 4))
+              for i, b in enumerate(m.branches)}
+    state = m.init_state(m._declared_input_shape)
+    x = [np.asarray(rng.normal(size=(2, 4)), np.float32)] * 2
+    y = m.call(params, x, training=False, state=state)
+    y2, _ = m.apply(params, state, x, training=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2))
+    with pytest.raises(RuntimeError, match="inference-only"):
+        m.call(params, x, training=True, state=state)
+
+
+def test_from_env_tolerates_default_factory(monkeypatch):
+    @dataclasses.dataclass
+    class Conf2(ZooConf):
+        extras: list = dataclasses.field(default_factory=list)
+
+    monkeypatch.setenv("ZOO_TPU_SEED", "99")
+    monkeypatch.setenv("ZOO_TPU_EXTRAS", "whatever")
+    conf = Conf2.from_env()          # previously AttributeError on `extras`
+    assert conf.seed == 99
+    assert conf.extras == ["whatever"]   # list fields parse comma-separated
