@@ -5,8 +5,8 @@ from analytics_zoo_tpu.nn.layers.core import (
 from analytics_zoo_tpu.nn.layers.conv import (
     AtrousConvolution1D, AtrousConvolution2D, Convolution1D, Convolution2D,
     Convolution3D, Cropping1D, Cropping2D, Deconvolution2D, LocallyConnected1D,
-    SeparableConvolution2D, UpSampling1D, UpSampling2D, UpSampling3D, ZeroPadding1D,
-    ZeroPadding2D)
+    SeparableConvolution2D, SpaceToDepth, UpSampling1D, UpSampling2D, UpSampling3D,
+    ZeroPadding1D, ZeroPadding2D)
 from analytics_zoo_tpu.nn.layers.pooling import (
     AveragePooling1D, AveragePooling2D, AveragePooling3D, GlobalAveragePooling1D,
     GlobalAveragePooling2D, GlobalAveragePooling3D, GlobalMaxPooling1D,

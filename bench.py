@@ -5,30 +5,42 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 Primary metric = ResNet-50 training MFU (BASELINE.md north star: >= 50% MFU);
 `vs_baseline` = mfu / 0.5.  NCF throughput rides along under "extra".
 
-Methodology notes (axon relay environment): per-dispatch overhead is ~seconds and
-`block_until_ready` does not synchronise through the relay, so the training loop runs
-DEVICE-SIDE — `lax.scan` over steps inside one jitted call — and timing syncs on a
-scalar readback.  That is also the TPU-idiomatic shape for a hot training loop (no
-host round-trips between steps).  ResNet input batches are synthesized device-side
-from a per-trial seed (fresh data defeats relay caching without paying host->HBM
-transfer for steps x 154 MB of images); NCF batches are staged from host.
+FLOP accounting (fixed in round 3): MFU's numerator is the ANALYTIC model
+FLOPs of standard ResNet-50 — sum of 2*H'W'*K^2*Cin*Cout over the conv
+inventory (tools/conv_ceiling.py table) + the FC layer, x3 for fwd+bwd —
+the convention used by MLPerf/scaling-book MFU numbers.  Round 2 divided a
+fwd+bwd step by XLA's cost analysis of a lowering that captured only the
+FORWARD pass (1.04 vs 3.09 TFLOP/step), underreporting MFU 3x (8.5% reported,
+~29% actual).  XLA's cost model on the unscanned step agrees with the analytic
+number within 3% (tools/mfu_debug.py), so both are printed.
 
-FLOPs/step comes from XLA's own cost model on the SINGLE-step lowering
-(`.lower().compile().cost_analysis()['flops']`) — not hand math — then
-MFU = flops_per_step * steps / elapsed / peak.  Peak per chip from device_kind
-(TPU v5 lite: 197 Tbf16-FLOP/s; see table).  Reference harness analog:
-examples/vnni/bigdl/Perf.scala:26-66.
+Timing (fixed in round 3): two-point method — the jitted `lax.fori_loop`
+training loop is timed at n and 5n steps and the rate taken from the
+difference, cancelling the axon relay's ~100ms per-dispatch overhead (which
+was inside round 2's timed window).  Methodology shared with
+tools/conv_ceiling.py; min-of-trials at each point.
 
-Measured environment ceiling (this axon-relayed v5e): huge bf16 matmuls reach
-89% of peak, but RAW `lax.conv_general_dilated` at ResNet-50 shapes tops out at
-~41 TF/s forward and ~9-16 TF/s combined fwd+bwd (measured standalone, outside
-this framework) — so ResNet-50 training MFU here is conv-implementation-bound
-in XLA, not bound by this framework's graph.  The samples/s/chip and MFU below
-are honest end-to-end numbers against the 197 TF/s nameplate.
+Model config: `resnet(50, stem="s2d")` — SpaceToDepth(2) + 4x4/s1 stem,
+mathematically equivalent to the 7x7/s2 stem (weights map exactly via
+`stem_7x7_to_s2d`; tests/test_mfu_opts.py proves both the mapping and the
+full-model equivalence), ~3x faster on the Cin=3-starved MXU stem.  MFU is
+still accounted against the STANDARD 7x7 model FLOPs (the s2d kernel's padded
+taps are implementation overhead, not model work).
+
+Ceiling context (VERDICT r2 #1): extras carry `raw_conv_ceiling_tflops` — the
+aggregate raw `lax.conv_general_dilated` fwd+bwd rate over the full ResNet-50
+conv inventory measured OUTSIDE the framework by tools/conv_ceiling.py on this
+chip — and `framework_vs_conv_ceiling`, the fraction of that ceiling the
+end-to-end framework step achieves.  Pass --ceiling to re-measure live
+(~3 min); by default the last committed measurement for this device kind is
+used (conv_ceiling_cache below, measured 2026-07-30).
+
+Reference harness analog: examples/vnni/bigdl/Perf.scala:26-66.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -43,6 +55,13 @@ _PEAK_FLOPS = [
     ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
 ]
 
+# tools/conv_ceiling.py --trials 3 --batch 128 on this environment's chip:
+# aggregate raw-XLA conv rate over the ResNet-50 inventory (fwd+bwd), and the
+# big-matmul MXU rate, both in TF/s. Re-measure with --ceiling.
+_CONV_CEILING_CACHE = {
+    "TPU v5 lite": {"conv_agg_tflops": 123.36, "matmul_tflops": 176.61},
+}
+
 
 def _peak_flops(device) -> float:
     kind = device.device_kind.lower()
@@ -52,17 +71,35 @@ def _peak_flops(device) -> float:
     return 0.0  # unknown (e.g. CPU) — MFU reported as 0
 
 
-def _time_loop(run, n_trials=5):  # min-of-5: the shared relay is noisy
-    run()  # compile + warmup
-    totals = []
-    for trial in range(n_trials):
-        t0 = time.perf_counter()
-        run(trial + 1)
-        totals.append(time.perf_counter() - t0)
-    return min(totals)
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
 
 
-def bench_resnet50():
+def _steps_per_sec_two_point(run, trials, n_lo):
+    """steps/sec from the (5n-n) time difference; run(n, seed) must vary the
+    input data with seed so the relay cannot serve cached replies. Shares the
+    methodology of tools/conv_ceiling.py:_rate_two_point."""
+    from conv_ceiling import _time
+    n_hi = 5 * n_lo
+    run(n_lo, 0)  # compile + warmup
+    t_lo = _time(run, trials, n_lo)
+    t_hi = _time(run, trials, n_hi)
+    return (n_hi - n_lo) / max(t_hi - t_lo, 1e-9)
+
+
+def resnet50_model_flops(batch: int, num_classes: int = 1000) -> float:
+    """Analytic fwd FLOPs of standard ResNet-50 at 224x224 (2*MACs)."""
+    from conv_ceiling import RESNET50_CONVS, conv_flops
+    fl = sum(conv_flops(batch, h, cin, cout, k, s) * cnt
+             for (_, h, cin, cout, k, s, cnt) in RESNET50_CONVS)
+    fl += 2.0 * batch * 2048 * num_classes  # FC
+    return fl
+
+
+def bench_resnet50(trials=3, with_ceiling=False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -74,87 +111,101 @@ def bench_resnet50():
 
     dtypes.mixed_bf16()
     # Single-chip by construction: the loop is plain jax.jit (no mesh), so it
-    # executes on device 0 regardless of how many chips are attached — sizing
-    # or dividing by device count here would misreport on multi-chip hosts.
+    # executes on device 0 regardless of how many chips are attached.
     batch = 128
-    steps = 10
-    H = W = 224
 
-    model = resnet(50, num_classes=1000)
+    model = resnet(50, num_classes=1000, stem="s2d")
     params, state = model.init(jax.random.PRNGKey(0))
     opt = SGD(lr=0.1, momentum=0.9)
     opt_state = opt.init(params)
     loss_fn = objectives.get("sparse_categorical_crossentropy")
 
-    # One staged batch reused across scan steps: device-side jax.random image
-    # synthesis costs as much as the whole forward pass (~10 ms/step measured),
-    # and the compute is data-independent, so reuse doesn't distort timing.
-    def make_step(imgs, labels):
-        def one_step(carry, _):
-            params, opt_state, state = carry
+    def make_train_step(imgs, labels):
+        def train_step(p, o, s):
+            def loss_of(pp):
+                y_pred, s2 = model.apply(pp, s, imgs, training=True, rng=None)
+                return loss_fn(y_pred, labels).mean(), s2
+            (_, s2), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, o, s2
+        return train_step
 
-            def loss_of(p):
-                y_pred, new_state = model.apply(p, state, imgs, training=True,
-                                                rng=None)
-                return loss_fn(y_pred, labels).mean(), new_state
-
-            (l, new_state), grads = jax.value_and_grad(loss_of,
-                                                       has_aux=True)(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state, new_state), l
-        return one_step
-
-    def gen_data(seed):
-        # Synthesized ON DEVICE from a scalar seed: shipping a real 77 MB image
-        # batch through the axon relay host->device path dominates the timing,
-        # and regenerating per scan step costs a forward pass worth of time —
-        # so generate once per call, outside the scan.
+    @jax.jit
+    def train_loop(params, opt_state, state, n, seed):
+        # One device-synthesized batch per call, derived from the seed so no
+        # two timing dispatches are byte-identical (the relay must not serve
+        # cached replies); reused across loop steps — the compute is
+        # data-independent and the params (the loop carry) change every step,
+        # so nothing is hoistable.
         r_img, r_lbl = jax.random.split(jax.random.PRNGKey(seed))
-        imgs = jax.random.normal(r_img, (batch, H, W, 3), jnp.float32)
-        imgs = imgs.astype(jnp.bfloat16)
-        labels = jax.random.randint(r_lbl, (batch, 1), 0, 1000)
-        return imgs, labels.astype(jnp.float32)
+        imgs = jax.random.normal(r_img, (batch, 224, 224, 3), jnp.bfloat16)
+        labels = jax.random.randint(r_lbl, (batch, 1), 0, 1000) \
+                    .astype(jnp.float32)
+        step = make_train_step(imgs, labels)
 
-    @jax.jit
-    def train_loop(params, opt_state, state, seed):
-        # imgs/labels are scan-loop invariants (closed over), not scan carry —
-        # carrying the 77 MB image tensor through the loop cost 4x throughput.
-        imgs, labels = gen_data(seed)
-        (params, opt_state, state), losses = jax.lax.scan(
-            make_step(imgs, labels), (params, opt_state, state), None,
-            length=steps)
-        return jnp.sum(losses)
+        def body(i, c):
+            return step(*c)
+        p, o, s = jax.lax.fori_loop(0, n, body, (params, opt_state, state))
+        return jax.tree.leaves(p)[0].sum()
 
-    # FLOPs from XLA's cost model on a single step (scan bodies are counted
-    # once in the scanned lowering, so account on the unrolled single step).
-    @jax.jit
-    def single_step(params, opt_state, state, seed):
-        imgs, labels = gen_data(seed)
-        return make_step(imgs, labels)((params, opt_state, state), None)[1]
+    def run(n, seed=0):
+        float(train_loop(params, opt_state, state, n, seed))
 
-    cost = single_step.lower(params, opt_state, state,
-                             0).compile().cost_analysis()
-    flops_per_step = float(cost.get("flops", 0.0))
+    steps_per_sec = _steps_per_sec_two_point(run, trials, n_lo=8)
 
-    def run(seed=0):
-        float(train_loop(params, opt_state, state, seed))
+    analytic_fwd = resnet50_model_flops(batch)
+    flops_per_step = 3.0 * analytic_fwd          # fwd + input-grad + weight-grad
+    # cross-check: XLA's own cost model on the unscanned step
+    key = jax.random.PRNGKey(1)
+    imgs0 = jax.random.normal(key, (batch, 224, 224, 3), jnp.bfloat16)
+    labels0 = jax.random.randint(key, (batch, 1), 0, 1000).astype(jnp.float32)
+    single = jax.jit(lambda p, o, s: make_train_step(imgs0, labels0)(p, o, s)[0])
+    cost = single.lower(params, opt_state, state).compile().cost_analysis()
+    xla_flops = float(cost.get("flops", 0.0))
 
-    dt = _time_loop(run)
-    per_chip = batch * steps / dt
+    per_chip = batch * steps_per_sec
     peak = _peak_flops(jax.devices()[0])
-    mfu = (flops_per_step * steps / dt) / peak if peak else 0.0
-    return {
+    mfu = flops_per_step * steps_per_sec / peak if peak else 0.0
+
+    out = {
         "resnet50_train_samples_per_sec_per_chip": round(per_chip, 1),
         "resnet50_mfu": round(mfu, 4),
-        "resnet50_flops_per_step": flops_per_step,
+        "resnet50_step_time_ms": round(1000.0 / steps_per_sec, 2),
+        "resnet50_flops_per_step_analytic": flops_per_step,
+        "resnet50_flops_per_step_xla_cost_model": xla_flops,
         "resnet50_batch_per_chip": batch,
+        "resnet50_stem": "s2d (7x7-equivalent, tests/test_mfu_opts.py)",
         "device_kind": jax.devices()[0].device_kind,
         "peak_flops_per_chip": peak,
     }
 
+    ceiling = None
+    if with_ceiling:
+        import subprocess
+        probe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools", "conv_ceiling.py")
+        r = subprocess.run([sys.executable, probe, "--trials", "2"],
+                           capture_output=True, text=True)
+        try:
+            c = json.loads(r.stdout.strip().splitlines()[-1])
+            ceiling = {"conv_agg_tflops": c["resnet50_conv_agg_tflops"],
+                       "matmul_tflops": c["matmul_8k_tflops"]}
+        except Exception:
+            ceiling = None
+    if ceiling is None:
+        ceiling = _CONV_CEILING_CACHE.get(jax.devices()[0].device_kind)
+    if ceiling:
+        out["raw_conv_ceiling_tflops"] = ceiling["conv_agg_tflops"]
+        out["raw_matmul_tflops"] = ceiling["matmul_tflops"]
+        achieved = flops_per_step * steps_per_sec / 1e12
+        out["framework_tflops"] = round(achieved, 2)
+        out["framework_vs_conv_ceiling"] = round(
+            achieved / ceiling["conv_agg_tflops"], 3)
+    return out
 
-def bench_ncf():
+
+def bench_ncf(trials=3):
     import jax
     import jax.numpy as jnp
     import optax
@@ -177,47 +228,35 @@ def bench_ncf():
     loss_fn = objectives.get("sparse_categorical_crossentropy")
 
     batch = 8192  # single-chip loop, as in bench_resnet50
-    steps = 50
-
-    def one_step(carry, batch_data):
-        params, opt_state, state = carry
-        users, items, labels = batch_data
-
-        def loss_of(p):
-            y_pred, new_state = model.apply(p, state, [users, items],
-                                            training=True, rng=None)
-            return loss_fn(y_pred, labels).mean(), new_state
-
-        (l, new_state), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state, new_state), l
 
     @jax.jit
-    def train_loop(params, opt_state, state, users, items, labels):
-        (params, opt_state, state), losses = jax.lax.scan(
-            one_step, (params, opt_state, state), (users, items, labels))
-        return jnp.sum(losses)
+    def train_loop(params, opt_state, state, n, seed):
+        # device-synthesized ids, seed-varied per dispatch (no relay caching)
+        ru, ri, rl = jax.random.split(jax.random.PRNGKey(seed), 3)
+        users = jax.random.randint(ru, (batch, 1), 1, 6041).astype(jnp.float32)
+        items = jax.random.randint(ri, (batch, 1), 1, 3707).astype(jnp.float32)
+        labels = jax.random.randint(rl, (batch, 1), 0, 2).astype(jnp.float32)
 
-    def fresh_data(seed):
-        g = np.random.default_rng(seed)
-        users = g.integers(1, 6041, (steps, batch, 1)).astype(np.float32)
-        items = g.integers(1, 3707, (steps, batch, 1)).astype(np.float32)
-        labels = g.integers(0, 2, (steps, batch, 1)).astype(np.float32)
-        return users, items, labels
+        def train_step(p, o, s):
+            def loss_of(pp):
+                y_pred, s2 = model.apply(pp, s, [users, items], training=True,
+                                         rng=None)
+                return loss_fn(y_pred, labels).mean(), s2
+            (_, s2), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, o, s2
 
-    # Host-side numpy generation AND the host->device transfer stay OUTSIDE
-    # the timed window: the relay transfer path has multi-hundred-ms jitter
-    # that would otherwise dominate the ~0.4 s device loop being measured.
-    import jax as _jax
-    staged = {seed: tuple(_jax.device_put(a) for a in fresh_data(seed))
-              for seed in range(6)}
+        def body(i, c):
+            return train_step(*c)
+        p, o, s = jax.lax.fori_loop(0, n, body, (params, opt_state, state))
+        return jax.tree.leaves(p)[0].sum()
 
-    def run(seed=0):
-        float(train_loop(params, opt_state, state, *staged[seed]))
+    def run(n, seed=0):
+        float(train_loop(params, opt_state, state, n, seed))
 
-    dt = _time_loop(run)
-    per_chip = batch * steps / dt
+    steps_per_sec = _steps_per_sec_two_point(run, trials, n_lo=200)
+    per_chip = batch * steps_per_sec
     return {
         "ncf_train_samples_per_sec_per_chip": round(per_chip, 1),
         "ncf_vs_1e6_ref": round(per_chip / NCF_BASELINE_SAMPLES_PER_SEC, 3),
@@ -225,8 +264,14 @@ def bench_ncf():
 
 
 def main():
-    res = bench_resnet50()
-    ncf = bench_ncf()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ceiling", action="store_true",
+                    help="re-measure the raw conv ceiling live (~3 min)")
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    res = bench_resnet50(trials=args.trials, with_ceiling=args.ceiling)
+    ncf = bench_ncf(trials=args.trials)
     mfu = res["resnet50_mfu"]
     print(json.dumps({
         "metric": "resnet50_train_mfu",
