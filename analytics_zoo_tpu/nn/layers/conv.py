@@ -155,9 +155,26 @@ class Deconvolution2D(Layer):
         if th:
             x = jnp.transpose(x, (0, 2, 3, 1))
         xw, W = dtypes.cast_compute(x, params["W"])
-        y = jax.lax.conv_transpose(
-            xw, W, strides=self.subsample, padding=_pad_str(self.border_mode),
-            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+        # True fractionally-strided conv (the gradient of the forward conv —
+        # keras Conv2DTranspose semantics, which lax.conv_transpose does NOT
+        # reproduce for strided/SAME configs): dilate the input by the stride
+        # and convolve with the spatially-flipped kernel at stride 1.
+        Wt = W.transpose(0, 1, 3, 2)[::-1, ::-1]       # (kh,kw,out,in)->HWIO
+        pads = []
+        for k, s in zip(self.kernel_size, self.subsample):
+            if self.border_mode in ("same", "SAME"):
+                ptf = max(k - s, 0)                    # fwd-conv SAME padding
+                plo = ptf // 2
+                # the max(s-k, 0) term keeps output size i*s when k < s
+                pads.append((k - 1 - plo,
+                             k - 1 - (ptf - plo) + max(s - k, 0)))
+            else:
+                pads.append((k - 1, k - 1))
+        y = jax.lax.conv_general_dilated(
+            xw, Wt, window_strides=(1, 1), padding=pads,
+            lhs_dilation=self.subsample,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                xw.shape, Wt.shape, ("NHWC", "HWIO", "NHWC")),
             preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
@@ -237,14 +254,21 @@ class ZeroPadding1D(Layer):
 class ZeroPadding2D(Layer):
     def __init__(self, padding=(1, 1), dim_ordering="tf", **kwargs):
         super().__init__(**kwargs)
-        self.padding = _pair(padding)
+        # symmetric (ph, pw), or asymmetric ((top, bottom), (left, right))
+        if (isinstance(padding, (tuple, list)) and padding
+                and isinstance(padding[0], (tuple, list))):
+            self.padding = (tuple(int(v) for v in padding[0]),
+                            tuple(int(v) for v in padding[1]))
+        else:
+            ph, pw = _pair(padding)
+            self.padding = ((ph, ph), (pw, pw))
         self.dim_ordering = dim_ordering
 
     def call(self, params, x, *, training=False, rng=None):
         ph, pw = self.padding
         if self.dim_ordering == "th":
-            return jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+            return jnp.pad(x, ((0, 0), (0, 0), ph, pw))
+        return jnp.pad(x, ((0, 0), ph, pw, (0, 0)))
 
 
 class Cropping1D(Layer):
@@ -355,15 +379,6 @@ class SpaceToDepth(Layer):
     def __init__(self, block_size=2, **kwargs):
         super().__init__(**kwargs)
         self.block = int(block_size)
-
-    def output_shape(self, input_shape):
-        h, w, c = to_shape(input_shape)
-        b = self.block
-        if (h is not None and h % b) or (w is not None and w % b):
-            raise ValueError(
-                f"SpaceToDepth({b}): spatial dims {(h, w)} must be divisible "
-                f"by block_size")
-        return (h // b, w // b, c * b * b)
 
     def call(self, params, x, *, training=False, rng=None):
         b = self.block

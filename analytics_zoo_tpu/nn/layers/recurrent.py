@@ -114,10 +114,14 @@ class GRU(_RNNBase):
         xw, Wx, Wh = dtypes.cast_compute(x_t, params["Wx"], params["Wh"])
         hw = dtypes.cast_compute(h)
         xz = jnp.matmul(xw, Wx, preferred_element_type=jnp.float32) + params["b"]
-        hz = jnp.matmul(hw, Wh, preferred_element_type=jnp.float32)
+        hz = jnp.matmul(hw, Wh[:, :2 * H], preferred_element_type=jnp.float32)
         z = self.inner_activation(xz[:, :H] + hz[:, :H])
         r = self.inner_activation(xz[:, H:2 * H] + hz[:, H:2 * H])
-        hh = self.activation(xz[:, 2 * H:] + r * hz[:, 2 * H:])
+        # reset gate applied to h BEFORE the candidate matmul (keras-1/BigDL
+        # GRU semantics, reset_after=False; verified vs tf.keras oracle)
+        rh = dtypes.cast_compute(r * h)
+        hc = jnp.matmul(rh, Wh[:, 2 * H:], preferred_element_type=jnp.float32)
+        hh = self.activation(xz[:, 2 * H:] + hc)
         h_new = z * h + (1 - z) * hh
         return h_new, h_new
 

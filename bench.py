@@ -49,26 +49,12 @@ import numpy as np
 NCF_BASELINE_SAMPLES_PER_SEC = 1_000_000.0  # round-1 reference point
 MFU_TARGET = 0.5                            # BASELINE.md north star
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
-_PEAK_FLOPS = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
-]
-
 # tools/conv_ceiling.py --trials 3 --batch 128 on this environment's chip:
 # aggregate raw-XLA conv rate over the ResNet-50 inventory (fwd+bwd), and the
 # big-matmul MXU rate, both in TF/s. Re-measure with --ceiling.
 _CONV_CEILING_CACHE = {
-    "TPU v5 lite": {"conv_agg_tflops": 123.36, "matmul_tflops": 176.61},
+    "TPU v5 lite": {"conv_agg_tflops": 122.02, "matmul_tflops": 168.77},
 }
-
-
-def _peak_flops(device) -> float:
-    kind = device.device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return 0.0  # unknown (e.g. CPU) — MFU reported as 0
 
 
 import os
@@ -77,17 +63,13 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tools"))
 
+from conv_ceiling import _rate_two_point, peak_flops as _peak_flops  # noqa: E402
+
 
 def _steps_per_sec_two_point(run, trials, n_lo):
     """steps/sec from the (5n-n) time difference; run(n, seed) must vary the
-    input data with seed so the relay cannot serve cached replies. Shares the
-    methodology of tools/conv_ceiling.py:_rate_two_point."""
-    from conv_ceiling import _time
-    n_hi = 5 * n_lo
-    run(n_lo, 0)  # compile + warmup
-    t_lo = _time(run, trials, n_lo)
-    t_hi = _time(run, trials, n_hi)
-    return (n_hi - n_lo) / max(t_hi - t_lo, 1e-9)
+    input data with seed so the relay cannot serve cached replies."""
+    return _rate_two_point(run, 1.0, trials, n_lo)
 
 
 def resnet50_model_flops(batch: int, num_classes: int = 1000) -> float:

@@ -86,12 +86,34 @@ def _time(run, trials, n):
 def _rate_two_point(run, flops_per_iter, trials, n_lo):
     """FLOP/s from the (5n - n) time difference: immune to constant dispatch
     overhead, which on the axon relay is ~100ms per call."""
+    import inspect
     n_hi = 5 * n_lo
-    run(n_lo)  # compile + warmup (dynamic trip count: one compile total)
+    # compile + warmup; out-of-band trial index so the warmup dispatch is not
+    # byte-identical to timed trial 0 (dynamic trip count: one compile total)
+    if len(inspect.signature(run).parameters) > 1:
+        run(n_lo, trials)
+    else:
+        run(n_lo)
     t_lo = _time(run, trials, n_lo)
     t_hi = _time(run, trials, n_hi)
     dt = max(t_hi - t_lo, 1e-9)
     return flops_per_iter * (n_hi - n_lo) / dt
+
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
+# Single source of truth — bench.py and tools/mfu_debug.py import these.
+PEAK_FLOPS_TABLE = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+]
+
+
+def peak_flops(device) -> float:
+    kind = device.device_kind.lower()
+    for key, peak in PEAK_FLOPS_TABLE:
+        if key in kind:
+            return peak
+    return 0.0  # unknown (e.g. CPU) — MFU reported as 0
 
 
 def probe_conv(batch, h, cin, cout, k, stride, trials, mode):
@@ -130,8 +152,10 @@ def probe_conv(batch, h, cin, cout, k, stride, trials, mode):
     x = jax.random.normal(key, (batch, h, h, cin), jnp.bfloat16)
     w = jax.random.normal(key, (k, k, cin, cout), jnp.bfloat16)
 
-    def run(n):
-        float(loop(x, w, n))
+    def run(n, trial=0):
+        # trial-perturbed weights: no two timing dispatches are byte-identical,
+        # so the relay cannot serve cached replies
+        float(loop(x, w + jnp.bfloat16(trial * 1e-8), n))
 
     # fwd = 1x; fwd+both grads = 3x fwd FLOPs (standard accounting)
     factor = {"fwd": 1.0, "both": 3.0}[mode]
@@ -158,8 +182,8 @@ def probe_matmul(trials, m=8192, n=8192, kdim=8192):
     a = jax.random.normal(key, (m, kdim), jnp.bfloat16)
     b = jax.random.normal(key, (kdim, n), jnp.bfloat16)
 
-    def run(nn):
-        float(loop(a, b, nn))
+    def run(nn, trial=0):
+        float(loop(a, b + jnp.bfloat16(trial * 1e-8), nn))
 
     fl = 2.0 * m * n * kdim
     return _rate_two_point(run, fl, trials, max(8, int(25e12 / fl)))
@@ -214,10 +238,7 @@ def main():
     # convs are ~95+% of ResNet FLOPs; BN/relu/pool are bandwidth-bound and
     # partially fused, so the honest ceiling is slightly below the conv
     # aggregate. Report the conv aggregate vs nameplate peak.
-    peaks = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v4": 275e12,
-             "v6": 918e12, "v3": 123e12}
-    peak = next((v for kk, v in peaks.items()
-                 if kk in dev.device_kind.lower()), 0.0)
+    peak = peak_flops(dev)
     if peak:
         out["conv_ceiling_mfu"] = round(agg / peak, 4)
         out["matmul_mfu"] = round(mm / peak, 4)

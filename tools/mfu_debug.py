@@ -148,7 +148,8 @@ def main():
 
     import jax
 
-    peak = 197e12 if "v5" in jax.devices()[0].device_kind.lower() else 0.0
+    from conv_ceiling import peak_flops
+    peak = peak_flops(jax.devices()[0])
     out = {}
     for v in args.variants.split(","):
         batch = 256 if v == "b256" else 128
