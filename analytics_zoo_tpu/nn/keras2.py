@@ -13,6 +13,15 @@ from analytics_zoo_tpu.nn.layers import conv as _conv
 from analytics_zoo_tpu.nn.layers import pooling as _pool
 
 
+def _do(data_format):
+    """keras2 data_format -> internal dim_ordering."""
+    if data_format in ("channels_last", "tf", None):
+        return "tf"
+    if data_format in ("channels_first", "th"):
+        return "th"
+    raise ValueError(f"unknown data_format {data_format!r}")
+
+
 def Dense(units, activation=None, kernel_initializer="glorot_uniform",
           use_bias=True, **kw):
     return _core.Dense(units, activation=activation, init=kernel_initializer,
@@ -96,32 +105,59 @@ def GlobalAveragePooling2D(data_format="channels_last", **kw):
 
 # -- merge-op classes (keras2/layers/merge) ----------------------------------
 
-def Add(**kw):
-    return _core.Merge(mode="sum", **kw)
+class Add(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="sum", **kw)
 
 
-def Multiply(**kw):
-    return _core.Merge(mode="mul", **kw)
+class Subtract(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="sub", **kw)
 
 
-def Average(**kw):
-    return _core.Merge(mode="ave", **kw)
+class Multiply(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="mul", **kw)
 
 
-def Maximum(**kw):
-    return _core.Merge(mode="max", **kw)
+class Average(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="ave", **kw)
 
 
-def Minimum(**kw):
-    return _core.Merge(mode="min", **kw)
+class Maximum(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="max", **kw)
 
 
-def Concatenate(axis=-1, **kw):
-    return _core.Merge(mode="concat", concat_axis=axis, **kw)
+class Minimum(_core.Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="min", **kw)
+
+
+class Concatenate(_core.Merge):
+    def __init__(self, axis=-1, **kw):
+        super().__init__(mode="concat", concat_axis=axis, **kw)
+
+
+class Dot(_core.Merge):
+    """Batched dot of two rank-2 (B, d) inputs along the feature axis;
+    normalize=True gives cosine proximity (keras2/layers/merge Dot)."""
+
+    def __init__(self, axes=1, normalize=False, **kw):
+        if axes not in (1, -1):
+            raise NotImplementedError(
+                "Dot currently supports rank-2 inputs dotted along the "
+                f"feature axis (axes=1); got axes={axes!r}")
+        super().__init__(mode="cos" if normalize else "dot", **kw)
 
 
 def add(inputs, **kw):
     return Add(**kw)(list(inputs))
+
+
+def subtract(inputs, **kw):
+    return Subtract(**kw)(list(inputs))
 
 
 def multiply(inputs, **kw):
@@ -136,5 +172,109 @@ def maximum(inputs, **kw):
     return Maximum(**kw)(list(inputs))
 
 
+def minimum(inputs, **kw):
+    return Minimum(**kw)(list(inputs))
+
+
 def concatenate(inputs, axis=-1, **kw):
     return Concatenate(axis=axis, **kw)(list(inputs))
+
+
+def dot(inputs, normalize=False, **kw):
+    return Dot(normalize=normalize, **kw)(list(inputs))
+
+
+# -- further keras2 constructor aliases ---------------------------------------
+
+def Conv3D(filters, kernel_size, strides=1, padding="valid", activation=None,
+           kernel_initializer="glorot_uniform", use_bias=True,
+           data_format="channels_last", **kw):
+    return _conv.Convolution3D(filters, kernel_size, activation=activation,
+                               border_mode=padding, subsample=strides,
+                               init=kernel_initializer, bias=use_bias,
+                               dim_ordering=_do(data_format), **kw)
+
+
+def Conv2DTranspose(filters, kernel_size, strides=1, padding="valid",
+                    activation=None, kernel_initializer="glorot_uniform",
+                    use_bias=True, data_format="channels_last", **kw):
+    return _conv.Deconvolution2D(filters, kernel_size, activation=activation,
+                                 subsample=strides, border_mode=padding,
+                                 init=kernel_initializer, bias=use_bias,
+                                 dim_ordering=_do(data_format), **kw)
+
+
+def SeparableConv2D(filters, kernel_size, strides=1, padding="valid",
+                    depth_multiplier=1, activation=None, use_bias=True,
+                    data_format="channels_last", **kw):
+    return _conv.SeparableConvolution2D(
+        filters, kernel_size, depth_multiplier=depth_multiplier,
+        activation=activation, subsample=strides, border_mode=padding,
+        bias=use_bias, dim_ordering=_do(data_format), **kw)
+
+
+def MaxPooling3D(pool_size=2, strides=None, padding="valid",
+                 data_format="channels_last", **kw):
+    return _pool.MaxPooling3D(pool_size, strides=strides, border_mode=padding,
+                              dim_ordering=_do(data_format), **kw)
+
+
+def AveragePooling3D(pool_size=2, strides=None, padding="valid",
+                     data_format="channels_last", **kw):
+    return _pool.AveragePooling3D(pool_size, strides=strides,
+                                  border_mode=padding,
+                                  dim_ordering=_do(data_format), **kw)
+
+
+def GlobalMaxPooling2D(data_format="channels_last", **kw):
+    return _pool.GlobalMaxPooling2D(dim_ordering=_do(data_format), **kw)
+
+
+def GlobalMaxPooling3D(data_format="channels_last", **kw):
+    return _pool.GlobalMaxPooling3D(dim_ordering=_do(data_format), **kw)
+
+
+def GlobalAveragePooling1D(data_format="channels_last", **kw):
+    return _pool.GlobalAveragePooling1D(dim_ordering=_do(data_format), **kw)
+
+
+def GlobalAveragePooling3D(data_format="channels_last", **kw):
+    return _pool.GlobalAveragePooling3D(dim_ordering=_do(data_format), **kw)
+
+
+def UpSampling2D(size=(2, 2), **kw):
+    return _conv.UpSampling2D(size, **kw)
+
+
+def ZeroPadding2D(padding=(1, 1), **kw):
+    return _conv.ZeroPadding2D(padding, **kw)
+
+
+def Cropping2D(cropping=((0, 0), (0, 0)), **kw):
+    return _conv.Cropping2D(cropping, **kw)
+
+
+def LSTM(units, activation="tanh", recurrent_activation="hard_sigmoid",
+         return_sequences=False, go_backwards=False, **kw):
+    from analytics_zoo_tpu.nn.layers import recurrent as _rnn
+    return _rnn.LSTM(units, activation=activation,
+                     inner_activation=recurrent_activation,
+                     return_sequences=return_sequences,
+                     go_backwards=go_backwards, **kw)
+
+
+def GRU(units, activation="tanh", recurrent_activation="hard_sigmoid",
+        return_sequences=False, go_backwards=False, **kw):
+    from analytics_zoo_tpu.nn.layers import recurrent as _rnn
+    return _rnn.GRU(units, activation=activation,
+                    inner_activation=recurrent_activation,
+                    return_sequences=return_sequences,
+                    go_backwards=go_backwards, **kw)
+
+
+def SimpleRNN(units, activation="tanh", return_sequences=False,
+              go_backwards=False, **kw):
+    from analytics_zoo_tpu.nn.layers import recurrent as _rnn
+    return _rnn.SimpleRNN(units, activation=activation,
+                          return_sequences=return_sequences,
+                          go_backwards=go_backwards, **kw)

@@ -4,15 +4,24 @@ from analytics_zoo_tpu.nn.layers.core import (
     RepeatVector, Reshape, Select, Squeeze, merge)
 from analytics_zoo_tpu.nn.layers.conv import (
     AtrousConvolution1D, AtrousConvolution2D, Convolution1D, Convolution2D,
-    Convolution3D, Cropping1D, Cropping2D, Deconvolution2D, LocallyConnected1D,
-    SeparableConvolution2D, SpaceToDepth, UpSampling1D, UpSampling2D, UpSampling3D,
-    ZeroPadding1D, ZeroPadding2D)
+    Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
+    LocallyConnected1D, LocallyConnected2D, LRN2D, ResizeBilinear,
+    SeparableConvolution2D, ShareConvolution2D, SpaceToDepth, UpSampling1D,
+    UpSampling2D, UpSampling3D, ZeroPadding1D, ZeroPadding2D, ZeroPadding3D)
 from analytics_zoo_tpu.nn.layers.pooling import (
     AveragePooling1D, AveragePooling2D, AveragePooling3D, GlobalAveragePooling1D,
     GlobalAveragePooling2D, GlobalAveragePooling3D, GlobalMaxPooling1D,
     GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D, MaxPooling2D, MaxPooling3D)
 from analytics_zoo_tpu.nn.layers.recurrent import (
-    GRU, LSTM, Bidirectional, ConvLSTM2D, Highway, SimpleRNN, TimeDistributed)
+    GRU, LSTM, Bidirectional, ConvLSTM2D, ConvLSTM3D, Highway, SimpleRNN,
+    TimeDistributed)
+from analytics_zoo_tpu.nn.layers.math import (
+    AddConstant, BinaryThreshold, CAdd, CMul, Exp, Expand, GaussianSampler,
+    GetShape, HardShrink, HardTanh, Identity, Log, Max, Mul, MulConstant,
+    Negative, Power, RReLU, Scale, SelectTable, Softmax, SoftShrink,
+    SplitTensor, Sqrt, Square, Threshold)
+from analytics_zoo_tpu.nn.layers.embedding import (
+    SparseDense, SparseEmbedding, WordEmbedding)
 from analytics_zoo_tpu.nn.layers.advanced import (
     ELU, LeakyReLU, MaxoutDense, PReLU, SReLU, SpatialDropout1D, SpatialDropout2D,
     ThresholdedReLU, WithinChannelLRN2D)

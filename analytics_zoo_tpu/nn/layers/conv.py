@@ -406,3 +406,169 @@ def stem_7x7_to_s2d(w7: jnp.ndarray) -> jnp.ndarray:
     w = wpad.reshape(4, 2, 4, 2, cin, cout)        # (a, dh, b, dw, c, o)
     w = w.transpose(0, 2, 1, 3, 4, 5)              # (a, b, dh, dw, c, o)
     return w.reshape(4, 4, 4 * cin, cout)
+
+
+class LocallyConnected2D(Layer):
+    """Unshared-weights 2D conv (LocallyConnected2D.scala): each output
+    position has its own kernel.  Implemented as patch extraction + one big
+    einsum — a single MXU contraction instead of H'*W' small convs."""
+
+    def __init__(self, nb_filter, nb_row, nb_col=None, activation=None,
+                 subsample=1, bias=True, init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col if nb_col is not None
+                                             else nb_row))
+        self.activation = activations.get(activation)
+        self.subsample = _pair(subsample)
+        self.bias = bias
+        self.init_name = init
+
+    def _out_hw(self, H, W):
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        return (H - kh) // sh + 1, (W - kw) // sw + 1
+
+    def build(self, rng, input_shape):
+        H, W, C = to_shape(input_shape)
+        oh, ow = self._out_hw(H, W)
+        kh, kw = self.kernel_size
+        p = {"W": initializer(self.init_name, rng,
+                              (oh * ow, kh * kw * C, self.nb_filter),
+                              dtypes.param_dtype(),
+                              fan_in=kh * kw * C,
+                              fan_out=self.nb_filter)}
+        if self.bias:
+            p["b"] = jnp.zeros((oh, ow, self.nb_filter), dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        B, H, W, C = x.shape
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        oh, ow = self._out_hw(H, W)
+        # extract (B, oh, ow, kh, kw, C) patches via gather on row/col indices
+        ri = (jnp.arange(oh) * sh)[:, None] + jnp.arange(kh)[None, :]
+        ci = (jnp.arange(ow) * sw)[:, None] + jnp.arange(kw)[None, :]
+        patches = x[:, ri][:, :, :, ci]          # (B, oh, kh, ow, kw, C)
+        patches = patches.transpose(0, 1, 3, 2, 4, 5) \
+                         .reshape(B, oh * ow, kh * kw * C)
+        xw, W_ = dtypes.cast_compute(patches, params["W"])
+        y = jnp.einsum("bpk,pko->bpo", xw, W_,
+                       preferred_element_type=dtypes.param_dtype())
+        y = y.reshape(B, oh, ow, self.nb_filter)
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y)
+
+
+class ShareConvolution2D(_ConvND):
+    """Conv2D with explicit asymmetric-capable padH/padW (ShareConvolution2D.scala;
+    the 'shared buffer' aspect is a BigDL memory detail with no XLA analog —
+    capability surface = conv with explicit pad)."""
+
+    ndim = 2
+
+    def __init__(self, nb_filter, kernel_size, pad_h=0, pad_w=0, **kwargs):
+        super().__init__(nb_filter, kernel_size, border_mode="valid", **kwargs)
+        self.pad_h = int(pad_h)
+        self.pad_w = int(pad_w)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self.pad_h or self.pad_w:
+            th = self.dim_ordering == "th"
+            pads = ((0, 0), (0, 0), (self.pad_h, self.pad_h),
+                    (self.pad_w, self.pad_w)) if th else \
+                   ((0, 0), (self.pad_h, self.pad_h),
+                    (self.pad_w, self.pad_w), (0, 0))
+            x = jnp.pad(x, pads)
+        return super().call(params, x, training=training, rng=rng)
+
+
+class ZeroPadding3D(Layer):
+    """Pad the 3 spatial dims of a (B, D1, D2, D3, C) tensor
+    (ZeroPadding3D.scala, channels-last)."""
+
+    def __init__(self, padding=(1, 1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.padding = tuple(int(p) for p in padding)
+
+    def call(self, params, x, *, training=False, rng=None):
+        p1, p2, p3 = self.padding
+        return jnp.pad(x, ((0, 0), (p1, p1), (p2, p2), (p3, p3), (0, 0)))
+
+
+class Cropping3D(Layer):
+    """Crop the 3 spatial dims of a (B, D1, D2, D3, C) tensor
+    (Cropping3D.scala, channels-last)."""
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple((int(a), int(b)) for a, b in cropping)
+
+    def call(self, params, x, *, training=False, rng=None):
+        (a1, b1), (a2, b2), (a3, b3) = self.cropping
+        return x[:, a1:x.shape[1] - b1, a2:x.shape[2] - b2,
+                 a3:x.shape[3] - b3, :]
+
+
+class ResizeBilinear(Layer):
+    """Bilinear resize of (B, H, W, C) images (ResizeBilinear.scala).
+
+    Reproduces the reference's TF1 `resize_bilinear` sampling grid exactly
+    (src = dst * in/out with NO half-pixel offset; align_corners uses the
+    (in-1)/(out-1) grid) — `jax.image.resize` uses half-pixel centers +
+    antialiasing and does not match the BigDL/TF1 numerics."""
+
+    def __init__(self, output_height, output_width, align_corners=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.oh = int(output_height)
+        self.ow = int(output_width)
+        self.align_corners = bool(align_corners)
+
+    def _grid(self, n_in, n_out):
+        if self.align_corners and n_out > 1:
+            src = jnp.arange(n_out) * ((n_in - 1) / (n_out - 1))
+        else:
+            src = jnp.arange(n_out) * (n_in / n_out)
+        i0 = jnp.floor(src).astype(jnp.int32)
+        i0 = jnp.clip(i0, 0, n_in - 1)
+        i1 = jnp.minimum(i0 + 1, n_in - 1)
+        frac = (src - i0).astype(jnp.float32)
+        return i0, i1, frac
+
+    def call(self, params, x, *, training=False, rng=None):
+        B, H, W, C = x.shape
+        y0, y1, fy = self._grid(H, self.oh)
+        x0, x1, fx = self._grid(W, self.ow)
+        dt = x.dtype
+        xf = x.astype(jnp.float32)
+        top = xf[:, y0][:, :, x0] * (1 - fx)[None, None, :, None] \
+            + xf[:, y0][:, :, x1] * fx[None, None, :, None]
+        bot = xf[:, y1][:, :, x0] * (1 - fx)[None, None, :, None] \
+            + xf[:, y1][:, :, x1] * fx[None, None, :, None]
+        out = top * (1 - fy)[None, :, None, None] \
+            + bot * fy[None, :, None, None]
+        return out.astype(dt)
+
+
+class LRN2D(Layer):
+    """Cross-channel local response normalization (LRN2D.scala, NHWC):
+    y = x / (k + alpha/n * sum_{local n channels} x^2)^beta."""
+
+    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+        self.k = float(k)
+        self.beta = float(beta)
+        self.n = int(n)
+
+    def call(self, params, x, *, training=False, rng=None):
+        half = self.n // 2
+        sq = x * x
+        C = x.shape[-1]
+        # windowed channel sum via padded cumulative trick (vectorized)
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        acc = sum(pad[..., i:i + C] for i in range(self.n))
+        return x / jnp.power(self.k + self.alpha / self.n * acc, self.beta)

@@ -196,6 +196,10 @@ class Merge(Layer):
             return out
         if m == "ave":
             return sum(xs[1:], xs[0]) / float(len(xs))
+        if m == "sub":
+            if len(xs) != 2:
+                raise ValueError("sub merge requires exactly 2 inputs")
+            return xs[0] - xs[1]
         if m == "max":
             out = xs[0]
             for x in xs[1:]:

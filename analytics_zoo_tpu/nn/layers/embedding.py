@@ -1,0 +1,164 @@
+"""Embedding-family layers beyond the core Embedding.
+
+Reference parity: pipeline/api/keras/layers/{WordEmbedding,SparseEmbedding,
+SparseDense}.scala.  TPU-native notes: "sparse" inputs are represented as
+dense padded id/value arrays (static shapes for XLA) instead of SparseTensors;
+lookups are jnp.take gathers that XLA lowers to dynamic-gather on HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.module import Layer, initializer, to_shape
+
+
+class WordEmbedding(Layer):
+    """Pretrained word embeddings, frozen by default (WordEmbedding.scala:
+    loads glove.6B.*d.txt-style files; out-of-vocabulary words map to zeros).
+
+    `embedding_file` is a text file of "<word> <v1> <v2> ..." lines;
+    `word_index` maps word -> 1-based id (id 0 is the padding/OOV row).
+    """
+
+    def __init__(self, embedding_file: str,
+                 word_index: Optional[Dict[str, int]] = None,
+                 trainable: bool = False, input_length: Optional[int] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.embedding_file = embedding_file
+        self.word_index = word_index
+        self.trainable = trainable
+        self.input_length = input_length
+        self._table = None  # loaded lazily in build
+
+    @staticmethod
+    def get_word_index(embedding_file: str) -> Dict[str, int]:
+        """Full vocabulary of the embedding file -> 1-based ids
+        (WordEmbedding.scala getWordIndex)."""
+        index = {}
+        with open(embedding_file, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                w = line.rstrip("\n").split(" ", 1)[0]
+                index[w] = i + 1
+        return index
+
+    def _load(self):
+        vectors = {}
+        dim = None
+        with open(self.embedding_file, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 2:
+                    continue
+                vec = np.asarray(parts[1:], dtype=np.float32)
+                dim = len(vec)
+                vectors[parts[0]] = vec
+        if dim is None:
+            raise ValueError(f"empty embedding file {self.embedding_file}")
+        word_index = self.word_index or \
+            {w: i + 1 for i, w in enumerate(vectors)}
+        n = max(word_index.values()) + 1
+        table = np.zeros((n, dim), np.float32)   # row 0 + OOV stay zero
+        for w, i in word_index.items():
+            if w in vectors:
+                table[i] = vectors[w]
+        return table
+
+    def build(self, rng, input_shape):
+        if self._table is None:
+            self._table = self._load()
+        table = jnp.asarray(self._table, dtypes.param_dtype())
+        if self.trainable:
+            return {"E": table}
+        # frozen: keep the table out of the trainable param pytree
+        self._frozen = table
+        return {}
+
+    def call(self, params, x, *, training=False, rng=None):
+        # same id contract as the core Embedding layer: output rank = rank+1
+        table = params["E"] if self.trainable else self._frozen
+        return jnp.take(table, jnp.asarray(x).astype(jnp.int32), axis=0)
+
+
+class SparseEmbedding(Layer):
+    """Pooled embedding over variable-length id lists (SparseEmbedding.scala /
+    BigDL LookupTableSparse semantics, tf.nn.embedding_lookup_sparse analog).
+
+    Input is a dense padded (B, L) id array where id 0 is padding; output is
+    the sum/mean/sqrtn-combined embedding of the non-padding ids per row —
+    static shapes, so the whole op is one gather + masked reduction on TPU.
+    """
+
+    def __init__(self, input_dim, output_dim, combiner: str = "sum",
+                 init="uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.combiner = combiner
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        return {"E": initializer(self.init_name, rng,
+                                 (self.input_dim, self.output_dim),
+                                 dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = jnp.asarray(x).astype(jnp.int32)
+        mask = (ids > 0).astype(params["E"].dtype)       # (B, L)
+        emb = jnp.take(params["E"], ids, axis=0)         # (B, L, D)
+        summed = jnp.sum(emb * mask[..., None], axis=1)  # (B, D)
+        if self.combiner == "sum":
+            return summed
+        count = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        if self.combiner == "mean":
+            return summed / count
+        if self.combiner == "sqrtn":
+            return summed / jnp.sqrt(count)
+        raise ValueError(f"unknown combiner {self.combiner!r}")
+
+
+class SparseDense(Layer):
+    """Dense layer over sparse COO input (SparseDense.scala).
+
+    Input is a (indices, values) pair of dense padded arrays — indices (B, K)
+    int column ids, values (B, K) floats, entries with index < 0 ignored —
+    i.e. each row is a sparse vector of the `input_dim`-dim feature space.
+    y[b] = sum_k values[b,k] * W[indices[b,k]] + bias: one gather + weighted
+    sum instead of materializing the (B, input_dim) dense matrix.
+    """
+
+    def __init__(self, input_dim, output_dim, activation=None, bias=True,
+                 init="glorot_uniform", **kwargs):
+        from analytics_zoo_tpu.nn import activations
+        super().__init__(**kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        p = {"W": initializer(self.init_name, rng,
+                              (self.input_dim, self.output_dim),
+                              dtypes.param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.output_dim,), dtypes.param_dtype())
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        indices, values = inputs
+        idx = jnp.asarray(indices).astype(jnp.int32)
+        val = jnp.asarray(values)
+        valid = (idx >= 0)
+        rows = jnp.take(params["W"], jnp.where(valid, idx, 0), axis=0)
+        w, v = dtypes.cast_compute(rows, val * valid.astype(val.dtype))
+        y = jnp.sum(w * v[..., None], axis=-2).astype(dtypes.param_dtype())
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y)

@@ -1,0 +1,307 @@
+"""Elementwise / structural math layers.
+
+Reference parity: pipeline/api/keras/layers/{AddConstant,MulConstant,Negative,
+Power,Sqrt,Square,Exp,Log,Identity,BinaryThreshold,Threshold,HardShrink,
+SoftShrink,HardTanh,RReLU,CAdd,CMul,Scale,Mul,Expand,GetShape,Max,SelectTable,
+SplitTensor,GaussianSampler,Softmax}.scala.  Each is a thin pure function (or
+tiny-parameter layer) over jnp — XLA fuses these into neighbouring ops, so
+unlike the reference (one BigDL module + Keras wrapper per op) there is no
+per-layer kernel cost on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.module import Layer, to_shape
+
+
+class AddConstant(Layer):
+    """y = x + constant (AddConstant.scala)."""
+
+    def __init__(self, constant=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + self.constant
+
+
+class MulConstant(Layer):
+    """y = x * constant (MulConstant.scala)."""
+
+    def __init__(self, constant=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * self.constant
+
+
+class Negative(Layer):
+    """y = -x (Negative.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return -x
+
+
+class Power(Layer):
+    """y = (shift + scale * x) ** power (Power.scala)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Sqrt(Layer):
+    """y = sqrt(x) (Sqrt.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.sqrt(x)
+
+
+class Square(Layer):
+    """y = x^2 (Square.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * x
+
+
+class Exp(Layer):
+    """y = e^x (Exp.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.exp(x)
+
+
+class Log(Layer):
+    """y = ln(x) (Log.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.log(x)
+
+
+class Identity(Layer):
+    """y = x (Identity.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x
+
+
+class Softmax(Layer):
+    """Softmax over the last axis as a standalone layer (Softmax.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class BinaryThreshold(Layer):
+    """y = 1 if x > th else 0 (BinaryThreshold.scala, th default 1e-6)."""
+
+    def __init__(self, value=1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return (x > self.value).astype(x.dtype)
+
+
+class Threshold(Layer):
+    """y = x if x > th else v (Threshold.scala)."""
+
+    def __init__(self, th=1e-6, v=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.th = float(th)
+        self.v = float(v)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class HardShrink(Layer):
+    """y = x if |x| > lambda else 0 (HardShrink.scala)."""
+
+    def __init__(self, value=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(Layer):
+    """y = x -/+ lambda outside [-lambda, lambda], else 0 (SoftShrink.scala)."""
+
+    def __init__(self, value=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.value, x - self.value,
+                         jnp.where(x < -self.value, x + self.value, 0.0))
+
+
+class HardTanh(Layer):
+    """y = clip(x, min_value, max_value) (HardTanh.scala)."""
+
+    def __init__(self, min_value=-1.0, max_value=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (RReLU.scala): negative slope ~ U(lower, upper)
+    per element when training, (lower+upper)/2 at inference."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, **kwargs):
+        super().__init__(**kwargs)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, jnp.float32,
+                                   self.lower, self.upper).astype(x.dtype)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class CAdd(Layer):
+    """Learnable per-element bias of the given broadcast shape (CAdd.scala)."""
+
+    def __init__(self, size, **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in to_shape(size))
+
+    def build(self, rng, input_shape):
+        return {"b": jnp.zeros(self.size, dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + params["b"]
+
+
+class CMul(Layer):
+    """Learnable per-element scale of the given broadcast shape (CMul.scala)."""
+
+    def __init__(self, size, **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in to_shape(size))
+
+    def build(self, rng, input_shape):
+        return {"w": jnp.ones(self.size, dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["w"]
+
+
+class Scale(Layer):
+    """CMul then CAdd (Scale.scala)."""
+
+    def __init__(self, size, **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in to_shape(size))
+
+    def build(self, rng, input_shape):
+        return {"w": jnp.ones(self.size, dtypes.param_dtype()),
+                "b": jnp.zeros(self.size, dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["w"] + params["b"]
+
+
+class Mul(Layer):
+    """Single learnable scalar multiplier (Mul.scala)."""
+
+    def build(self, rng, input_shape):
+        return {"w": jnp.ones((), dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["w"]
+
+
+class Expand(Layer):
+    """Broadcast singleton dims to target sizes (Expand.scala/InternalExpand;
+    tgt_sizes EXCLUDES the batch dim, -1 keeps a dim)."""
+
+    def __init__(self, tgt_sizes, **kwargs):
+        super().__init__(**kwargs)
+        self.tgt_sizes = tuple(int(s) for s in tgt_sizes)
+
+    def call(self, params, x, *, training=False, rng=None):
+        tgt = (x.shape[0],) + tuple(
+            x.shape[i + 1] if s == -1 else s
+            for i, s in enumerate(self.tgt_sizes))
+        return jnp.broadcast_to(x, tgt)
+
+
+class GetShape(Layer):
+    """Returns the input's shape as an int32 tensor (GetShape.scala)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.asarray(np.array(x.shape, np.int32))
+
+
+class Max(Layer):
+    """Max over dimension `dim` (1-based over non-batch dims, as in Max.scala);
+    return_value=False returns argmax indices instead."""
+
+    def __init__(self, dim, return_value=True, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+        self.return_value = bool(return_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        ax = self.dim  # batch is axis 0; reference dim 1 = first feature dim
+        if self.return_value:
+            return jnp.max(x, axis=ax)
+        return jnp.argmax(x, axis=ax).astype(jnp.int32)
+
+
+class SelectTable(Layer):
+    """Select one tensor from a list input (SelectTable.scala)."""
+
+    def __init__(self, index, **kwargs):
+        super().__init__(**kwargs)
+        self.index = int(index)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs[self.index]
+
+
+class SplitTensor(Layer):
+    """Split along a dim into a list of tensors (SplitTensor.scala;
+    dim counts the batch axis as 0, like the reference's 1-based dim-1)."""
+
+    def __init__(self, dim, num_split, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+        self.num_split = int(num_split)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.split(x, self.num_split, axis=self.dim)
+
+
+class GaussianSampler(Layer):
+    """VAE reparameterization sampler (GaussianSampler.scala): input
+    [mean, log_var], output mean + exp(log_var/2) * eps, eps ~ N(0, 1).
+    Deterministic (returns the mean) when no rng is supplied at inference."""
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        mean, log_var = inputs
+        if rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, jnp.float32).astype(mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps

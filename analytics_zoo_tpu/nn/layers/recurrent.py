@@ -184,10 +184,15 @@ class TimeDistributed(Layer):
         return y.reshape((B, T) + y.shape[1:]), {"inner": new_state}
 
 
-class ConvLSTM2D(Layer):
-    """Convolutional LSTM (ConvLSTM2D.scala / ConvLSTM3D analog): gates are 2D convs.
+class _ConvLSTMND(Layer):
+    """Convolutional LSTM core (ConvLSTM2D/ConvLSTM3D.scala): gates are
+    rank-`ndim` convs over channels-last input (B, T, *spatial, C).
 
-    Input (B, T, H, W, C) channels-last; returns last state or full sequence."""
+    border_mode applies to the INPUT conv (spatial dims shrink under
+    "valid"); the recurrent conv on the state is always SAME so the state
+    shape is stable across steps."""
+
+    ndim = 2
 
     def __init__(self, nb_filter: int, nb_kernel: int, return_sequences=False,
                  border_mode="same", inner_activation="hard_sigmoid",
@@ -201,41 +206,50 @@ class ConvLSTM2D(Layer):
         self.activation = activations.get(activation)
         self.init_name = init
 
+    def _dims(self):
+        spatial = "DHW"[-self.ndim:]
+        return ("N" + spatial + "C", spatial + "IO", "N" + spatial + "C")
+
     def build(self, rng, input_shape):
-        _, H, W, C = to_shape(input_shape)
+        shape = to_shape(input_shape)          # (T, *spatial, C)
+        C = shape[-1]
         r1, r2 = jax.random.split(rng)
         F = self.nb_filter
+        kk = (self.k,) * self.ndim
         return {
-            "Wx": initializer(self.init_name, r1, (self.k, self.k, C, 4 * F),
+            "Wx": initializer(self.init_name, r1, kk + (C, 4 * F),
                               dtypes.param_dtype(),
-                              fan_in=self.k * self.k * C,
-                              fan_out=self.k * self.k * F),
-            "Wh": initializer(self.init_name, r2, (self.k, self.k, F, 4 * F),
+                              fan_in=self.k ** self.ndim * C,
+                              fan_out=self.k ** self.ndim * F),
+            "Wh": initializer(self.init_name, r2, kk + (F, 4 * F),
                               dtypes.param_dtype(),
-                              fan_in=self.k * self.k * F,
-                              fan_out=self.k * self.k * F),
+                              fan_in=self.k ** self.ndim * F,
+                              fan_out=self.k ** self.ndim * F),
             "b": jnp.zeros((4 * F,), dtypes.param_dtype()),
         }
 
-    def _conv(self, x, W):
+    def _conv(self, x, W, padding):
         xw, Ww = dtypes.cast_compute(x, W)
-        dn = jax.lax.conv_dimension_numbers(x.shape, W.shape,
-                                            ("NHWC", "HWIO", "NHWC"))
+        dn = jax.lax.conv_dimension_numbers(x.shape, W.shape, self._dims())
         return jax.lax.conv_general_dilated(
-            xw, Ww, (1, 1), "SAME", dimension_numbers=dn,
+            xw, Ww, (1,) * self.ndim, padding, dimension_numbers=dn,
             preferred_element_type=jnp.float32)
 
     def call(self, params, x, *, training=False, rng=None):
-        B, T, H, W, C = x.shape
+        B, T = x.shape[0], x.shape[1]
+        spatial = x.shape[2:-1]
         F = self.nb_filter
+        pad = "SAME" if self.border_mode in ("same", "SAME") else "VALID"
+        out_spatial = tuple(s if pad == "SAME" else s - self.k + 1
+                            for s in spatial)
         xs = jnp.swapaxes(x, 0, 1)
-        h0 = jnp.zeros((B, H, W, F), jnp.float32)
-        c0 = jnp.zeros((B, H, W, F), jnp.float32)
+        h0 = jnp.zeros((B,) + out_spatial + (F,), jnp.float32)
+        c0 = jnp.zeros((B,) + out_spatial + (F,), jnp.float32)
 
         def body(carry, x_t):
             h, c = carry
-            z = (self._conv(x_t, params["Wx"]) + self._conv(h, params["Wh"])
-                 + params["b"])
+            z = (self._conv(x_t, params["Wx"], pad)
+                 + self._conv(h, params["Wh"], "SAME") + params["b"])
             i = self.inner_activation(z[..., :F])
             f = self.inner_activation(z[..., F:2 * F])
             g = self.activation(z[..., 2 * F:3 * F])
@@ -246,6 +260,20 @@ class ConvLSTM2D(Layer):
 
         (_, _), ys = jax.lax.scan(body, (h0, c0), xs)
         return jnp.swapaxes(ys, 0, 1) if self.return_sequences else ys[-1]
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """Convolutional LSTM with 2D-conv gates (ConvLSTM2D.scala):
+    input (B, T, H, W, C) channels-last."""
+
+    ndim = 2
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """Convolutional LSTM with 3D-conv gates (ConvLSTM3D.scala /
+    InternalConvLSTM3D.scala): input (B, T, D, H, W, C) channels-last."""
+
+    ndim = 3
 
 
 class Highway(Layer):
@@ -278,3 +306,67 @@ class Highway(Layer):
         h = self.activation(h)
         t = jax.nn.sigmoid(t)
         return t * h + (1.0 - t) * x
+
+
+class ConvLSTM3D(Layer):
+    """Convolutional LSTM with 3D-conv gates (ConvLSTM3D.scala /
+    InternalConvLSTM3D.scala): input (B, T, D, H, W, C) channels-last."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int, return_sequences=False,
+                 border_mode="same", inner_activation="hard_sigmoid",
+                 activation="tanh", init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.k = int(nb_kernel)
+        self.return_sequences = return_sequences
+        self.border_mode = border_mode
+        self.inner_activation = activations.get(inner_activation)
+        self.activation = activations.get(activation)
+        self.init_name = init
+
+    def build(self, rng, input_shape):
+        _, D, H, W, C = to_shape(input_shape)
+        r1, r2 = jax.random.split(rng)
+        F = self.nb_filter
+        k3 = (self.k,) * 3
+        return {
+            "Wx": initializer(self.init_name, r1, k3 + (C, 4 * F),
+                              dtypes.param_dtype(),
+                              fan_in=self.k ** 3 * C,
+                              fan_out=self.k ** 3 * F),
+            "Wh": initializer(self.init_name, r2, k3 + (F, 4 * F),
+                              dtypes.param_dtype(),
+                              fan_in=self.k ** 3 * F,
+                              fan_out=self.k ** 3 * F),
+            "b": jnp.zeros((4 * F,), dtypes.param_dtype()),
+        }
+
+    def _conv(self, x, W):
+        xw, Ww = dtypes.cast_compute(x, W)
+        dn = jax.lax.conv_dimension_numbers(x.shape, W.shape,
+                                            ("NDHWC", "DHWIO", "NDHWC"))
+        return jax.lax.conv_general_dilated(
+            xw, Ww, (1, 1, 1), "SAME", dimension_numbers=dn,
+            preferred_element_type=jnp.float32)
+
+    def call(self, params, x, *, training=False, rng=None):
+        B, T, D, H, W, C = x.shape
+        F = self.nb_filter
+        xs = jnp.swapaxes(x, 0, 1)
+        h0 = jnp.zeros((B, D, H, W, F), jnp.float32)
+        c0 = jnp.zeros((B, D, H, W, F), jnp.float32)
+
+        def body(carry, x_t):
+            h, c = carry
+            z = (self._conv(x_t, params["Wx"]) + self._conv(h, params["Wh"])
+                 + params["b"])
+            i = self.inner_activation(z[..., :F])
+            f = self.inner_activation(z[..., F:2 * F])
+            g = self.activation(z[..., 2 * F:3 * F])
+            o = self.inner_activation(z[..., 3 * F:])
+            c_new = f * c + i * g
+            h_new = o * self.activation(c_new)
+            return (h_new, c_new), h_new
+
+        (_, _), ys = jax.lax.scan(body, (h0, c0), xs)
+        return jnp.swapaxes(ys, 0, 1) if self.return_sequences else ys[-1]

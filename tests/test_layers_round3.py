@@ -1,0 +1,321 @@
+"""Tests for the round-3 layer-library completion (VERDICT r2 #4).
+
+Covers the ~44 newly added classes: elementwise math family, scale family,
+structural ops, LocallyConnected2D / ShareConvolution2D / 3D pad+crop /
+ResizeBilinear / LRN2D, ConvLSTM3D, WordEmbedding (GloVe-format loading),
+SparseEmbedding / SparseDense, keras2 merge classes, and the layer-count
+'Done' criterion (>=110 classes).  Where tf/keras has an equivalent the test
+is differential (same oracle contract as tests/test_keras_oracle.py);
+otherwise semantics are asserted against hand-computed numpy.
+"""
+
+import inspect
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.nn.keras2 as k2
+from analytics_zoo_tpu.nn import layers as L
+from analytics_zoo_tpu.nn.module import Layer
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------- math family
+
+def test_elementwise_math_layers(rng):
+    x = jnp.asarray(rng.normal(size=(3, 5)), jnp.float32)
+    xp = jnp.abs(x) + 0.5
+    np.testing.assert_allclose(_np(L.AddConstant(2.5).call({}, x)), _np(x) + 2.5)
+    np.testing.assert_allclose(_np(L.MulConstant(3.0).call({}, x)), _np(x) * 3.0)
+    np.testing.assert_allclose(_np(L.Negative().call({}, x)), -_np(x))
+    np.testing.assert_allclose(_np(L.Power(2.0, 2.0, 1.0).call({}, xp)),
+                               (1.0 + 2.0 * _np(xp)) ** 2, rtol=1e-6)
+    np.testing.assert_allclose(_np(L.Sqrt().call({}, xp)), np.sqrt(_np(xp)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(L.Square().call({}, x)), _np(x) ** 2,
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(L.Exp().call({}, x)), np.exp(_np(x)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(L.Log().call({}, xp)), np.log(_np(xp)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(L.Identity().call({}, x)), _np(x))
+    np.testing.assert_allclose(
+        _np(L.Softmax().call({}, x)),
+        np.exp(_np(x)) / np.exp(_np(x)).sum(-1, keepdims=True), rtol=1e-5)
+
+
+def test_threshold_family(rng):
+    x = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    xn = _np(x)
+    np.testing.assert_allclose(_np(L.BinaryThreshold(0.1).call({}, x)),
+                               (xn > 0.1).astype(np.float32))
+    np.testing.assert_allclose(_np(L.Threshold(0.2, -7.0).call({}, x)),
+                               np.where(xn > 0.2, xn, -7.0))
+    np.testing.assert_allclose(_np(L.HardShrink(0.5).call({}, x)),
+                               np.where(np.abs(xn) > 0.5, xn, 0.0))
+    np.testing.assert_allclose(
+        _np(L.SoftShrink(0.5).call({}, x)),
+        np.where(xn > 0.5, xn - 0.5, np.where(xn < -0.5, xn + 0.5, 0.0)))
+    np.testing.assert_allclose(_np(L.HardTanh(-0.3, 0.7).call({}, x)),
+                               np.clip(xn, -0.3, 0.7))
+
+
+def test_rrelu(rng):
+    x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    # inference: deterministic mean slope
+    y = L.RReLU(0.1, 0.3).call({}, x, training=False)
+    np.testing.assert_allclose(_np(y), np.where(_np(x) >= 0, _np(x),
+                                                0.2 * _np(x)), rtol=1e-6)
+    # training: slopes vary within [lower, upper]
+    yt = L.RReLU(0.1, 0.3).call({}, x, training=True,
+                                rng=jax.random.PRNGKey(0))
+    neg = _np(x) < 0
+    slopes = _np(yt)[neg] / _np(x)[neg]
+    assert slopes.min() >= 0.1 - 1e-5 and slopes.max() <= 0.3 + 1e-5
+    assert slopes.std() > 0.01
+
+
+def test_scale_family(rng):
+    x = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    for cls, expect in [
+            (L.CAdd, lambda xn, p: xn + p),
+            (L.CMul, lambda xn, p: xn * p)]:
+        layer = cls((6,))
+        params = layer.build(jax.random.PRNGKey(0), (4, 6))
+        key = list(params)[0]
+        params = {key: jnp.asarray(rng.normal(size=(6,)), jnp.float32)}
+        np.testing.assert_allclose(_np(layer.call(params, x)),
+                                   expect(_np(x), _np(params[key])), rtol=1e-6)
+    sc = L.Scale((6,))
+    p = {"w": jnp.asarray(rng.normal(size=(6,)), jnp.float32),
+         "b": jnp.asarray(rng.normal(size=(6,)), jnp.float32)}
+    np.testing.assert_allclose(_np(sc.call(p, x)),
+                               _np(x) * _np(p["w"]) + _np(p["b"]), rtol=1e-6)
+    mul = L.Mul()
+    p = {"w": jnp.asarray(1.7, jnp.float32)}
+    np.testing.assert_allclose(_np(mul.call(p, x)), 1.7 * _np(x), rtol=1e-6)
+
+
+def test_structural_ops(rng):
+    x = jnp.asarray(rng.normal(size=(2, 1, 5)), jnp.float32)
+    y = L.Expand((4, -1)).call({}, x)
+    assert y.shape == (2, 4, 5)
+    np.testing.assert_allclose(_np(y), np.broadcast_to(_np(x), (2, 4, 5)))
+
+    shp = L.GetShape().call({}, x)
+    np.testing.assert_array_equal(_np(shp), [2, 1, 5])
+
+    x2 = jnp.asarray(rng.normal(size=(2, 6, 3)), jnp.float32)
+    np.testing.assert_allclose(_np(L.Max(1).call({}, x2)), _np(x2).max(1))
+    np.testing.assert_array_equal(_np(L.Max(2, return_value=False).call({}, x2)),
+                                  _np(x2).argmax(2))
+
+    parts = L.SplitTensor(1, 3).call({}, x2)
+    assert len(parts) == 3 and parts[0].shape == (2, 2, 3)
+    np.testing.assert_allclose(_np(parts[1]), _np(x2)[:, 2:4])
+
+    sel = L.SelectTable(1).call({}, [x, x2])
+    np.testing.assert_allclose(_np(sel), _np(x2))
+
+
+def test_gaussian_sampler(rng):
+    mean = jnp.asarray(rng.normal(size=(2000, 4)), jnp.float32)
+    log_var = jnp.full((2000, 4), -2.0, jnp.float32)
+    gs = L.GaussianSampler()
+    np.testing.assert_allclose(_np(gs.call({}, [mean, log_var])), _np(mean))
+    y = gs.call({}, [mean, log_var], rng=jax.random.PRNGKey(0))
+    resid = _np(y) - _np(mean)
+    assert abs(resid.std() - np.exp(-1.0)) < 0.02   # exp(log_var/2) = e^-1
+
+
+# ------------------------------------------------------- conv/spatial family
+
+def test_locally_connected_2d_matches_manual(rng):
+    x = jnp.asarray(rng.normal(size=(2, 6, 7, 3)), jnp.float32)
+    lc = L.LocallyConnected2D(4, 3, 2, subsample=(1, 2))
+    params = lc.build(jax.random.PRNGKey(0), (6, 7, 3))
+    y = _np(lc.call(params, x))
+    oh, ow = (6 - 3) // 1 + 1, (7 - 2) // 2 + 1
+    assert y.shape == (2, oh, ow, 4)
+    W = _np(params["W"]).reshape(oh, ow, 3 * 2 * 3, 4)
+    b = _np(params["b"])
+    for i in range(oh):
+        for j in range(ow):
+            patch = _np(x)[:, i:i + 3, 2 * j:2 * j + 2, :].reshape(2, -1)
+            np.testing.assert_allclose(y[:, i, j], patch @ W[i, j] + b[i, j],
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_share_convolution2d_pads_like_explicit_pad(rng):
+    x = jnp.asarray(rng.normal(size=(2, 6, 6, 3)), jnp.float32)
+    sc = L.ShareConvolution2D(4, 3, pad_h=1, pad_w=2)
+    params = sc.build(jax.random.PRNGKey(0), (6, 6, 3))
+    y = sc.call(params, x)
+    ref_conv = L.Convolution2D(4, 3, border_mode="valid")
+    xp = jnp.pad(x, ((0, 0), (1, 1), (2, 2), (0, 0)))
+    np.testing.assert_allclose(_np(y), _np(ref_conv.call(params, xp)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pad_crop_3d_match_tf(rng):
+    tf = pytest.importorskip("tensorflow")
+    x = rng.normal(size=(2, 4, 5, 6, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        _np(L.ZeroPadding3D((1, 2, 3)).call({}, jnp.asarray(x))),
+        np.asarray(tf.keras.layers.ZeroPadding3D((1, 2, 3))(x)))
+    np.testing.assert_allclose(
+        _np(L.Cropping3D(((1, 1), (0, 2), (1, 0))).call({}, jnp.asarray(x))),
+        np.asarray(tf.keras.layers.Cropping3D(((1, 1), (0, 2), (1, 0)))(x)))
+
+
+def test_resize_bilinear_matches_tf1_semantics(rng):
+    tf = pytest.importorskip("tensorflow")
+    x = rng.normal(size=(2, 8, 10, 3)).astype(np.float32)
+    for align, oh, ow in [(False, 5, 7), (True, 5, 7), (False, 16, 20)]:
+        y = L.ResizeBilinear(oh, ow, align_corners=align) \
+             .call({}, jnp.asarray(x))
+        ref = tf.compat.v1.image.resize_bilinear(x, (oh, ow),
+                                                 align_corners=align)
+        np.testing.assert_allclose(_np(y), np.asarray(ref), rtol=1e-4,
+                                   atol=1e-4, err_msg=f"align={align}")
+
+
+def test_lrn2d_matches_tf(rng):
+    tf = pytest.importorskip("tensorflow")
+    x = rng.normal(size=(2, 4, 4, 8)).astype(np.float32)
+    y = L.LRN2D(alpha=1e-3, k=2.0, beta=0.75, n=5).call({}, jnp.asarray(x))
+    # tf.nn.lrn: alpha is per-element (not alpha/n), depth_radius = (n-1)/2
+    ref = tf.nn.local_response_normalization(
+        x, depth_radius=2, bias=2.0, alpha=1e-3 / 5, beta=0.75)
+    np.testing.assert_allclose(_np(y), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_convlstm_valid_border_mode(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 6, 6, 2)), jnp.float32)
+    layer = L.ConvLSTM2D(3, 3, border_mode="valid", return_sequences=True)
+    params = layer.build(jax.random.PRNGKey(0), (3, 6, 6, 2))
+    y = layer.call(params, x)
+    assert y.shape == (2, 3, 4, 4, 3)   # 6 - 3 + 1 = 4
+    assert np.isfinite(_np(y)).all()
+
+
+def test_convlstm3d_shapes_and_finiteness(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 4, 5, 6, 2)), jnp.float32)
+    layer = L.ConvLSTM3D(3, 2, return_sequences=True)
+    params = layer.build(jax.random.PRNGKey(0), (3, 4, 5, 6, 2))
+    y = layer.call(params, x)
+    assert y.shape == (2, 3, 4, 5, 6, 3)
+    assert np.isfinite(_np(y)).all()
+    last = L.ConvLSTM3D(3, 2).call(params, x)
+    np.testing.assert_allclose(_np(last), _np(y[:, -1]), rtol=1e-5)
+
+
+# --------------------------------------------------------- embedding family
+
+def test_word_embedding_glove_loading(tmp_path):
+    glove = tmp_path / "glove.txt"
+    glove.write_text("the 0.1 0.2 0.3\ncat 0.4 0.5 0.6\nsat -0.1 -0.2 -0.3\n")
+    widx = L.WordEmbedding.get_word_index(str(glove))
+    assert widx == {"the": 1, "cat": 2, "sat": 3}
+    emb = L.WordEmbedding(str(glove), word_index={"cat": 1, "dog": 2})
+    params = emb.build(jax.random.PRNGKey(0), (4,))
+    assert params == {}  # frozen: not in the trainable pytree
+    ids = jnp.asarray([[1, 2, 0]])
+    y = _np(emb.call(params, ids))
+    np.testing.assert_allclose(y[0, 0], [0.4, 0.5, 0.6])   # cat
+    np.testing.assert_allclose(y[0, 1], [0.0, 0.0, 0.0])   # dog: OOV -> zeros
+    np.testing.assert_allclose(y[0, 2], [0.0, 0.0, 0.0])   # padding
+
+
+def test_sparse_embedding_combiners(rng):
+    emb = L.SparseEmbedding(10, 4, combiner="mean")
+    params = emb.build(jax.random.PRNGKey(0), (5,))
+    ids = jnp.asarray([[1, 3, 0, 0], [2, 0, 0, 0]])
+    y = _np(emb.call(params, ids))
+    E = _np(params["E"])
+    np.testing.assert_allclose(y[0], (E[1] + E[3]) / 2, rtol=1e-5)
+    np.testing.assert_allclose(y[1], E[2], rtol=1e-5)
+    s = L.SparseEmbedding(10, 4, combiner="sum")
+    np.testing.assert_allclose(_np(s.call(params, ids))[0], E[1] + E[3],
+                               rtol=1e-5)
+
+
+def test_sparse_dense_matches_dense_matmul(rng):
+    sd = L.SparseDense(20, 6)
+    params = sd.build(jax.random.PRNGKey(0), None)
+    idx = jnp.asarray([[0, 5, 19, -1], [3, -1, -1, -1]])
+    val = jnp.asarray(rng.normal(size=(2, 4)), jnp.float32)
+    y = _np(sd.call(params, [idx, val]))
+    dense = np.zeros((2, 20), np.float32)
+    dense[0, [0, 5, 19]] = _np(val)[0, :3]
+    dense[1, 3] = _np(val)[1, 0]
+    ref = dense @ _np(params["W"]) + _np(params["b"])
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------------------- keras2 merges
+
+def test_keras2_merge_classes_match_keras(rng):
+    tf = pytest.importorskip("tensorflow")
+    KL = tf.keras.layers
+    a = rng.normal(size=(3, 6)).astype(np.float32)
+    b = rng.normal(size=(3, 6)).astype(np.float32)
+    pairs = [
+        (k2.Add(), KL.Add()), (k2.Subtract(), KL.Subtract()),
+        (k2.Multiply(), KL.Multiply()), (k2.Average(), KL.Average()),
+        (k2.Maximum(), KL.Maximum()), (k2.Minimum(), KL.Minimum()),
+        (k2.Concatenate(axis=-1), KL.Concatenate(axis=-1)),
+    ]
+    for ours, theirs in pairs:
+        y = _np(ours.call({}, [jnp.asarray(a), jnp.asarray(b)]))
+        ref = np.asarray(theirs([tf.constant(a), tf.constant(b)]))
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=type(theirs).__name__)
+    # Dot: keras Dot(axes=1) on (B, d) pairs == our batched dot
+    y = _np(k2.Dot().call({}, [jnp.asarray(a), jnp.asarray(b)]))
+    ref = np.asarray(KL.Dot(axes=1)([tf.constant(a), tf.constant(b)]))
+    np.testing.assert_allclose(y, ref, rtol=1e-5)
+    y = _np(k2.Dot(normalize=True).call({}, [jnp.asarray(a), jnp.asarray(b)]))
+    ref = np.asarray(KL.Dot(axes=1, normalize=True)([tf.constant(a),
+                                                     tf.constant(b)]))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_keras2_constructor_aliases_build():
+    assert isinstance(k2.Conv2DTranspose(4, 3), L.Deconvolution2D)
+    assert isinstance(k2.SeparableConv2D(4, 3), L.SeparableConvolution2D)
+    assert isinstance(k2.Conv3D(4, 3), L.Convolution3D)
+    assert isinstance(k2.LSTM(5), L.LSTM)
+    assert isinstance(k2.GRU(5), L.GRU)
+    assert isinstance(k2.SimpleRNN(5), L.SimpleRNN)
+    assert isinstance(k2.MaxPooling3D(), L.MaxPooling3D)
+    assert isinstance(k2.GlobalAveragePooling3D(), L.GlobalAveragePooling3D)
+
+
+# ------------------------------------------------------------- count check
+
+def test_layer_library_has_at_least_110_classes():
+    """VERDICT r2 #4 'Done' criterion: >=110 layer classes."""
+    import analytics_zoo_tpu.nn.layers.advanced      # noqa: F401
+    import analytics_zoo_tpu.nn.layers.attention     # noqa: F401
+    import analytics_zoo_tpu.nn.layers.conv          # noqa: F401
+    import analytics_zoo_tpu.nn.layers.core          # noqa: F401
+    import analytics_zoo_tpu.nn.layers.embedding     # noqa: F401
+    import analytics_zoo_tpu.nn.layers.math          # noqa: F401
+    import analytics_zoo_tpu.nn.layers.pooling       # noqa: F401
+    import analytics_zoo_tpu.nn.layers.recurrent     # noqa: F401
+
+    classes = set()
+    for name, mod in list(sys.modules.items()):
+        if name.startswith("analytics_zoo_tpu.nn"):
+            for k, v in vars(mod).items():
+                if (inspect.isclass(v) and issubclass(v, Layer)
+                        and v is not Layer and not k.startswith("_")):
+                    classes.add(f"{v.__module__}.{v.__name__}")
+    assert len(classes) >= 110, sorted(classes)
