@@ -92,6 +92,31 @@ class InferenceModel:
         params = net.build(None, None)
         return self.do_load_model(net, params, {})
 
+    # -- quantization ----------------------------------------------------------
+    def do_quantize(self, calib_inputs):
+        """Post-training int8 quantization of the loaded model (the
+        OpenVINO-int8 capability, pipeline/inference/OpenVinoInferenceSupportive
+        .scala analog — here targeting the MXU s8xs8->s32 path).
+
+        `calib_inputs`: one batch (or list of batches) shaped like predict
+        inputs; used to calibrate per-layer activation scales.  Dense/conv
+        weights become int8 with per-output-channel scales; predict() then
+        runs the quantized graph."""
+        from analytics_zoo_tpu.inference.quantize import (
+            _target_layers, quantize)
+        if self._model is None:
+            raise RuntimeError("load a model first")
+        if not _target_layers(self._model, self._params or {}):
+            # nothing quantizable (e.g. a TFNet-backed model whose predict
+            # lambda must stay un-jitted) — leave the loaded path untouched
+            return self
+        self._params = quantize(self._model, self._params, self._state or {},
+                                calib_inputs)
+        model = self._model
+        self._jitted = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        return self
+
     # -- predict --------------------------------------------------------------
     def do_predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
         """Batched forward with power-of-two bucket padding: at most

@@ -1,0 +1,323 @@
+"""Caffe model importer: prototxt + caffemodel -> native graph Model.
+
+Reference parity: models/caffe/CaffeLoader.scala:1-718 and
+Converter.scala:1-698 (V2 LayerParameter converters; V1LayerConverter.scala
+is the legacy path, not reproduced).  Structure comes from the prototxt when
+given (falling back to the caffemodel's own layer list); weights come from the
+caffemodel blobs, matched by layer name as the reference does
+(CaffeLoader.copyParameters).
+
+The imported graph runs NCHW end-to-end (Caffe's layout): convs/pools are
+built with dim_ordering="th", weights transposed once at import
+(conv (O,I,kH,kW) -> HWIO, inner-product (O,I) -> (I,O)).
+
+Returns (model, params, state) and a CaffeModel facade with .predict, wired
+into `Net.load_caffe` (nn/net.py) and
+`InferenceModel.do_load_caffe` (inference/inference_model.py).
+
+Supported layer types (Converter.scala's core set): Input/Data, Convolution,
+InnerProduct, Pooling (MAX/AVE incl. Caffe's ceil-mode via asymmetric pad),
+ReLU (incl. negative_slope), Sigmoid, TanH, Softmax, Dropout, LRN
+(across-channel), BatchNorm (+ scale factor), Scale, Eltwise (SUM/PROD/MAX),
+Concat, Flatten, Reshape.  Unsupported types raise with the layer name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.interop import caffe_pb
+from analytics_zoo_tpu.nn.graph import Input
+from analytics_zoo_tpu.nn.layers import (
+    Activation, Dropout, Flatten, LeakyReLU, Merge, Reshape, Scale,
+    ShareConvolution2D)
+from analytics_zoo_tpu.nn.layers.conv import LRN2D
+from analytics_zoo_tpu.nn.layers.pooling import AveragePooling2D, MaxPooling2D
+from analytics_zoo_tpu.nn.layers.core import Dense
+from analytics_zoo_tpu.nn.models import Model
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _layers_from_prototxt(txt: Dict[str, Any]) -> List[caffe_pb.CaffeLayer]:
+    out = []
+    for entry in _as_list(txt.get("layer")):
+        params = {k: v for k, v in entry.items()
+                  if isinstance(v, dict) and k.endswith("_param")}
+        out.append(caffe_pb.CaffeLayer(
+            name=str(entry.get("name", "")), type=str(entry.get("type", "")),
+            bottoms=[str(b) for b in _as_list(entry.get("bottom"))],
+            tops=[str(t) for t in _as_list(entry.get("top"))],
+            blobs=[], params=params))
+    return out
+
+
+def _input_decl(txt: Optional[Dict[str, Any]], net: caffe_pb.CaffeNet,
+                layers: List[caffe_pb.CaffeLayer]):
+    """(input names, shapes incl. batch) from prototxt/net/Input layers."""
+    names, shapes = [], []
+    if txt is not None and "input" in txt:
+        names = [str(n) for n in _as_list(txt["input"])]
+        for shp in _as_list(txt.get("input_shape")):
+            shapes.append([int(d) for d in _as_list(shp.get("dim"))])
+        if not shapes and "input_dim" in txt:
+            dims = [int(d) for d in _as_list(txt["input_dim"])]
+            shapes = [dims[i:i + 4] for i in range(0, len(dims), 4)]
+    if not names and net.inputs:
+        names, shapes = list(net.inputs), [list(s) for s in net.input_shapes]
+    for l in layers:
+        if l.type in ("Input", "Data") and l.tops:
+            names.append(l.tops[0])
+            shp = l.params.get("input_param", {}).get("shape")
+            if shp:
+                first = shp[0] if isinstance(shp[0], (list, tuple)) \
+                    else _as_list(shp.get("dim")) if isinstance(shp, dict) \
+                    else shp
+                shapes.append([int(d) for d in _as_list(
+                    first.get("dim") if isinstance(first, dict) else first)])
+    return names, shapes
+
+
+_POOL_ENUM = {0: "MAX", 1: "AVE", "MAX": "MAX", "AVE": "AVE"}
+_ELTWISE_ENUM = {0: "mul", 1: "sum", 2: "max",
+                 "PROD": "mul", "SUM": "sum", "MAX": "max"}
+
+
+def _pool_layer(p: Dict[str, Any], name: str, in_hw: Tuple[int, int]):
+    """Pooling incl. Caffe ceil-mode: output = ceil((H + 2p - k)/s) + 1.
+    Expressed as (optional asymmetric pad) + VALID pooling."""
+    kind = _POOL_ENUM[p.get("pool", 0)]
+    k = int(p.get("kernel_h", p.get("kernel_size", 2)))
+    kw = int(p.get("kernel_w", p.get("kernel_size", 2)))
+    s = int(p.get("stride_h", p.get("stride", 1)))
+    sw = int(p.get("stride_w", p.get("stride", 1)))
+    pad = int(p.get("pad_h", p.get("pad", 0)))
+    padw = int(p.get("pad_w", p.get("pad", 0)))
+    if p.get("global_pooling"):
+        k, kw = in_hw
+        s = sw = 1
+        pad = padw = 0
+
+    def extra(h, pp, kk, ss):
+        out = -(-(h + 2 * pp - kk) // ss) + 1       # caffe ceil mode
+        covered = (out - 1) * ss + kk
+        return max(covered - (h + 2 * pp), 0)
+
+    eh = extra(in_hw[0], pad, k, s)
+    ew = extra(in_hw[1], padw, kw, sw)
+    pool_cls = MaxPooling2D if kind == "MAX" else AveragePooling2D
+    if kind == "AVE" and (pad or padw or eh or ew):
+        raise NotImplementedError(
+            f"{name}: AVE pooling with padding/ceil-overhang not supported "
+            "(Caffe divides by the full window incl. padding)")
+    padding = ((pad, pad + eh), (padw, padw + ew)) \
+        if (pad or padw or eh or ew) else None
+    return pool_cls((k, kw), strides=(s, sw), border_mode="valid",
+                    dim_ordering="th", padding=padding, name=name)
+
+
+def load_caffe(def_path: Optional[str], model_path: str):
+    """Import prototxt (structure, optional) + caffemodel (weights).
+    Returns a CaffeModel facade; .model/.params/.state carry the graph."""
+    with open(model_path, "rb") as f:
+        net = caffe_pb.load_net(f.read())
+    txt = None
+    if def_path:
+        with open(def_path, "r", encoding="utf-8") as f:
+            txt = caffe_pb.parse_prototxt(f.read())
+    struct_layers = _layers_from_prototxt(txt) if txt else net.layers
+    weight_blobs = {l.name: l.blobs for l in net.layers if l.blobs}
+
+    in_names, in_shapes = _input_decl(txt, net, struct_layers)
+    if not in_names:
+        raise ValueError("caffe net declares no inputs")
+    env: Dict[str, Any] = {}
+    inputs = []
+    for nm, shp in zip(in_names, in_shapes):
+        node = Input(shape=tuple(shp[1:]), name=nm)      # strip batch dim
+        env[nm] = node
+        inputs.append(node)
+    # track NCHW spatial dims for pooling ceil-mode
+    hw: Dict[str, Tuple[int, int]] = {
+        nm: (shp[2], shp[3]) for nm, shp in zip(in_names, in_shapes)
+        if len(shp) == 4}
+
+    weights: Dict[str, Dict[str, np.ndarray]] = {}
+    state_patch: Dict[str, Dict[str, np.ndarray]] = {}
+
+    for l in struct_layers:
+        if l.type in ("Input", "Data"):
+            continue
+        bots = [env[b] for b in l.bottoms]
+        x = bots[0] if bots else None
+        blobs = weight_blobs.get(l.name, l.blobs)
+        t = l.type
+
+        if t == "Convolution":
+            p = l.params.get("convolution_param", {})
+            if int(p.get("group", 1)) != 1:
+                raise NotImplementedError(f"{l.name}: grouped conv")
+            ks = _as_list(p.get("kernel_size", []))
+            kh = int(p.get("kernel_h", ks[0] if ks else 3))
+            kw = int(p.get("kernel_w", ks[-1] if ks else kh))
+            st = _as_list(p.get("stride", []))
+            sh = int(p.get("stride_h", st[0] if st else 1))
+            sw = int(p.get("stride_w", st[-1] if st else 1))
+            pd = _as_list(p.get("pad", []))
+            ph = int(p.get("pad_h", pd[0] if pd else 0))
+            pw = int(p.get("pad_w", pd[-1] if pd else 0))
+            bias = bool(p.get("bias_term", True))
+            layer = ShareConvolution2D(
+                int(p["num_output"]), (kh, kw), pad_h=ph, pad_w=pw,
+                subsample=(sh, sw), bias=bias, dim_ordering="th", name=l.name)
+            y = layer(x)
+            if blobs:
+                W = blobs[0].data                     # (O, I, kH, kW)
+                weights[l.name] = {"W": W.transpose(2, 3, 1, 0)}
+                if bias and len(blobs) > 1:
+                    weights[l.name]["b"] = blobs[1].data.reshape(-1)
+            if l.bottoms[0] in hw:
+                h, w = hw[l.bottoms[0]]
+                hw[l.tops[0]] = ((h + 2 * ph - kh) // sh + 1,
+                                 (w + 2 * pw - kw) // sw + 1)
+        elif t == "InnerProduct":
+            p = l.params.get("inner_product_param", {})
+            bias = bool(p.get("bias_term", True))
+            flat = Flatten(name=l.name + "_flat")(x)
+            layer = Dense(int(p["num_output"]), bias=bias, name=l.name)
+            y = layer(flat)
+            if blobs:
+                W = blobs[0].data
+                W2 = W.reshape(W.shape[0], -1).T       # (O, I) -> (I, O)
+                weights[l.name] = {"W": W2}
+                if bias and len(blobs) > 1:
+                    weights[l.name]["b"] = blobs[1].data.reshape(-1)
+        elif t == "Pooling":
+            p = l.params.get("pooling_param", {})
+            pool = _pool_layer(p, l.name, hw.get(l.bottoms[0], (0, 0)))
+            y = pool(x)
+            if l.bottoms[0] in hw:
+                h, w = hw[l.bottoms[0]]
+                k = pool.pool_size
+                s = pool.strides
+                ph = int(p.get("pad_h", p.get("pad", 0)))
+                pw_ = int(p.get("pad_w", p.get("pad", 0)))
+                hw[l.tops[0]] = (-(-(h + 2 * ph - k[0]) // s[0]) + 1,
+                                 -(-(w + 2 * pw_ - k[1]) // s[1]) + 1)
+        elif t == "ReLU":
+            slope = l.params.get("relu_param", {}).get("negative_slope", 0.0)
+            layer = LeakyReLU(slope, name=l.name) if slope \
+                else Activation("relu", name=l.name)
+            y = layer(x)
+        elif t == "Sigmoid":
+            y = Activation("sigmoid", name=l.name)(x)
+        elif t == "TanH":
+            y = Activation("tanh", name=l.name)(x)
+        elif t == "Softmax":
+            y = Activation("softmax", name=l.name)(x)
+        elif t == "Dropout":
+            ratio = l.params.get("dropout_param", {}).get("dropout_ratio", 0.5)
+            y = Dropout(float(ratio), name=l.name)(x)
+        elif t == "LRN":
+            p = l.params.get("lrn_param", {})
+            if int(p.get("norm_region", 0)) != 0:
+                raise NotImplementedError(f"{l.name}: within-channel LRN")
+            y = LRN2D(alpha=float(p.get("alpha", 1.0)),
+                      k=float(p.get("k", 1.0)),
+                      beta=float(p.get("beta", 0.75)),
+                      n=int(p.get("local_size", 5)),
+                      dim_ordering="th", name=l.name)(x)
+        elif t == "BatchNorm":
+            p = l.params.get("batch_norm_param", {})
+            eps = float(p.get("eps", 1e-5))
+            layer = Scale((1, 1, 1), name=l.name)     # placeholder size
+            if blobs:
+                sf = float(blobs[2].data.reshape(-1)[0]) if len(blobs) > 2 \
+                    else 1.0
+                sf = sf if sf != 0 else 1.0
+                mean = blobs[0].data.reshape(-1) / sf
+                var = blobs[1].data.reshape(-1) / sf
+                C = mean.shape[0]
+                layer.size = (C, 1, 1)
+                inv = 1.0 / np.sqrt(var + eps)
+                weights[l.name] = {
+                    "w": inv.reshape(C, 1, 1).astype(np.float32),
+                    "b": (-mean * inv).reshape(C, 1, 1).astype(np.float32)}
+            y = layer(x)
+        elif t == "Scale":
+            p = l.params.get("scale_param", {})
+            bias = bool(p.get("bias_term", False))
+            layer = Scale((1, 1, 1), name=l.name)
+            if blobs:
+                g = blobs[0].data.reshape(-1)
+                C = g.shape[0]
+                layer.size = (C, 1, 1)
+                weights[l.name] = {
+                    "w": g.reshape(C, 1, 1).astype(np.float32),
+                    "b": (blobs[1].data.reshape(C, 1, 1).astype(np.float32)
+                          if bias and len(blobs) > 1
+                          else np.zeros((C, 1, 1), np.float32))}
+            y = layer(x)
+        elif t == "Eltwise":
+            p = l.params.get("eltwise_param", {})
+            coeff = _as_list(p.get("coeff", []))
+            if coeff and any(float(c) != 1.0 for c in coeff):
+                raise NotImplementedError(
+                    f"{l.name}: Eltwise SUM with non-unit coeffs {coeff}")
+            op = _ELTWISE_ENUM[p.get("operation", 1)]
+            y = Merge(mode=op, name=l.name)(bots)
+        elif t == "Concat":
+            p = l.params.get("concat_param", {})
+            axis = int(p.get("axis", p.get("concat_dim", 1)))
+            y = Merge(mode="concat", concat_axis=axis, name=l.name)(bots)
+        elif t == "Flatten":
+            y = Flatten(name=l.name)(x)
+        elif t == "Reshape":
+            p = l.params.get("reshape_param", {})
+            shp = p.get("shape", {})
+            dims = [int(d) for d in _as_list(
+                shp.get("dim") if isinstance(shp, dict) else shp)]
+            y = Reshape(tuple(dims[1:]), name=l.name)(x)   # strip batch
+        else:
+            raise NotImplementedError(
+                f"caffe layer {l.name!r}: unsupported type {t!r} "
+                "(Converter.scala parity subset)")
+        env[l.tops[0] if l.tops else l.name] = y
+        if l.tops and l.tops[0] not in hw and l.bottoms \
+                and l.bottoms[0] in hw and t in ("ReLU", "Sigmoid", "TanH",
+                                                 "Dropout", "LRN",
+                                                 "BatchNorm", "Scale"):
+            hw[l.tops[0]] = hw[l.bottoms[0]]
+
+    last = struct_layers[-1]
+    out = env[last.tops[0] if last.tops else last.name]
+    model = Model(input=inputs if len(inputs) > 1 else inputs[0], output=out,
+                  name=net.name or "caffe_net")
+    params = model.build(jax.random.PRNGKey(0))
+    for lname, w in weights.items():
+        params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+    state = model.init_state()
+    return CaffeModel(model, params, state)
+
+
+class CaffeModel:
+    """Imported-caffe facade: NCHW predict + the underlying (model, params,
+    state) triple for Estimator fine-tuning."""
+
+    def __init__(self, model, params, state):
+        self.model = model
+        self.params = params
+        self.state = state
+        self._jit = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+    def predict(self, x) -> np.ndarray:
+        return np.asarray(self._jit(self.params, self.state, jnp.asarray(x)))

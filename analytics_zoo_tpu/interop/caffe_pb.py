@@ -1,0 +1,345 @@
+"""Dependency-free wire-level codec for the Caffe protobuf subset.
+
+Reference parity: models/caffe/CaffeLoader.scala:1-718 reads
+prototxt + caffemodel through the generated caffe.proto classes; this module
+decodes (and encodes, for fixtures/tests) the subset of BVLC caffe.proto
+needed by the importer, reusing the varint/wire primitives from
+interop/onnx_pb.py, plus a parser for the prototxt TEXT format (the nested
+`key { ... }` / `key: value` syntax).
+
+Field numbers follow BVLC caffe.proto (master): NetParameter.layer=100
+(V2 LayerParameter) / .layers=2 (V1), LayerParameter.{name=1, type=2,
+bottom=3, top=4, blobs=7} and the per-type param messages listed in
+_LAYER_PARAM_FIELDS.  Self-consistency (encode->decode) is tested; the LeNet
+fixture round-trip is the import oracle (tests/test_caffe_import.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.interop.onnx_pb import (
+    _WIRE_I32, _WIRE_I64, _WIRE_LEN, _WIRE_VARINT, _f_bytes, _f_str,
+    _f_varint, _field, _read_varint, _write_varint, iter_fields)
+
+
+# ---------------------------------------------------------------- messages
+
+@dataclasses.dataclass
+class Blob:
+    data: np.ndarray                     # float32, shaped
+
+    def encode(self) -> bytes:
+        out = b""
+        dims = b"".join(_write_varint(int(d)) for d in self.data.shape)
+        out += _f_bytes(7, _f_bytes(1, dims))              # shape.dim packed
+        out += _f_bytes(5, np.asarray(self.data, "<f4").tobytes())  # data
+        return out
+
+
+def _decode_blob(buf: bytes) -> Blob:
+    shape: List[int] = []
+    legacy = {}
+    data = np.zeros((0,), np.float32)
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 7 and wtype == _WIRE_LEN:               # BlobShape
+            for f2, w2, v2 in iter_fields(val):
+                if f2 == 1:
+                    if w2 == _WIRE_LEN:                    # packed
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            shape.append(d)
+                    else:
+                        shape.append(val if isinstance(val, int) else v2)
+        elif fnum in (1, 2, 3, 4) and wtype == _WIRE_VARINT:
+            legacy[fnum] = val                             # num/ch/h/w
+        elif fnum == 5:
+            if wtype == _WIRE_LEN:                         # packed floats
+                data = np.frombuffer(val, "<f4").copy()
+            elif wtype == _WIRE_I32:
+                data = np.append(data, struct.unpack("<f", val)[0]) \
+                    .astype(np.float32)
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    if shape:
+        data = data.reshape(shape)
+    return Blob(data=data)
+
+
+@dataclasses.dataclass
+class CaffeLayer:
+    name: str
+    type: str
+    bottoms: List[str]
+    tops: List[str]
+    blobs: List[Blob]
+    params: Dict[str, Dict[str, Any]]    # param-message name -> fields
+
+
+# LayerParameter field number -> (param message name, field schema).
+# Schema maps field number -> (name, kind) with kind in
+# {"varint", "float", "repeated_varint", "string"}.
+_LAYER_PARAM_FIELDS = {
+    106: ("convolution_param", {
+        1: ("num_output", "varint"), 2: ("bias_term", "varint"),
+        3: ("pad", "repeated_varint"), 4: ("kernel_size", "repeated_varint"),
+        5: ("group", "varint"), 6: ("stride", "repeated_varint"),
+        9: ("pad_h", "varint"), 10: ("pad_w", "varint"),
+        11: ("kernel_h", "varint"), 12: ("kernel_w", "varint"),
+        13: ("stride_h", "varint"), 14: ("stride_w", "varint"),
+        18: ("dilation", "repeated_varint")}),
+    117: ("inner_product_param", {
+        1: ("num_output", "varint"), 2: ("bias_term", "varint"),
+        5: ("axis", "varint"), 6: ("transpose", "varint")}),
+    121: ("pooling_param", {
+        1: ("pool", "varint"), 2: ("kernel_size", "varint"),
+        3: ("stride", "varint"), 4: ("pad", "varint"),
+        5: ("kernel_h", "varint"), 6: ("kernel_w", "varint"),
+        7: ("stride_h", "varint"), 8: ("stride_w", "varint"),
+        9: ("pad_h", "varint"), 10: ("pad_w", "varint"),
+        12: ("global_pooling", "varint")}),
+    118: ("lrn_param", {
+        1: ("local_size", "varint"), 2: ("alpha", "float"),
+        3: ("beta", "float"), 4: ("norm_region", "varint"),
+        5: ("k", "float")}),
+    108: ("dropout_param", {1: ("dropout_ratio", "float")}),
+    139: ("batch_norm_param", {
+        1: ("use_global_stats", "varint"),
+        2: ("moving_average_fraction", "float"), 3: ("eps", "float")}),
+    142: ("scale_param", {
+        1: ("axis", "varint"), 2: ("num_axes", "varint"),
+        5: ("bias_term", "varint")}),
+    110: ("eltwise_param", {
+        1: ("operation", "varint"), 2: ("coeff", "float")}),
+    104: ("concat_param", {
+        1: ("concat_dim", "varint"), 2: ("axis", "varint")}),
+    125: ("softmax_param", {1: ("engine", "varint"), 2: ("axis", "varint")}),
+    135: ("flatten_param", {1: ("axis", "varint"), 2: ("end_axis", "varint")}),
+    143: ("input_param", {1: ("shape", "blobshape")}),
+    123: ("relu_param", {1: ("negative_slope", "float")}),
+}
+_PARAM_BY_NAME = {name: (fnum, schema)
+                  for fnum, (name, schema) in _LAYER_PARAM_FIELDS.items()}
+
+
+def _decode_param(schema, buf: bytes) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum not in schema:
+            continue
+        name, kind = schema[fnum]
+        if kind == "varint":
+            out[name] = int(val)
+        elif kind == "float":
+            out[name] = struct.unpack("<f", val)[0] if wtype == _WIRE_I32 \
+                else float(val)
+        elif kind == "repeated_varint":
+            lst = out.setdefault(name, [])
+            if wtype == _WIRE_LEN:                          # packed
+                pos = 0
+                while pos < len(val):
+                    v, pos = _read_varint(val, pos)
+                    lst.append(v)
+            else:
+                lst.append(int(val))
+        elif kind == "blobshape" and wtype == _WIRE_LEN:
+            dims = []
+            for f2, w2, v2 in iter_fields(val):
+                if f2 == 1 and w2 == _WIRE_LEN:
+                    pos = 0
+                    while pos < len(v2):
+                        d, pos = _read_varint(v2, pos)
+                        dims.append(d)
+                elif f2 == 1:
+                    dims.append(int(v2))
+            out.setdefault(name, []).append(dims)
+    return out
+
+
+def _decode_layer(buf: bytes) -> CaffeLayer:
+    layer = CaffeLayer("", "", [], [], [], {})
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 1:
+            layer.name = val.decode("utf-8")
+        elif fnum == 2:
+            layer.type = val.decode("utf-8")
+        elif fnum == 3:
+            layer.bottoms.append(val.decode("utf-8"))
+        elif fnum == 4:
+            layer.tops.append(val.decode("utf-8"))
+        elif fnum == 7:
+            layer.blobs.append(_decode_blob(val))
+        elif fnum in _LAYER_PARAM_FIELDS:
+            name, schema = _LAYER_PARAM_FIELDS[fnum]
+            layer.params[name] = _decode_param(schema, val)
+    return layer
+
+
+@dataclasses.dataclass
+class CaffeNet:
+    name: str
+    layers: List[CaffeLayer]
+    inputs: List[str]
+    input_shapes: List[List[int]]
+
+
+def load_net(data: bytes) -> CaffeNet:
+    """Decode a binary NetParameter (.caffemodel)."""
+    net = CaffeNet("", [], [], [])
+    legacy_dims: List[int] = []
+    for fnum, wtype, val in iter_fields(data):
+        if fnum == 1:
+            net.name = val.decode("utf-8")
+        elif fnum == 100:                                  # V2 layers
+            net.layers.append(_decode_layer(val))
+        elif fnum == 3:
+            net.inputs.append(val.decode("utf-8"))
+        elif fnum == 8 and wtype == _WIRE_LEN:             # input_shape
+            dims = []
+            for f2, w2, v2 in iter_fields(val):
+                if f2 == 1 and w2 == _WIRE_LEN:
+                    pos = 0
+                    while pos < len(v2):
+                        d, pos = _read_varint(v2, pos)
+                        dims.append(d)
+            net.input_shapes.append(dims)
+        elif fnum == 4 and wtype == _WIRE_VARINT:          # legacy input_dim
+            legacy_dims.append(int(val))
+    if not net.input_shapes and legacy_dims:
+        net.input_shapes = [legacy_dims[i:i + 4]
+                            for i in range(0, len(legacy_dims), 4)]
+    return net
+
+
+# ---------------------------------------------------------------- encoder
+# (for building test fixtures; the reference never writes caffemodels)
+
+def encode_param(name: str, fields: Dict[str, Any]) -> bytes:
+    fnum, schema = _PARAM_BY_NAME[name]
+    rev = {n: (f, kind) for f, (n, kind) in schema.items()}
+    out = b""
+    for k, v in fields.items():
+        f, kind = rev[k]
+        if kind == "varint":
+            out += _f_varint(f, int(v))
+        elif kind == "float":
+            out += _field(f, _WIRE_I32, struct.pack("<f", float(v)))
+        elif kind == "repeated_varint":
+            for item in (v if isinstance(v, (list, tuple)) else [v]):
+                out += _f_varint(f, int(item))
+        elif kind == "blobshape":
+            for dims in v:
+                packed = b"".join(_write_varint(int(d)) for d in dims)
+                out += _f_bytes(f, _f_bytes(1, packed))
+    return _f_bytes(fnum, out)
+
+
+def encode_layer(layer: CaffeLayer) -> bytes:
+    out = _f_str(1, layer.name) + _f_str(2, layer.type)
+    for b in layer.bottoms:
+        out += _f_str(3, b)
+    for t in layer.tops:
+        out += _f_str(4, t)
+    for blob in layer.blobs:
+        out += _f_bytes(7, blob.encode())
+    for pname, fields in layer.params.items():
+        out += encode_param(pname, fields)
+    return _f_bytes(100, out)
+
+
+def encode_net(net: CaffeNet) -> bytes:
+    out = _f_str(1, net.name)
+    for i, inp in enumerate(net.inputs):
+        out += _f_str(3, inp)
+    for dims in net.input_shapes:
+        packed = b"".join(_write_varint(int(d)) for d in dims)
+        out += _f_bytes(8, _f_bytes(1, packed))
+    body = b"".join(encode_layer(l) for l in net.layers)
+    return out + body
+
+
+# ---------------------------------------------------------------- prototxt
+
+def parse_prototxt(text: str) -> Dict[str, Any]:
+    """Parse Caffe's prototxt text format into nested dicts; repeated keys
+    collect into lists.  Handles `key: value`, `key { ... }`, strings,
+    numbers, booleans, and enum identifiers."""
+    tokens = _tokenize(text)
+    pos = [0]
+
+    def parse_block():
+        out: Dict[str, Any] = {}
+        while pos[0] < len(tokens):
+            tok = tokens[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return out
+            key = tok
+            pos[0] += 1
+            tok = tokens[pos[0]]
+            if tok == "{":
+                pos[0] += 1
+                val = parse_block()
+            elif tok == ":":
+                pos[0] += 1
+                val = _convert(tokens[pos[0]])
+                pos[0] += 1
+            else:
+                raise ValueError(f"prototxt parse error near {tok!r}")
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(val)
+            else:
+                out[key] = val
+        return out
+
+    return parse_block()
+
+
+def _tokenize(text: str) -> List[str]:
+    tokens: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in " \t\r\n,":
+            i += 1
+        elif c in "{}:":
+            tokens.append(c)
+            i += 1
+        elif c in "\"'":
+            j = text.index(c, i + 1)
+            tokens.append(text[i:j + 1])
+            i = j + 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n{}:#,\"'":
+                j += 1
+            tokens.append(text[i:j])
+            i = j
+    return tokens
+
+
+def _convert(tok: str):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    if tok in ("true", "True"):
+        return True
+    if tok in ("false", "False"):
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok                        # enum identifier (MAX, AVE, ...)

@@ -391,7 +391,7 @@ class OnnxNet(Layer):
 
     def call(self, params, inputs, *, training=False, rng=None):
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        return run_graph(self.graph, params, xs)
+        return run_graph(self.graph, params, xs)[0]
 
 
 def load_onnx(path_or_bytes) -> OnnxNet:

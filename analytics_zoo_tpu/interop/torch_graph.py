@@ -42,6 +42,9 @@ class ConvertedGraph(NamedTuple):
     input_names: Tuple[str, ...]
     output_names: Tuple[str, ...]
     input_shapes: Tuple[Optional[Tuple[int, ...]], ...] = ()  # traced, incl. batch
+    # batch-norm moving statistics, carried as Layer STATE (not trainable —
+    # round 2 kept them in params, where fine-tuning applied SGD to them)
+    state: Dict[str, np.ndarray] = {}
 
 
 # --------------------------------------------------------------------------
@@ -110,9 +113,10 @@ def _aten_addmm(b, x, w, beta=1, alpha=1):
 
 
 def _aten_batch_norm(x, w, b, mean, var, training, momentum, eps, *_):
-    if training:
-        raise NotImplementedError(
-            "imported TorchScript graphs must be traced in eval() mode")
+    """Inference-mode normalize against the supplied (moving) statistics.
+    Training-mode execution is handled by the run_graph executor, which owns
+    the moving-stat state updates (torch semantics: normalize with biased
+    batch var, update running stats with unbiased var at `momentum`)."""
     shape = (1, -1) + (1,) * (x.ndim - 2)
     inv = jax.lax.rsqrt(var.reshape(shape) + eps)
     y = (x - mean.reshape(shape)) * inv
@@ -363,8 +367,9 @@ ATEN_OPS: Dict[str, Callable] = {
     "aten::maximum": jnp.maximum, "aten::minimum": jnp.minimum,
     "aten::max": lambda x, *a: _aten_minmax(x, jnp.max, jnp.argmax, a),
     "aten::min": lambda x, *a: _aten_minmax(x, jnp.min, jnp.argmin, a),
-    "aten::argmax": lambda x, dim=None, keepdim=False: jnp.argmax(
-        x, axis=None if dim is None else int(dim)),
+    "aten::argmax": lambda x, dim=None, keepdim=False: (
+        jnp.argmax(x, axis=None if dim is None else int(dim),
+                   keepdims=bool(keepdim))),
     "aten::mse_loss": lambda p, t, reduction=1: _reduce((p - t) ** 2, reduction),
     "aten::l1_loss": lambda p, t, reduction=1: _reduce(jnp.abs(p - t), reduction),
     "aten::binary_cross_entropy": lambda p, t, w=None, reduction=1: _reduce(
@@ -388,25 +393,48 @@ def _reduce(per, reduction):
 # Graph walking
 # --------------------------------------------------------------------------
 
-def convert_torchscript(scripted) -> ConvertedGraph:
-    """Freeze+inline a ScriptModule and lower its graph to a Step program."""
+def convert_torchscript(scripted, preserve_training: bool = False) \
+        -> ConvertedGraph:
+    """Lower a ScriptModule's graph to a Step program.
+
+    preserve_training=False (default): eval + freeze — dropout disappears
+    from the trace and batch_norm carries its moving stats (inference
+    import, the reference TorchNet's semantics).
+
+    preserve_training=True: the module is converted AS TRACED (trace it in
+    train() mode) without freezing, so dropout/batch_norm nodes survive for
+    fine-tuning; prim::GetAttr chains are resolved here at conversion time
+    (the job freezing normally does) — nn.Parameters become trainable
+    params, buffers become consts (BN stats then move to state below)."""
     import torch
 
     if not isinstance(scripted, torch.jit.ScriptModule):
         raise TypeError("expected a torch.jit.ScriptModule (trace/script first)")
     mod = scripted
-    if getattr(mod, "training", False):
-        mod = mod.eval()
-    try:
-        mod = torch.jit.freeze(mod)
-    except RuntimeError:
-        pass  # already frozen
-    graph = mod.graph
-    torch._C._jit_pass_inline(graph)
 
     params: Dict[str, np.ndarray] = {}
     consts: Dict[str, Any] = {}
     steps: List[Step] = []
+    attr_objs: Dict[str, Any] = {}
+    tensor_ids: Dict[int, str] = {}      # id(tensor) -> canonical value name
+    alias: Dict[str, str] = {}           # duplicate value name -> canonical
+
+    if not preserve_training:
+        if getattr(mod, "training", False):
+            mod = mod.eval()
+        try:
+            # optimize_numerics=False keeps batch_norm nodes intact (the
+            # default folds BN into the preceding conv, which would freeze
+            # the statistics and silently break later fine-tuning)
+            mod = torch.jit.freeze(mod, optimize_numerics=False)
+        except RuntimeError:
+            pass  # already frozen
+    graph = mod.graph
+    torch._C._jit_pass_inline(graph)
+    if preserve_training:
+        for g_in in graph.inputs():
+            if g_in.debugName().startswith("self"):
+                attr_objs[g_in.debugName()] = mod
 
     real_inputs = [i for i in graph.inputs()
                    if not i.debugName().startswith("self")]
@@ -446,9 +474,31 @@ def convert_torchscript(scripted) -> ConvertedGraph:
         elif kind == "prim::NumToTensor":
             steps.append(Step(kind, lambda v: v, ins, outs))
         elif kind == "prim::GetAttr":
-            raise NotImplementedError(
-                "prim::GetAttr survived freezing — load the module in eval() "
-                "mode and re-trace")
+            if not preserve_training:
+                raise NotImplementedError(
+                    "prim::GetAttr survived freezing — load the module in "
+                    "eval() mode and re-trace")
+            parent = attr_objs.get(ins[0])
+            if parent is None:
+                raise NotImplementedError(
+                    f"prim::GetAttr on unresolved object {ins[0]}")
+            obj = getattr(parent, node.s("name"))
+            attr_objs[outs[0]] = obj
+            if isinstance(obj, torch.Tensor):
+                # the inlined graph emits one GetAttr per access: dedupe by
+                # the underlying tensor so weight tying / reused submodules
+                # keep ONE trainable copy (aliases resolved below)
+                prev = tensor_ids.get(id(obj))
+                if prev is not None:
+                    alias[outs[0]] = prev
+                else:
+                    tensor_ids[id(obj)] = outs[0]
+                    arr = obj.detach().cpu().numpy()
+                    if isinstance(obj, torch.nn.Parameter) and \
+                            np.issubdtype(arr.dtype, np.floating):
+                        params[outs[0]] = arr
+                    else:
+                        consts[outs[0]] = jnp.asarray(arr)
         elif kind in ATEN_OPS:
             steps.append(Step(kind, ATEN_OPS[kind], ins, outs))
         else:
@@ -456,25 +506,83 @@ def convert_torchscript(scripted) -> ConvertedGraph:
                 f"TorchScript op {kind} has no JAX mapping yet "
                 f"(add it to torch_graph.ATEN_OPS)")
 
-    output_names = tuple(o.debugName() for o in graph.outputs())
+    if alias:
+        steps = [Step(s.kind, s.fn,
+                      tuple(alias.get(n, n) for n in s.in_names),
+                      s.out_names) for s in steps]
+
+    output_names = tuple(alias.get(o.debugName(), o.debugName())
+                         for o in graph.outputs())
+
+    # Move batch-norm moving statistics out of the trainable params into
+    # state: they must not receive optimizer updates, and training-mode
+    # execution updates them as torch running stats.
+    state: Dict[str, np.ndarray] = {}
+    for step in steps:
+        if step.kind == "aten::batch_norm":
+            for name in step.in_names[3:5]:          # running_mean, running_var
+                if name in params:
+                    state[name] = params.pop(name)
+                elif name in consts:                 # buffers (preserve path)
+                    state[name] = np.asarray(consts.pop(name))
     return ConvertedGraph(params, consts, steps, input_names, output_names,
-                          input_shapes)
+                          input_shapes, state)
 
 
-def run_graph(cg: ConvertedGraph, params, inputs: Sequence):
-    """Execute the Step program as a pure function of (params, inputs)."""
+def run_graph(cg: ConvertedGraph, params, inputs: Sequence, state=None,
+              *, training: bool = False, rng=None):
+    """Execute the Step program as a pure function of (params, state, inputs).
+
+    Returns (output, new_state).  With training=True, aten::batch_norm
+    normalizes with batch statistics and advances the running stats in
+    `new_state` (torch semantics), and aten::dropout drops with `rng`
+    (identity when rng is None, matching torch's eval behaviour)."""
     env: Dict[str, Any] = dict(cg.consts)
     env.update(params)
+    state = dict(cg.state) if state is None else dict(state)
+    env.update(state)
     if len(inputs) != len(cg.input_names):
         raise ValueError(
             f"graph expects {len(cg.input_names)} inputs, got {len(inputs)}")
     env.update(zip(cg.input_names, inputs))
-    for step in cg.steps:
+    new_state = dict(state)
+    for idx, step in enumerate(cg.steps):
         args = [env[n] for n in step.in_names]
-        out = step.fn(*args)
+        # training-mode behaviour requires BOTH the runtime flag and the
+        # node's own traced flag: an eval-imported graph (traced flag False)
+        # must keep frozen-eval semantics even inside a fit loop
+        if training and step.kind == "aten::batch_norm" and bool(args[5]):
+            x, w, b = args[0], args[1], args[2]
+            momentum, eps = args[6], args[7]
+            red = (0,) + tuple(range(2, x.ndim))
+            x32 = x.astype(jnp.float32)
+            bmean = jnp.mean(x32, axis=red)
+            bvar = jnp.mean(x32 * x32, axis=red) - bmean * bmean
+            bvar = jnp.maximum(bvar, 0.0)
+            out = _aten_batch_norm(x, w, b, bmean, bvar, False, momentum, eps)
+            n = float(np.prod([x.shape[i] for i in red]))
+            unbiased = bvar * (n / max(n - 1.0, 1.0))
+            mname, vname = step.in_names[3], step.in_names[4]
+            if mname in new_state:       # torch: r = (1-m)*r + m*batch
+                new_state[mname] = (1 - momentum) * env[mname] \
+                    + momentum * bmean
+                new_state[vname] = (1 - momentum) * env[vname] \
+                    + momentum * unbiased
+        elif training and rng is not None and step.kind in (
+                "aten::dropout", "aten::dropout_", "aten::feature_dropout") \
+                and bool(args[2]):
+            x, p = args[0], float(args[1])
+            if p > 0.0:
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(rng, idx), 1.0 - p, x.shape)
+                out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+            else:
+                out = x
+        else:
+            out = step.fn(*args)
         if len(step.out_names) == 1:
             env[step.out_names[0]] = out
         else:
             env.update(zip(step.out_names, out))
     outs = [env[n] for n in cg.output_names]
-    return outs[0] if len(outs) == 1 else tuple(outs)
+    return (outs[0] if len(outs) == 1 else tuple(outs)), new_state

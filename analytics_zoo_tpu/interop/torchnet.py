@@ -23,12 +23,12 @@ from analytics_zoo_tpu.interop.torch_graph import (
 from analytics_zoo_tpu.nn.module import Layer
 
 
-def _trace(module, example_input, check_trace=True):
+def _trace(module, example_input, check_trace=True, train_mode=False):
     import torch
 
     if isinstance(module, torch.jit.ScriptModule):
         return module
-    module = module.eval()
+    module = module.train() if train_mode else module.eval()
     ex = example_input
     if isinstance(ex, np.ndarray):
         ex = torch.as_tensor(ex)
@@ -36,7 +36,8 @@ def _trace(module, example_input, check_trace=True):
         ex = (ex,)
     ex = tuple(torch.as_tensor(e) if isinstance(e, np.ndarray) else e
                for e in ex)
-    return torch.jit.trace(module, ex, check_trace=check_trace)
+    return torch.jit.trace(module, ex,
+                           check_trace=check_trace and not train_mode)
 
 
 class TorchNet(Layer):
@@ -47,13 +48,14 @@ class TorchNet(Layer):
     """
 
     def __init__(self, path: Optional[str] = None, *, scripted=None,
-                 input_shape=None, **kwargs):
+                 input_shape=None, preserve_training=False, **kwargs):
         if scripted is None:
             if path is None:
                 raise ValueError("TorchNet needs a TorchScript path or module")
             import torch
             scripted = torch.jit.load(path, map_location="cpu")
-        self.graph: ConvertedGraph = convert_torchscript(scripted)
+        self.graph: ConvertedGraph = convert_torchscript(
+            scripted, preserve_training=preserve_training)
         if input_shape is None:
             shapes = [s[1:] if s else None for s in self.graph.input_shapes]
             if len(shapes) == 1:
@@ -64,14 +66,23 @@ class TorchNet(Layer):
 
     @staticmethod
     def from_pytorch(module, input, check_trace: bool = True,
+                     preserve_training: Optional[bool] = None,
                      **kwargs) -> "TorchNet":
-        """Trace a live torch.nn.Module on `input` (tensor/ndarray or tuple)."""
-        scripted = _trace(module, input, check_trace)
+        """Trace a live torch.nn.Module on `input` (tensor/ndarray or tuple).
+
+        preserve_training defaults to the module's own .training flag: pass a
+        module in train() mode to keep dropout/batch_norm fine-tunable
+        (TorchNet.scala supports training through libtorch; here the
+        training-mode graph is preserved and run natively)."""
+        if preserve_training is None:
+            preserve_training = bool(getattr(module, "training", False))
+        scripted = _trace(module, input, check_trace,
+                          train_mode=preserve_training)
         shapes = [tuple(t.shape[1:]) for t in
                   (input if isinstance(input, (tuple, list)) else [input])]
         return TorchNet(scripted=scripted,
                         input_shape=shapes[0] if len(shapes) == 1 else shapes,
-                        **kwargs)
+                        preserve_training=preserve_training, **kwargs)
 
     def build(self, rng, input_shape):
         return {k: jnp.asarray(v) for k, v in self.graph.params.items()}
@@ -80,11 +91,20 @@ class TorchNet(Layer):
         # Unlike native layers the params are fully determined by the imported
         # graph, so init works without an input shape (torch.jit.load drops
         # the traced shape metadata).
-        return self.build(rng, input_shape), {}
+        return self.build(rng, input_shape), self.init_state(input_shape)
+
+    def init_state(self, input_shape=None):
+        return {k: jnp.asarray(v) for k, v in self.graph.state.items()}
+
+    def apply(self, params, state, inputs, *, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return run_graph(self.graph, params, xs, state,
+                         training=training, rng=rng)
 
     def call(self, params, inputs, *, training=False, rng=None):
         xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        return run_graph(self.graph, params, xs)
+        y, _ = run_graph(self.graph, params, xs, training=training, rng=rng)
+        return y
 
 
 class TorchCriterion:
@@ -112,4 +132,4 @@ class TorchCriterion:
         return TorchCriterion(torch.jit.trace(loss, (ex_in, ex_lbl)))
 
     def __call__(self, y_pred, y_true):
-        return run_graph(self.graph, self._params, [y_pred, y_true])
+        return run_graph(self.graph, self._params, [y_pred, y_true])[0]

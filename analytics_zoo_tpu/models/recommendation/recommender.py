@@ -110,15 +110,38 @@ def ndcg(scores: np.ndarray, k: int = 10) -> float:
 
 def evaluate_ranking(model, test_pos: np.ndarray, item_count: int,
                      num_neg: int = 100, k: int = 10, seed: int = 0,
-                     batch_size: int = 8192, positive_class: int = 1):
+                     batch_size: int = 8192, positive_class: int = 1,
+                     exclude_pos=None):
     """Leave-one-out ranking eval: for each (user, pos_item), score against `num_neg`
     random negatives; report HR@k and NDCG@k.  `positive_class` indexes the probability
-    column used as the ranking score (binary NCF: class 1)."""
+    column used as the ranking score (binary NCF: class 1).
+
+    `exclude_pos`: optional {user_id: set(item_ids)} of known interactions —
+    negatives colliding with them are resampled, matching the reference
+    protocol (Utils.scala samples negatives the user has NOT interacted
+    with; without this, a user's own training positives appear among the
+    negatives and unfairly outrank the held-out item)."""
     rng = np.random.default_rng(seed)
     B = test_pos.shape[0]
     cand = np.empty((B, 1 + num_neg), np.float32)
     cand[:, 0] = test_pos[:, 1]
-    cand[:, 1:] = rng.integers(1, item_count + 1, size=(B, num_neg))
+    neg = rng.integers(1, item_count + 1, size=(B, num_neg))
+    if exclude_pos is not None:
+        # vectorized rejection: encode (user, item) pairs as int keys and
+        # redraw colliding entries against the flat seen-key set
+        seen_keys = np.fromiter(
+            (u * (item_count + 1) + i
+             for u, items in exclude_pos.items() for i in items),
+            np.int64)
+        seen_keys = np.sort(seen_keys)
+        urep = test_pos[:, 0].astype(np.int64)[:, None] * (item_count + 1)
+        for _ in range(20):
+            bad = np.isin(urep + neg, seen_keys)
+            n_bad = int(bad.sum())
+            if n_bad == 0:
+                break
+            neg[bad] = rng.integers(1, item_count + 1, size=n_bad)
+    cand[:, 1:] = neg
     users = np.repeat(test_pos[:, 0].astype(np.float32), 1 + num_neg)[:, None]
     items = cand.reshape(-1)[:, None]
     probs = model.predict([users, items], batch_size=batch_size)

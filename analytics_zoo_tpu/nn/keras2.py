@@ -69,7 +69,7 @@ def Conv2D(filters, kernel_size, strides=1, padding="valid", activation=None,
         filters, kernel_size, activation=activation, border_mode=padding,
         subsample=strides, dilation=dilation_rate, init=kernel_initializer,
         bias=use_bias,
-        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+        dim_ordering=_do(data_format), **kw)
 
 
 def MaxPooling1D(pool_size=2, strides=None, padding="valid", **kw):
@@ -80,7 +80,7 @@ def MaxPooling2D(pool_size=2, strides=None, padding="valid",
                  data_format="channels_last", **kw):
     return _pool.MaxPooling2D(
         pool_size, strides, border_mode=padding,
-        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+        dim_ordering=_do(data_format), **kw)
 
 
 def AveragePooling1D(pool_size=2, strides=None, padding="valid", **kw):
@@ -91,7 +91,7 @@ def AveragePooling2D(pool_size=2, strides=None, padding="valid",
                      data_format="channels_last", **kw):
     return _pool.AveragePooling2D(
         pool_size, strides, border_mode=padding,
-        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+        dim_ordering=_do(data_format), **kw)
 
 
 def GlobalMaxPooling1D(**kw):
@@ -100,7 +100,7 @@ def GlobalMaxPooling1D(**kw):
 
 def GlobalAveragePooling2D(data_format="channels_last", **kw):
     return _pool.GlobalAveragePooling2D(
-        dim_ordering="tf" if data_format == "channels_last" else "th", **kw)
+        dim_ordering=_do(data_format), **kw)
 
 
 # -- merge-op classes (keras2/layers/merge) ----------------------------------
@@ -150,6 +150,13 @@ class Dot(_core.Merge):
                 "Dot currently supports rank-2 inputs dotted along the "
                 f"feature axis (axes=1); got axes={axes!r}")
         super().__init__(mode="cos" if normalize else "dot", **kw)
+
+    def _merge(self, xs):
+        if any(getattr(x, "ndim", 2) != 2 for x in xs):
+            raise NotImplementedError(
+                "Dot supports rank-2 (B, d) inputs only; got shapes "
+                f"{[getattr(x, 'shape', None) for x in xs]}")
+        return super()._merge(xs)
 
 
 def add(inputs, **kw):

@@ -91,6 +91,21 @@ class _ConvND(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = self._to_tf(x)
+        if "W_q" in params:
+            # int8 PTQ path (inference/quantize.py): s8 x s8 -> s32 conv on
+            # the MXU, dequantized by per-output-channel scale.
+            s_x = params["s_x"]
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, params["W_q"], window_strides=self.subsample,
+                padding=_pad_str(self.border_mode), rhs_dilation=self.dilation,
+                dimension_numbers=self._dn(),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * params["s_w"])
+            if self.bias:
+                y = y + params["b"]
+            return self._from_tf(self.activation(y.astype(dtypes.param_dtype())))
         xw, W = dtypes.cast_compute(x, params["W"])
         y = jax.lax.conv_general_dilated(
             xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode),
@@ -554,21 +569,28 @@ class ResizeBilinear(Layer):
 
 
 class LRN2D(Layer):
-    """Cross-channel local response normalization (LRN2D.scala, NHWC):
-    y = x / (k + alpha/n * sum_{local n channels} x^2)^beta."""
+    """Cross-channel local response normalization (LRN2D.scala):
+    y = x / (k + alpha/n * sum_{local n channels} x^2)^beta.
+    dim_ordering "tf" normalizes the last axis, "th" axis 1 (NCHW)."""
 
-    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, **kwargs):
+    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, dim_ordering="tf",
+                 **kwargs):
         super().__init__(**kwargs)
         self.alpha = float(alpha)
         self.k = float(k)
         self.beta = float(beta)
         self.n = int(n)
+        self.dim_ordering = dim_ordering
 
     def call(self, params, x, *, training=False, rng=None):
+        th = self.dim_ordering == "th"
+        if th:
+            x = jnp.moveaxis(x, 1, -1)
         half = self.n // 2
         sq = x * x
         C = x.shape[-1]
-        # windowed channel sum via padded cumulative trick (vectorized)
+        # windowed channel sum via padded shifted slices (vectorized)
         pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
         acc = sum(pad[..., i:i + C] for i in range(self.n))
-        return x / jnp.power(self.k + self.alpha / self.n * acc, self.beta)
+        y = x / jnp.power(self.k + self.alpha / self.n * acc, self.beta)
+        return jnp.moveaxis(y, -1, 1) if th else y

@@ -41,6 +41,20 @@ class Dense(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
+        if "W_q" in params:
+            # Post-training-quantized path (inference/quantize.py): symmetric
+            # int8 activations (per-tensor scale from calibration) x int8
+            # weights (per-output-channel scale), int32 MXU accumulation.
+            s_x = params["s_x"]
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, params["W_q"], (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * params["s_w"])
+            if "b" in params:
+                y = y + params["b"]
+            return self.activation(y.astype(dtypes.param_dtype()))
         xw, W = dtypes.cast_compute(x, params["W"])
         y = jnp.matmul(xw, W, preferred_element_type=dtypes.param_dtype())
         if self.bias:

@@ -25,12 +25,17 @@ class _PoolND(Layer):
     op = "max"
 
     def __init__(self, pool_size=2, strides=None, border_mode="valid",
-                 dim_ordering="tf", **kwargs):
+                 dim_ordering="tf", padding=None, **kwargs):
         super().__init__(**kwargs)
         self.pool_size = _tuplize(pool_size, self.ndim)
         self.strides = _tuplize(strides, self.ndim) if strides else self.pool_size
         self.border_mode = border_mode.upper()
         self.dim_ordering = dim_ordering
+        # explicit asymmetric spatial padding ((lo, hi) per spatial dim),
+        # applied with the pooling op's identity (-inf for max, 0 for avg) —
+        # Caffe-style explicit/ceil-mode padding (interop/caffe.py)
+        self.padding = None if padding is None else \
+            tuple((int(a), int(b)) for a, b in padding)
 
     def _spatial_axes(self, rank):
         if self.dim_ordering == "th":
@@ -43,6 +48,12 @@ class _PoolND(Layer):
         strides = [1] * rank
         for ax, w, s in zip(self._spatial_axes(rank), self.pool_size, self.strides):
             window[ax], strides[ax] = w, s
+        if self.padding is not None:
+            pads = [(0, 0)] * rank
+            for ax, p in zip(self._spatial_axes(rank), self.padding):
+                pads[ax] = p
+            fill = -jnp.inf if self.op == "max" else 0.0
+            x = jnp.pad(x, pads, constant_values=fill)
         if self.op == "max":
             init, fn = -jnp.inf, jax.lax.max
             y = jax.lax.reduce_window(x, init, fn, window, strides,

@@ -308,65 +308,3 @@ class Highway(Layer):
         return t * h + (1.0 - t) * x
 
 
-class ConvLSTM3D(Layer):
-    """Convolutional LSTM with 3D-conv gates (ConvLSTM3D.scala /
-    InternalConvLSTM3D.scala): input (B, T, D, H, W, C) channels-last."""
-
-    def __init__(self, nb_filter: int, nb_kernel: int, return_sequences=False,
-                 border_mode="same", inner_activation="hard_sigmoid",
-                 activation="tanh", init="glorot_uniform", **kwargs):
-        super().__init__(**kwargs)
-        self.nb_filter = int(nb_filter)
-        self.k = int(nb_kernel)
-        self.return_sequences = return_sequences
-        self.border_mode = border_mode
-        self.inner_activation = activations.get(inner_activation)
-        self.activation = activations.get(activation)
-        self.init_name = init
-
-    def build(self, rng, input_shape):
-        _, D, H, W, C = to_shape(input_shape)
-        r1, r2 = jax.random.split(rng)
-        F = self.nb_filter
-        k3 = (self.k,) * 3
-        return {
-            "Wx": initializer(self.init_name, r1, k3 + (C, 4 * F),
-                              dtypes.param_dtype(),
-                              fan_in=self.k ** 3 * C,
-                              fan_out=self.k ** 3 * F),
-            "Wh": initializer(self.init_name, r2, k3 + (F, 4 * F),
-                              dtypes.param_dtype(),
-                              fan_in=self.k ** 3 * F,
-                              fan_out=self.k ** 3 * F),
-            "b": jnp.zeros((4 * F,), dtypes.param_dtype()),
-        }
-
-    def _conv(self, x, W):
-        xw, Ww = dtypes.cast_compute(x, W)
-        dn = jax.lax.conv_dimension_numbers(x.shape, W.shape,
-                                            ("NDHWC", "DHWIO", "NDHWC"))
-        return jax.lax.conv_general_dilated(
-            xw, Ww, (1, 1, 1), "SAME", dimension_numbers=dn,
-            preferred_element_type=jnp.float32)
-
-    def call(self, params, x, *, training=False, rng=None):
-        B, T, D, H, W, C = x.shape
-        F = self.nb_filter
-        xs = jnp.swapaxes(x, 0, 1)
-        h0 = jnp.zeros((B, D, H, W, F), jnp.float32)
-        c0 = jnp.zeros((B, D, H, W, F), jnp.float32)
-
-        def body(carry, x_t):
-            h, c = carry
-            z = (self._conv(x_t, params["Wx"]) + self._conv(h, params["Wh"])
-                 + params["b"])
-            i = self.inner_activation(z[..., :F])
-            f = self.inner_activation(z[..., F:2 * F])
-            g = self.activation(z[..., 2 * F:3 * F])
-            o = self.inner_activation(z[..., 3 * F:])
-            c_new = f * c + i * g
-            h_new = o * self.activation(c_new)
-            return (h_new, c_new), h_new
-
-        (_, _), ys = jax.lax.scan(body, (h0, c0), xs)
-        return jnp.swapaxes(ys, 0, 1) if self.return_sequences else ys[-1]

@@ -1,0 +1,80 @@
+"""MovieLens pipeline tests (VERDICT r2 #3): ratings.dat parsing, leave-one-out
+split, reference-style negative sampling, and a small end-to-end NCF train+eval
+run beating chance HR@10 by a wide margin."""
+
+import numpy as np
+
+from analytics_zoo_tpu.models.recommendation import NeuralCF, evaluate_ranking
+from analytics_zoo_tpu.models.recommendation.movielens import (
+    leave_one_out, load_ml1m, synthetic_ml1m, training_arrays)
+from analytics_zoo_tpu.nn.optimizers import Adam
+
+
+def test_load_ml1m_parses_and_reindexes(tmp_path):
+    f = tmp_path / "ratings.dat"
+    f.write_text("1::1193::5::978300760\n"
+                 "1::661::3::978302109\n"
+                 "2::1193::4::978298413\n"
+                 "2::3952::1::978299000\n")
+    r = load_ml1m(str(tmp_path))
+    assert r.shape == (4, 4)
+    # dense re-index: {661, 1193, 3952} -> {1, 2, 3} by original-id order
+    assert set(r[:, 1]) == {1, 2, 3}
+    assert r[0, 1] == 2 and r[1, 1] == 1 and r[3, 1] == 3
+    assert r[0, 2] == 5 and r[0, 3] == 978300760
+
+
+def test_leave_one_out_holds_latest_per_user():
+    ratings = np.array([
+        [1, 10, 5, 100], [1, 11, 4, 200], [1, 12, 3, 50],
+        [2, 20, 5, 10], [2, 21, 2, 99],
+    ], np.int64)
+    train, test = leave_one_out(ratings)
+    assert test.tolist() == [[1, 11], [2, 21]]       # latest ts per user
+    assert sorted(train.tolist()) == [[1, 10], [1, 12], [2, 20]]
+
+
+def test_training_arrays_structure():
+    train = np.array([[1, 5], [1, 6], [2, 7]], np.int64)
+    users, items, labels = training_arrays(train, n_items=50, n_neg=4, seed=0)
+    assert users.shape == (15, 1) and labels.sum() == 3
+    # every positive pair present with label 1
+    triples = {(int(u), int(i), int(l))
+               for u, i, l in zip(users[:, 0], items[:, 0], labels[:, 0])}
+    for u, i in train:
+        assert (u, i, 1) in triples
+    # negatives: 4 per positive, right users
+    for u in (1, 2):
+        count = ((users[:, 0] == u) & (labels[:, 0] == 0)).sum()
+        assert count == 4 * (2 if u == 1 else 1)
+
+
+def test_synthetic_ml1m_shape_and_signal():
+    r = synthetic_ml1m(n_users=50, n_items=200, ratings_per_user=30, seed=1)
+    assert r.shape == (50 * 30, 4)
+    assert r[:, 0].min() == 1 and r[:, 0].max() == 50
+    assert r[:, 1].min() >= 1 and r[:, 1].max() <= 200
+    # heavy-tailed item popularity: top-10% of items get >25% of interactions
+    counts = np.bincount(r[:, 1], minlength=201)[1:]
+    top = np.sort(counts)[::-1][:20].sum()
+    assert top / counts.sum() > 0.25
+
+
+def test_ncf_movielens_end_to_end_beats_chance(ctx):
+    ratings = synthetic_ml1m(n_users=300, n_items=400, ratings_per_user=60,
+                             seed=3)
+    train_pos, test_pos = leave_one_out(ratings)
+    ncf = NeuralCF(user_count=300, item_count=400, class_num=2,
+                   user_embed=32, item_embed=32, hidden_layers=(64, 32),
+                   mf_embed=32)
+    ncf.compile(optimizer=Adam(lr=2e-3),
+                loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    for epoch in range(5):
+        users, items, labels = training_arrays(train_pos, 400, n_neg=4,
+                                               seed=epoch)
+        ncf.fit([users, items], labels, batch_size=2048, nb_epoch=1,
+                verbose=False)
+    m = evaluate_ranking(ncf, test_pos, 400, num_neg=99, k=10, seed=5)
+    # chance HR@10 is ~0.10; trained model must far exceed it
+    assert m["hit_ratio"] > 0.25, m  # ~2.5x chance
+    assert m["ndcg"] > 0.12, m

@@ -1,0 +1,230 @@
+"""Torch-import training-mode support (VERDICT r2 #7).
+
+Done criteria: training-mode batch_norm uses batch stats and ADVANCES the
+moving statistics (carried as Layer state, not trainable params); dropout
+actually drops under an rng; aten::argmax honors keepdim; and imported-model
+gradients match torch autograd to 1e-4 (inputs AND parameters); a BN+dropout
+CNN fine-tunes through the Estimator with moving stats updating.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                    # noqa: E402
+import jax.numpy as jnp                       # noqa: E402
+
+from analytics_zoo_tpu.interop.torch_graph import convert_torchscript  # noqa: E402
+from analytics_zoo_tpu.interop.torchnet import TorchNet  # noqa: E402
+
+
+class BNDropCNN(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(3, 8, 3, padding=1)
+        self.bn = torch.nn.BatchNorm2d(8)
+        self.drop = torch.nn.Dropout(0.5)
+        self.fc = torch.nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        h = torch.relu(self.bn(self.conv(x)))
+        h = torch.nn.functional.avg_pool2d(h, 2)
+        h = self.drop(h.flatten(1))
+        return self.fc(h)
+
+
+def _import_net(rng, train=False):
+    m = BNDropCNN().eval()
+    # give the moving stats non-trivial values so state vs batch is detectable
+    with torch.no_grad():
+        m.bn.running_mean.uniform_(-0.5, 0.5)
+        m.bn.running_var.uniform_(0.5, 2.0)
+    x = torch.randn(4, 3, 8, 8)
+    if train:
+        m = m.train()
+    net = TorchNet.from_pytorch(m, x)
+    m.eval()
+    return m, net
+
+
+def test_bn_moving_stats_live_in_state_not_params(rng):
+    m, net = _import_net(rng)
+    params = net.build(None, None)
+    state = net.init_state()
+    assert len(state) == 2                      # running_mean, running_var
+    mean_state = sorted(np.asarray(v).tolist() for v in state.values())
+    assert not any(np.shares_memory(np.asarray(p), np.asarray(s))
+                   for p in params.values() for s in state.values())
+    for v in state.values():
+        arr = np.asarray(v)
+        found = any(np.allclose(arr, r.detach().numpy())
+                    for r in (m.bn.running_mean, m.bn.running_var))
+        assert found
+
+
+def test_inference_matches_torch_eval(rng):
+    m, net = _import_net(rng)
+    x = np.random.default_rng(0).normal(size=(4, 3, 8, 8)).astype(np.float32)
+    ref = m(torch.from_numpy(x)).detach().numpy()
+    params = net.build(None, None)
+    y = np.asarray(net.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_training_mode_matches_torch_train(rng):
+    m, net = _import_net(rng, train=True)
+    m.drop.p = 0.0                              # isolate BN determinism
+    x = np.random.default_rng(1).normal(size=(8, 3, 8, 8)).astype(np.float32)
+
+    net2 = TorchNet.from_pytorch(m.train(), torch.from_numpy(x))
+    params = net2.build(None, None)
+
+    # trace-time forward runs advance BN stats, and the ScriptModule's buffer
+    # snapshot may differ from the live module's — force BOTH sides to
+    # identical, distinguishable starting stats before the compared step
+    mean0 = np.full(8, -0.25, np.float32)
+    var0 = np.full(8, 1.7, np.float32)
+    with torch.no_grad():
+        m.bn.running_mean.copy_(torch.from_numpy(mean0))
+        m.bn.running_var.copy_(torch.from_numpy(var0))
+    start_state = {}
+    for k, v in net2.init_state().items():
+        # variance stays ~O(1) positive, means hover near 0: classify by mean
+        start_state[k] = jnp.asarray(var0 if float(np.asarray(v).mean()) > 0.3
+                                     else mean0)
+
+    m.train()
+    ref = m(torch.from_numpy(x)).detach().numpy()   # advances torch stats
+    torch_mean = m.bn.running_mean.detach().numpy().copy()
+    torch_var = m.bn.running_var.detach().numpy().copy()
+
+    y, new_state = net2.apply(params, start_state, jnp.asarray(x),
+                              training=True)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-3, atol=1e-3)
+    # moving stats advanced exactly as torch's running stats did
+    by_val = sorted((np.asarray(v) for v in new_state.values()),
+                    key=lambda a: float(a.sum()))
+    ref_pair = sorted([torch_mean, torch_var], key=lambda a: float(a.sum()))
+    for a, b in zip(by_val, ref_pair):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout_active_in_training(rng):
+    m, net = _import_net(rng, train=True)
+    params = net.build(None, None)
+    state = net.init_state()
+    x = jnp.asarray(np.random.default_rng(2)
+                    .normal(size=(4, 3, 8, 8)).astype(np.float32))
+    y1, _ = net.apply(params, state, x, training=True,
+                      rng=jax.random.PRNGKey(0))
+    y2, _ = net.apply(params, state, x, training=True,
+                      rng=jax.random.PRNGKey(1))
+    y3, _ = net.apply(params, state, x, training=False)
+    assert float(jnp.abs(y1 - y2).max()) > 1e-4     # rng-dependent
+    y3b, _ = net.apply(params, state, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y3), np.asarray(y3b))
+
+
+def test_gradients_match_torch_autograd(rng):
+    m, net = _import_net(rng)
+    m.eval()
+    g = np.random.default_rng(3)
+    x = g.normal(size=(4, 3, 8, 8)).astype(np.float32)
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    loss_t = (m(xt) ** 2).sum()
+    loss_t.backward()
+    torch_grads = {n: p.grad.detach().numpy()
+                   for n, p in m.named_parameters()}
+    x_grad_ref = xt.grad.detach().numpy()
+
+    params = net.build(None, None)
+    state = net.init_state()
+
+    def loss_fn(p, x_):
+        y, _ = net.apply(p, state, x_, training=False)
+        return (y.astype(jnp.float32) ** 2).sum()
+
+    gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, jnp.asarray(x))
+    np.testing.assert_allclose(float(loss_fn(params, jnp.asarray(x))),
+                               float(loss_t), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx), x_grad_ref, rtol=1e-3,
+                               atol=1e-4)
+    # match param grads by pairing on the parameter VALUES (imported names
+    # are graph value names, not torch names)
+    matched = 0
+    for tname, tgrad in torch_grads.items():
+        tval = dict(m.named_parameters())[tname].detach().numpy()
+        for jname, jval in params.items():
+            if np.asarray(jval).shape == tval.shape and \
+                    np.allclose(np.asarray(jval), tval, atol=1e-6):
+                np.testing.assert_allclose(np.asarray(gp[jname]), tgrad,
+                                           rtol=1e-3, atol=1e-4,
+                                           err_msg=tname)
+                matched += 1
+                break
+    assert matched == len(torch_grads), (matched, len(torch_grads))
+
+
+def test_argmax_keepdim(rng):
+    class M(torch.nn.Module):
+        def forward(self, x):
+            return torch.argmax(x, dim=1, keepdim=True)
+
+    x = torch.randn(3, 7)
+    net = TorchNet.from_pytorch(M().eval(), x, check_trace=False)
+    y = net.call({}, jnp.asarray(x.numpy()))
+    assert y.shape == (3, 1)
+    np.testing.assert_array_equal(
+        np.asarray(y), torch.argmax(x, 1, keepdim=True).numpy())
+
+
+def test_bn_dropout_cnn_finetunes_through_estimator(ctx, rng):
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.nn.optimizers import SGD
+
+    m, net = _import_net(rng, train=True)
+    g = np.random.default_rng(4)
+    x = g.normal(size=(32, 3, 8, 8)).astype(np.float32)
+    y = g.integers(0, 5, size=(32, 1)).astype(np.float32)
+
+    est = Estimator(net, optimizer=SGD(lr=0.01),
+                    loss="sparse_categorical_crossentropy_from_logits",
+                    ctx=ctx)
+    state_before = jax.tree.map(np.asarray, net.init_state())
+    hist = est.fit(x, y, batch_size=16, epochs=2, verbose=False)
+    assert np.isfinite(hist.history["loss"]).all()
+    state_after = jax.tree.map(np.asarray, est.state)
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), state_before, state_after))
+    assert any(v > 1e-6 for v in moved)     # moving stats updated
+
+
+def test_weight_tying_preserved_on_import(rng):
+    class Tied(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(6, 6, bias=False)
+
+        def forward(self, x):
+            return self.fc(self.fc(x))      # same weight used twice
+
+    m = Tied().train()
+    net = TorchNet.from_pytorch(m, torch.randn(2, 6), check_trace=False)
+    params = net.build(None, None)
+    assert len(params) == 1                 # ONE trainable copy, not two
+    x = np.random.default_rng(7).normal(size=(3, 6)).astype(np.float32)
+    ref = m.eval()(torch.from_numpy(x)).detach().numpy()
+    y = np.asarray(net.call(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    # gradient flows through BOTH uses of the tied weight
+    g = jax.grad(lambda p: (net.call(p, jnp.asarray(x)) ** 2).sum())(params)
+    w = m.fc.weight.detach().clone().requires_grad_(True)
+    xt = torch.from_numpy(x)
+    ((xt @ w.T @ w.T) ** 2).sum().backward()
+    (jname, jgrad), = g.items()
+    # aten::linear keeps torch's (out, in) weight orientation
+    np.testing.assert_allclose(np.asarray(jgrad), w.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
