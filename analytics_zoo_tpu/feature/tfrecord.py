@@ -1,0 +1,117 @@
+"""TFRecord file reader/writer + tf.train.Example codec — dependency-free.
+
+Reference parity: `TFDataset.from_tfrecord` (pyzoo/zoo/tfpark/tf_dataset.py
+tfrecord constructors).  Record framing (length + masked-crc32c) reuses the
+CRC implementation of utils/tbwriter.py; the Example/Features/Feature protos
+are decoded with the onnx_pb wire primitives:
+
+    Example      { features: Features = 1 }
+    Features     { feature: map<string, Feature> = 1 }
+    Feature      { bytes_list=1 | float_list=2 | int64_list=3 }
+    BytesList    { value: repeated bytes = 1 }
+    FloatList    { value: repeated float [packed] = 1 }
+    Int64List    { value: repeated int64 [packed] = 1 }
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.interop.onnx_pb import (
+    _WIRE_I32, _WIRE_LEN, _f_bytes, _read_varint, _write_varint, iter_fields)
+from analytics_zoo_tpu.utils.tbwriter import _masked_crc, _record
+
+
+def read_tfrecord(path: str, verify_crc: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads from a TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if verify_crc and _masked_crc(header[:8]) != len_crc:
+                raise ValueError(f"{path}: corrupt length crc")
+            payload = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and _masked_crc(payload) != data_crc:
+                raise ValueError(f"{path}: corrupt payload crc")
+            yield payload
+
+
+def write_tfrecord(path: str, payloads: List[bytes]) -> None:
+    with open(path, "wb") as f:
+        for p in payloads:
+            f.write(_record(p))
+
+
+def parse_example(payload: bytes) -> Dict[str, np.ndarray]:
+    """tf.train.Example -> {name: ndarray} (bytes stay as object arrays)."""
+    out: Dict[str, np.ndarray] = {}
+    for f1, w1, features in iter_fields(payload):
+        if f1 != 1 or w1 != _WIRE_LEN:
+            continue
+        for f2, w2, entry in iter_fields(features):   # map entries
+            if f2 != 1 or w2 != _WIRE_LEN:
+                continue
+            name, feat = None, None
+            for f3, w3, v3 in iter_fields(entry):
+                if f3 == 1:
+                    name = v3.decode("utf-8")
+                elif f3 == 2:
+                    feat = v3
+            if name is None or feat is None:
+                continue
+            for f4, w4, v4 in iter_fields(feat):
+                if f4 == 1:                            # BytesList
+                    vals = [v for f5, w5, v in iter_fields(v4) if f5 == 1]
+                    out[name] = np.asarray(vals, object)
+                elif f4 == 2:                          # FloatList
+                    for f5, w5, v5 in iter_fields(v4):
+                        if f5 == 1 and w5 == _WIRE_LEN:
+                            out[name] = np.frombuffer(v5, "<f4").copy()
+                        elif f5 == 1 and w5 == _WIRE_I32:
+                            out.setdefault(name, np.zeros(0, np.float32))
+                            out[name] = np.append(
+                                out[name], struct.unpack("<f", v5)[0])
+                elif f4 == 3:                          # Int64List
+                    vals: List[int] = []
+
+                    def _signed64(d: int) -> int:
+                        return d - (1 << 64) if d >= (1 << 63) else d
+
+                    for f5, w5, v5 in iter_fields(v4):
+                        if f5 == 1 and w5 == _WIRE_LEN:
+                            pos = 0
+                            while pos < len(v5):
+                                d, pos = _read_varint(v5, pos)
+                                vals.append(_signed64(d))
+                        elif f5 == 1:
+                            vals.append(_signed64(int(v5)))
+                    out[name] = np.asarray(vals, np.int64)
+    return out
+
+
+def make_example(features: Dict[str, Union[np.ndarray, list, bytes]]) -> bytes:
+    """Encode {name: values} as a tf.train.Example payload (test fixtures +
+    export)."""
+    entries = b""
+    for name, vals in features.items():
+        if isinstance(vals, (bytes, bytearray)):
+            feat = _f_bytes(1, _f_bytes(1, bytes(vals)))
+        else:
+            arr = np.asarray(vals)
+            if np.issubdtype(arr.dtype, np.floating):
+                feat = _f_bytes(2, _f_bytes(
+                    1, arr.astype("<f4").tobytes()))
+            else:
+                packed = b"".join(_write_varint(int(v) & ((1 << 64) - 1))
+                                  for v in arr.reshape(-1))
+                feat = _f_bytes(3, _f_bytes(1, packed))
+        entry = _f_bytes(1, name.encode("utf-8")) + _f_bytes(2, feat)
+        entries += _f_bytes(1, entry)
+    return _f_bytes(1, entries)

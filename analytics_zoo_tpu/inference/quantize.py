@@ -60,7 +60,7 @@ def _target_layers(model, params) -> List[Tuple[object, dict]]:
     return out
 
 
-def calibrate(model, params, state, calib_inputs, batches=None) -> Dict[str, float]:
+def calibrate(model, params, state, calib_inputs) -> Dict[str, float]:
     """Run `calib_inputs` (one batch or a list of batches) through the model
     EAGERLY, recording the absmax of every quantizable layer's input.
     Returns {layer_name: absmax}."""

@@ -1,0 +1,247 @@
+"""BERT-family estimators over the native BERT encoder (VERDICT r2 #8).
+
+Reference parity: `BERTClassifier` (pyzoo/zoo/tfpark/text/estimator/
+bert_classifier.py:49-110), `BERTNER` (bert_ner.py), `BERTSQuAD`
+(bert_squad.py) — model_fn-style estimators that put a task head on the BERT
+encoder and train through the TFPark estimator.  Here the encoder is the
+native `nn.layers.attention.BERT` layer and training runs through the zoo
+Estimator's fused lax.scan step; the feature dict surface
+(input_ids / token_type_ids / input_mask) is kept.
+
+Pretrained-weight import: `load_huggingface_bert` maps a transformers
+`BertModel`'s torch weights onto the native BERT param pytree (fused-qkv
+concat, post-LN naming) — verified numerically against the HF forward in
+tests/test_bert_estimator.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.nn.layers.attention import BERT, _linear
+from analytics_zoo_tpu.nn.module import Layer
+from analytics_zoo_tpu.nn.optimizers import AdamWeightDecay
+
+
+def _features_to_list(features) -> list:
+    """The reference feeds a dict {input_ids, token_type_ids, input_mask};
+    the native BERT layer takes them positionally."""
+    if isinstance(features, dict):
+        out = [np.asarray(features["input_ids"])]
+        if "token_type_ids" in features or "input_mask" in features:
+            out.append(np.asarray(
+                features.get("token_type_ids",
+                             np.zeros_like(out[0]))))
+        if "input_mask" in features:
+            out.append(np.asarray(features["input_mask"]))
+        return out
+    return list(features) if isinstance(features, (list, tuple)) \
+        else [np.asarray(features)]
+
+
+class _BERTWithHead(Layer):
+    """BERT encoder + a task head, as one trainable Layer."""
+
+    head = "pooled"      # "pooled" | "tokens" | "span"
+
+    def __init__(self, n_out: int, vocab: int, hidden_size=768, n_block=12,
+                 n_head=12, max_position_len=512, intermediate_size=3072,
+                 hidden_drop=0.1, attn_drop=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.n_out = int(n_out)
+        self.hidden_drop = float(hidden_drop)
+        self.bert = BERT(vocab, hidden_size=hidden_size, n_block=n_block,
+                         n_head=n_head, max_position_len=max_position_len,
+                         intermediate_size=intermediate_size,
+                         hidden_drop=hidden_drop, attn_drop=attn_drop,
+                         name=self.name + "_bert")
+
+    def build(self, rng, input_shape):
+        rb, rh = jax.random.split(rng)
+        shapes = input_shape if isinstance(input_shape, list) else [input_shape]
+        p = {"bert": self.bert.build(rb, shapes[0])}
+        H = self.bert.hidden_size
+        p["head"] = {
+            "W": 0.02 * jax.random.normal(rh, (H, self.n_out), jnp.float32),
+            "b": jnp.zeros((self.n_out,), jnp.float32)}
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        seq = self.bert.call(params["bert"], inputs, training=training,
+                             rng=rng)
+        if self.head == "pooled":
+            h = self.bert.pooled(params["bert"], seq)
+            if training and rng is not None and self.hidden_drop > 0:
+                keep = 1.0 - self.hidden_drop
+                h = jnp.where(jax.random.bernoulli(
+                    jax.random.fold_in(rng, 77), keep, h.shape),
+                    h / keep, 0.0)
+            return _linear(params["head"], h)            # (B, n_out) logits
+        logits = _linear(params["head"], seq)            # (B, T, n_out)
+        if self.head == "span":                          # SQuAD: start/end
+            return logits[..., 0], logits[..., 1]
+        return logits                                    # NER: token logits
+
+
+class _BERTEstimatorBase:
+    """Shared train/evaluate/predict plumbing (bert_base.py analog)."""
+
+    head: str
+    loss: str
+
+    def __init__(self, n_out: int, vocab: int, hidden_size=768, n_block=12,
+                 n_head=12, max_position_len=512, intermediate_size=3072,
+                 optimizer=None, ctx=None):
+        model_cls = type(f"_{type(self).__name__}Model", (_BERTWithHead,),
+                         {"head": self.head})
+        self.model = model_cls(n_out, vocab, hidden_size=hidden_size,
+                               n_block=n_block, n_head=n_head,
+                               max_position_len=max_position_len,
+                               intermediate_size=intermediate_size)
+        self.estimator = Estimator(
+            self.model, optimizer=optimizer or AdamWeightDecay(lr=5e-5),
+            loss=self.loss, ctx=ctx)
+
+    def load_pretrained(self, bert_params):
+        """Install pretrained encoder weights (e.g. from
+        install_huggingface_weights on self.model.bert) under the task head."""
+        if self.estimator.params is None:
+            T = min(8, self.model.bert.max_position_len)
+            params, state = self.model.init(
+                jax.random.PRNGKey(0), [(T,), (T,), (T,)])
+            # Estimator._ensure_init picks up preloaded model params
+            self.model._params, self.model._state = params, state
+            holder = self.model._params
+        else:
+            holder = self.estimator.params
+        holder["bert"] = jax.tree.map(jnp.asarray, bert_params)
+        return self
+
+    def fit(self, features, labels, *, batch_size=32, epochs=1, **kw):
+        return self.estimator.fit(_features_to_list(features),
+                                  np.asarray(labels), batch_size=batch_size,
+                                  epochs=epochs, **kw)
+
+    def evaluate(self, features, labels, *, batch_size=32):
+        return self.estimator.evaluate(_features_to_list(features),
+                                       np.asarray(labels),
+                                       batch_size=batch_size)
+
+    def predict(self, features, *, batch_size=32):
+        return self.estimator.predict(_features_to_list(features),
+                                      batch_size=batch_size)
+
+
+class BERTClassifier(_BERTEstimatorBase):
+    """Sequence classification over the pooled output
+    (bert_classifier.py:49-110)."""
+
+    head = "pooled"
+    loss = "sparse_categorical_crossentropy_from_logits"
+
+    def __init__(self, num_classes: int, vocab: int, **kw):
+        super().__init__(num_classes, vocab, **kw)
+
+
+class BERTNER(_BERTEstimatorBase):
+    """Token-level classification (bert_ner.py): per-token logits."""
+
+    head = "tokens"
+    loss = "sparse_categorical_crossentropy_from_logits"
+
+    def __init__(self, num_entities: int, vocab: int, **kw):
+        super().__init__(num_entities, vocab, **kw)
+
+
+class BERTSQuAD(_BERTEstimatorBase):
+    """Span extraction (bert_squad.py): start/end logits over tokens.
+    Labels: (B, 2) int start/end positions."""
+
+    head = "span"
+
+    @staticmethod
+    def loss(y_pred, y_true):
+        start_logits, end_logits = y_pred
+        t = jnp.asarray(y_true).astype(jnp.int32)
+        lp_s = jax.nn.log_softmax(start_logits, axis=-1)
+        lp_e = jax.nn.log_softmax(end_logits, axis=-1)
+        ls = -jnp.take_along_axis(lp_s, t[:, :1], axis=1)[:, 0]
+        le = -jnp.take_along_axis(lp_e, t[:, 1:2], axis=1)[:, 0]
+        return (ls + le) / 2.0
+
+    def __init__(self, vocab: int, **kw):
+        super().__init__(2, vocab, **kw)
+
+    def predict(self, features, *, batch_size=32):
+        """Returns (start_logits, end_logits)."""
+        return super().predict(features, batch_size=batch_size)
+
+
+def load_huggingface_bert(hf_bert) -> Dict:
+    """Map a transformers BertModel's weights onto the native BERT layer's
+    param pytree (fused qkv = concat(q, k, v) along the output dim; Linear
+    weights transposed torch (out,in) -> (in,out))."""
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_bert.state_dict().items()}
+    H = sd["embeddings.word_embeddings.weight"].shape[1]
+
+    def lin(prefix):
+        return {"W": sd[prefix + ".weight"].T.astype(np.float32),
+                "b": sd[prefix + ".bias"].astype(np.float32)}
+
+    def ln(prefix):
+        return {"gamma": sd[prefix + ".weight"].astype(np.float32),
+                "beta": sd[prefix + ".bias"].astype(np.float32)}
+
+    p = {
+        "word": sd["embeddings.word_embeddings.weight"].astype(np.float32),
+        "pos": sd["embeddings.position_embeddings.weight"].astype(np.float32),
+        "type": sd["embeddings.token_type_embeddings.weight"]
+            .astype(np.float32),
+        "embln": ln("embeddings.LayerNorm"),
+        "pooler": lin("pooler.dense"),
+    }
+    n_layers = max(int(k.split(".")[2]) for k in sd
+                   if k.startswith("encoder.layer.")) + 1
+    # the native layer names blocks "<bertname>_block<i>"; build returns keys
+    # by block name — reproduce the same naming via a fresh BERT instance's
+    # block names is caller-side; here we use positional keys the loader
+    # rewrites below.
+    blocks = []
+    for i in range(n_layers):
+        b = f"encoder.layer.{i}."
+        q, k_, v = (lin(b + f"attention.self.{n}") for n in
+                    ("query", "key", "value"))
+        blocks.append({
+            "attn": {
+                "qkv": {"W": np.concatenate([q["W"], k_["W"], v["W"]], 1),
+                        "b": np.concatenate([q["b"], k_["b"], v["b"]], 0)},
+                "out": lin(b + "attention.output.dense")},
+            "ln1": ln(b + "attention.output.LayerNorm"),
+            "ffn": {"fc": lin(b + "intermediate.dense"),
+                    "proj": lin(b + "output.dense")},
+            "ln2": ln(b + "output.LayerNorm"),
+        })
+    p["_blocks"] = blocks
+    return p
+
+
+def install_huggingface_weights(bert: BERT, params: Dict, hf_bert) -> Dict:
+    """Return a copy of `params` (a native BERT layer's pytree) with the HF
+    model's weights installed, using the layer's own block names."""
+    mapped = load_huggingface_bert(hf_bert)
+    blocks = mapped.pop("_blocks")
+    out = dict(params)
+    out.update({k: jnp.asarray(v) if not isinstance(v, dict)
+                else jax.tree.map(jnp.asarray, v) for k, v in mapped.items()})
+    if len(blocks) != len(bert.blocks):
+        raise ValueError(
+            f"layer has {len(bert.blocks)} blocks, checkpoint has "
+            f"{len(blocks)}")
+    for blk, bp in zip(bert.blocks, blocks):
+        out[blk.name] = jax.tree.map(jnp.asarray, bp)
+    return out

@@ -294,7 +294,9 @@ def load_caffe(def_path: Optional[str], model_path: str):
         if l.tops and l.tops[0] not in hw and l.bottoms \
                 and l.bottoms[0] in hw and t in ("ReLU", "Sigmoid", "TanH",
                                                  "Dropout", "LRN",
-                                                 "BatchNorm", "Scale"):
+                                                 "BatchNorm", "Scale",
+                                                 "Eltwise", "Concat"):
+            # Eltwise/Concat preserve spatial dims (Concat joins channels)
             hw[l.tops[0]] = hw[l.bottoms[0]]
 
     last = struct_layers[-1]

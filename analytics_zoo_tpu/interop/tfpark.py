@@ -58,6 +58,66 @@ class TFDataset:
                          batch_size)
 
     @staticmethod
+    def from_image_set(image_set, batch_size: int = 32, to_chw: bool = False,
+                       float_scale: Optional[float] = None) -> "TFDataset":
+        """ImageSet -> dataset (tf_dataset.py from_image_set analog); apply
+        preprocessing on the ImageSet BEFORE conversion, as the reference's
+        image_set.transform chain does."""
+        return TFDataset(image_set.to_feature_set(to_chw=to_chw,
+                                                  float_scale=float_scale),
+                         batch_size)
+
+    @staticmethod
+    def from_text_set(text_set, batch_size: int = 32) -> "TFDataset":
+        """TextSet (tokenized/indexed/shaped) -> dataset
+        (tf_dataset.py from_text_set analog)."""
+        x, y = text_set.gen_sample()
+        return TFDataset(ArrayFeatureSet(x, y), batch_size)
+
+    @staticmethod
+    def from_string_rdd(strings, preprocessor, batch_size: int = 32,
+                        labels=None) -> "TFDataset":
+        """List/iterable of raw strings + a per-string preprocessor returning
+        a feature array (from_string_rdd analog — no Spark RDD, any iterable)."""
+        x = np.stack([np.asarray(preprocessor(s), np.float32)
+                      for s in strings])
+        y = (np.asarray(labels, np.float32).reshape(len(x), -1)
+             if labels is not None else None)
+        return TFDataset(ArrayFeatureSet(x, y), batch_size)
+
+    @staticmethod
+    def from_tfrecord(paths, batch_size: int = 32,
+                      feature_keys: Optional[Sequence[str]] = None,
+                      label_key: Optional[str] = None) -> "TFDataset":
+        """TFRecord files of tf.train.Example records
+        (tf_dataset.py from_tfrecord analog; dependency-free reader in
+        feature/tfrecord.py).  feature_keys default to all non-label keys of
+        the first record, sorted."""
+        from analytics_zoo_tpu.feature.tfrecord import (
+            parse_example, read_tfrecord)
+        if isinstance(paths, str):
+            paths = [paths]
+        rows = [parse_example(p) for path in paths
+                for p in read_tfrecord(path)]
+        if not rows:
+            raise ValueError(f"no records in {paths}")
+        # auto-selection skips BytesList features (e.g. 'image/encoded'):
+        # they need a caller-supplied decoder, not a float32 stack
+        keys = list(feature_keys) if feature_keys else sorted(
+            k for k, v in rows[0].items()
+            if k != label_key and v.dtype != object)
+        if not keys:
+            raise ValueError(
+                "no numeric feature keys found; bytes features "
+                f"{sorted(rows[0])} need explicit feature_keys + decoding")
+        xs = [np.stack([np.asarray(r[k], np.float32) for r in rows])
+              for k in keys]
+        y = (np.stack([np.asarray(r[label_key], np.float32) for r in rows])
+             if label_key else None)
+        return TFDataset(ArrayFeatureSet(xs if len(xs) > 1 else xs[0], y),
+                         batch_size)
+
+    @staticmethod
     def from_tf_data(tf_dataset, batch_size: int = 32,
                      size: Optional[int] = None) -> "TFDataset":
         """Materialise a (finite) tf.data.Dataset (TFDataFeatureSet analog)."""

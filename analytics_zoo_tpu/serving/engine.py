@@ -55,12 +55,20 @@ class ServingParams:
 
     def __init__(self, batch_size: int = 4, top_n: int = 5,
                  poll_timeout_s: float = 0.05, stream_max_len: int = 100000,
-                 filter_threshold: Optional[float] = None):
+                 filter_threshold: Optional[float] = None,
+                 write_retries: int = 5, write_backoff_s: float = 0.05,
+                 pipeline_depth: int = 2):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
         self.stream_max_len = stream_max_len
         self.filter_threshold = filter_threshold
+        # result-write backpressure (ClusterServing.scala:276-307 analog)
+        self.write_retries = write_retries
+        self.write_backoff_s = write_backoff_s
+        # staged micro-batches between the host preprocess thread and the
+        # device predict thread; bounds memory AND provides backpressure
+        self.pipeline_depth = pipeline_depth
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -93,19 +101,35 @@ class ClusterServing:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
             self._tb = FileWriter(tensorboard_dir)
 
-    # -- one micro-batch ------------------------------------------------------
-    def serve_once(self) -> int:
+    # -- result write with backpressure (ClusterServing.scala:276-307) -------
+    def _put_result(self, rid, value):
+        backoff = self.params.write_backoff_s
+        for attempt in range(self.params.write_retries + 1):
+            try:
+                self.queue.put_result(rid, value)
+                return
+            except Exception:
+                if attempt == self.params.write_retries:
+                    raise
+                time.sleep(backoff)
+                backoff *= 2           # blocking retry: upstream reads stall
+
+    def _read_and_preprocess(self):
         batch = self.queue.read_batch(self.params.batch_size,
                                       self.params.poll_timeout_s)
         if not batch:
-            return 0
-        t0 = time.time()
+            return None
         ids = [rid for rid, _ in batch]
         tensors = np.stack([self.preprocess(rec) for _, rec in batch])
+        return ids, tensors
+
+    def _predict_and_write(self, ids, tensors) -> int:
+        t0 = time.time()
         probs = self.model.do_predict(tensors)
         for rid, row in zip(ids, probs):
-            self.queue.put_result(rid, {"value": self.postprocess(np.asarray(row))})
-        n = len(batch)
+            self._put_result(rid,
+                             {"value": self.postprocess(np.asarray(row))})
+        n = len(ids)
         self.total_records += n
         dt = max(time.time() - t0, 1e-9)
         if self._tb is not None:
@@ -116,21 +140,54 @@ class ClusterServing:
         self.queue.trim(self.params.stream_max_len)
         return n
 
+    # -- one micro-batch (synchronous path, used by tests/clients) -----------
+    def serve_once(self) -> int:
+        staged = self._read_and_preprocess()
+        if staged is None:
+            return 0
+        return self._predict_and_write(*staged)
+
     # -- lifecycle (cluster-serving-start/stop scripts parity) ----------------
     def start(self):
+        """Pipelined loop: a host thread reads+preprocesses micro-batches into
+        a bounded buffer while the predict thread runs the device — host
+        preprocessing overlaps device compute (round-2 weak #5); the bounded
+        buffer gives natural backpressure when predict falls behind."""
+        import queue as _q
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._staged = _q.Queue(maxsize=self.params.pipeline_depth)
+        self._pre_thread = threading.Thread(target=self._pre_loop, daemon=True)
+        self._thread = threading.Thread(target=self._predict_loop, daemon=True)
+        self._pre_thread.start()
         self._thread.start()
         return self
 
-    def _loop(self):
+    def _pre_loop(self):
         while not self._stop.is_set():
-            if self.serve_once() == 0:
+            staged = self._read_and_preprocess()
+            if staged is None:
                 time.sleep(0.005)
+                continue
+            while not self._stop.is_set():
+                try:
+                    self._staged.put(staged, timeout=0.1)
+                    break
+                except Exception:
+                    continue           # buffer full: backpressure
+
+    def _predict_loop(self):
+        import queue as _q
+        while not self._stop.is_set():
+            try:
+                ids, tensors = self._staged.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            self._predict_and_write(ids, tensors)
 
     def shutdown(self):
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for t in (getattr(self, "_pre_thread", None), self._thread):
+            if t is not None:
+                t.join(timeout=5)
         if self._tb is not None:
             self._tb.flush()

@@ -1,0 +1,65 @@
+"""Image classification example: ResNet on synthetic CIFAR-shaped data.
+
+The reference's image-classification example surface
+(pyzoo/zoo/examples/imageclassification/predict.py + examples/inception
+training mains): build a zoo model, train through compile/fit, evaluate, and
+run batched prediction through InferenceModel.
+
+Run: python examples/image_classification.py [--epochs 2] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--depth", type=int, default=18)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.imageclassification import ImageClassifier
+    from analytics_zoo_tpu.nn.optimizers import SGD
+
+    n, classes = (256, 4) if args.quick else (2048, 10)
+    g = np.random.default_rng(0)
+    # synthetic learnable data: class = brightest quadrant
+    x = g.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    q = g.integers(0, classes, n)
+    for i, c in enumerate(q):
+        x[i, (c % 2) * 16:(c % 2) * 16 + 16,
+          ((c // 2) % 2) * 16:((c // 2) % 2) * 16 + 16] += 1.5
+    y = q.astype(np.float32)[:, None]
+
+    clf = ImageClassifier(model_name=f"resnet{args.depth}",
+                          num_classes=classes, input_shape=(32, 32, 3),
+                          stem="cifar")
+    clf.compile(optimizer=SGD(lr=0.05, momentum=0.9),
+                loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    t0 = time.time()
+    clf.fit(x, y, batch_size=args.batch_size,
+            nb_epoch=1 if args.quick else args.epochs, verbose=False)
+    res = clf.evaluate(x, y, batch_size=args.batch_size)
+
+    # batched inference through the InferenceModel surface
+    im = InferenceModel().do_load_model(clf.model, clf.model._params,
+                                        clf.model._state)
+    probs = im.do_predict(x[:64], batch_size=32)
+
+    out = {"train_accuracy": round(float(res["accuracy"]), 4),
+           "predict_shape": list(probs.shape),
+           "seconds": round(time.time() - t0, 1)}
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

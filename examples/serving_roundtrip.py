@@ -1,0 +1,79 @@
+"""Cluster-serving round trip: client enqueue -> pipelined engine -> dequeue.
+
+The reference's serving E2E flow (serving/ClusterServing.scala +
+pyzoo/zoo/serving/client.py): a client XADDs records onto the input queue,
+the serving engine batches/predicts/writes results, the client polls them
+back.  Uses the in-process queue by default; pass --redis to exercise the
+Redis queue (needs a reachable redis server).
+
+Run: python examples/serving_roundtrip.py [--n 64] [--redis]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--redis", action="store_true")
+    args = ap.parse_args(argv)
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    if args.redis:
+        from analytics_zoo_tpu.serving.queues import RedisQueue
+        queue = RedisQueue()
+    else:
+        queue = InProcQueue()
+
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(6,)))
+    model.add(Dense(3, activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+
+    serving = ClusterServing(im, queue,
+                             params=ServingParams(batch_size=8, top_n=3))
+    serving.start()
+
+    client_in = InputQueue(queue)
+    client_out = OutputQueue(queue)
+    g = np.random.default_rng(0)
+    t0 = time.time()
+    ids = [client_in.enqueue_tensor(f"t{i}",
+                                    g.normal(size=(6,)).astype(np.float32))
+           for i in range(args.n)]
+    results = {}
+    deadline = time.time() + 30
+    while len(results) < args.n and time.time() < deadline:
+        for rid in ids:
+            if rid not in results:
+                r = client_out.query(rid)
+                if r is not None:
+                    results[rid] = r
+        time.sleep(0.01)
+    serving.shutdown()
+
+    ok = len(results) == args.n
+    out = {"queue": type(queue).__name__, "requests": args.n,
+           "completed": len(results), "ok": ok,
+           "seconds": round(time.time() - t0, 2)}
+    print(json.dumps(out))
+    if not ok:
+        raise SystemExit(1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

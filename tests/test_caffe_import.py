@@ -231,3 +231,22 @@ def test_unsupported_layer_raises(tmp_path):
     p.write_bytes(caffe_pb.encode_net(net))
     with pytest.raises(NotImplementedError, match="DetectionOutput"):
         load_caffe(None, str(p))
+
+
+def test_pooling_after_eltwise_keeps_ceil_mode(tmp_path, rng):
+    """hw tracking must flow through Eltwise/Concat (ResNet/GoogLeNet shape)."""
+    L = caffe_pb.CaffeLayer
+    net = caffe_pb.CaffeNet("elt", [
+        L("data", "Input", [], ["data"], [],
+          {"input_param": {"shape": [[1, 2, 5, 5]]}}),
+        L("sum", "Eltwise", ["data", "data"], ["sum"], [],
+          {"eltwise_param": {"operation": 1}}),
+        L("pool", "Pooling", ["sum"], ["pool"], [],
+          {"pooling_param": {"pool": 0, "kernel_size": 2, "stride": 2}}),
+    ], [], [])
+    p = tmp_path / "elt.caffemodel"
+    p.write_bytes(caffe_pb.encode_net(net))
+    model = load_caffe(None, str(p))
+    x = rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+    y = model.predict(x)
+    assert y.shape == (1, 2, 3, 3)     # ceil((5-2)/2)+1 = 3

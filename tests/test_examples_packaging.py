@@ -1,0 +1,157 @@
+"""Packaging + runnable examples + serving hardening (VERDICT r2 #9).
+
+Examples run as in-process smoke tests (the reference's
+run-example-tests*.sh pattern); the pipelined serving loop is exercised
+end-to-end through the client queue surface; the Redis queue test is
+skip-guarded on a reachable server.
+"""
+
+import importlib.util
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, argv):
+    path = os.path.join(REPO, "examples", name)
+    spec = importlib.util.spec_from_file_location(f"example_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def test_ncf_example_quick():
+    out = _run_example("ncf_train.py", ["--quick"])
+    assert out["hr_at_10"] > 0.15          # well above untrained baseline
+    assert out["eval_users"] == 400
+
+
+def test_serving_roundtrip_example():
+    out = _run_example("serving_roundtrip.py", ["--n", "32"])
+    assert out["ok"] and out["completed"] == 32
+
+
+def test_image_classification_example_quick():
+    out = _run_example("image_classification.py", ["--quick"])
+    assert out["predict_shape"] == [64, 4]
+    assert np.isfinite(out["train_accuracy"])
+
+
+def test_pipelined_serving_overlaps_and_backpressures(ctx):
+    """start() runs a preprocess thread + predict thread with a bounded
+    staging buffer; results must flow and the buffer must never exceed
+    pipeline_depth."""
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    model = Sequential()
+    model.add(Dense(4, input_shape=(3,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    q = InProcQueue()
+    serving = ClusterServing(im, q, params=ServingParams(
+        batch_size=4, pipeline_depth=2))
+    serving.start()
+    assert serving._pre_thread.is_alive() and serving._thread.is_alive()
+
+    cin, cout = InputQueue(q), OutputQueue(q)
+    g = np.random.default_rng(0)
+    ids = [cin.enqueue_tensor(f"u{i}", g.normal(size=(3,)).astype(np.float32))
+           for i in range(40)]
+    got = {}
+    deadline = time.time() + 20
+    while len(got) < len(ids) and time.time() < deadline:
+        for rid in ids:
+            if rid not in got:
+                r = cout.query(rid)
+                if r is not None:
+                    got[rid] = r
+        time.sleep(0.01)
+    serving.shutdown()
+    assert len(got) == len(ids)
+    assert serving._staged.maxsize == 2
+
+
+def test_result_write_retries_with_backoff(ctx):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    model = Sequential()
+    model.add(Dense(2, input_shape=(3,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+
+    class Flaky(InProcQueue):
+        def __init__(self):
+            super().__init__()
+            self.failures = 3
+
+        def put_result(self, key, value):
+            if self.failures > 0:
+                self.failures -= 1
+                raise ConnectionError("redis OOM")   # ClusterServing.scala:276
+            return super().put_result(key, value)
+
+    q = Flaky()
+    serving = ClusterServing(im, q, params=ServingParams(
+        batch_size=2, write_retries=5, write_backoff_s=0.001))
+    q.xadd({"uri": "a", "data": [1.0, 2.0, 3.0], "shape": [3]})
+    assert serving.serve_once() == 1
+    assert q.failures == 0                      # retried through the failures
+    assert q.get_result("1") is not None or q.result_count() == 1
+
+    # exhausted retries surface the error
+    q2 = Flaky()
+    q2.failures = 99
+    serving2 = ClusterServing(im, q2, params=ServingParams(
+        batch_size=2, write_retries=2, write_backoff_s=0.001))
+    q2.xadd({"uri": "b", "data": [1.0, 2.0, 3.0], "shape": [3]})
+    with pytest.raises(ConnectionError):
+        serving2.serve_once()
+
+
+def _redis_available():
+    try:
+        import redis
+        r = redis.Redis(socket_connect_timeout=0.3)
+        r.ping()
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _redis_available(),
+                    reason="no reachable redis server")
+def test_redis_queue_roundtrip(ctx):
+    from analytics_zoo_tpu.serving.queues import RedisQueue
+
+    q = RedisQueue(stream=f"zoo_test_{os.getpid()}")
+    rid = q.xadd({"uri": "x", "data": [1.0], "shape": [1]})
+    batch = q.read_batch(4, timeout_s=1.0)
+    assert any(r == rid for r, _ in batch)
+    q.put_result(rid, {"value": [[0, 1.0]]})
+    assert q.get_result(rid)["value"] == [[0, 1.0]]
+
+
+def test_editable_install_metadata():
+    """pyproject.toml produces an installable distribution
+    (pip install -e . executed during the build; skip when absent)."""
+    try:
+        import importlib.metadata as md
+        version = md.version("analytics-zoo-tpu")
+    except Exception:
+        pytest.skip("analytics-zoo-tpu not pip-installed in this env")
+    assert version == "0.3.0"

@@ -34,16 +34,12 @@ def _trained_mlp(rng, n_classes=5, d=12):
 
 
 def test_quantized_dense_close_to_float(rng):
-    m, x = _trained_mlp(rng)
+    m, x = _trained_mlp(rng)          # Sequential: the container itself
     params, state = m._params, m._state
     xj = jnp.asarray(x[:64])
-    y_fp = np.asarray(m.model.apply(params, state, xj, training=False)[0]) \
-        if hasattr(m, "model") else None
     y_fp = np.asarray(m.predict(x[:64], batch_size=64))
-    qp = quantize(m if not hasattr(m, "model") else m.model, params, state,
-                  jnp.asarray(x[:256]))
-    container = m if not hasattr(m, "model") else m.model
-    y_q = np.asarray(container.apply(qp, state, xj, training=False)[0])
+    qp = quantize(m, params, state, jnp.asarray(x[:256]))
+    y_q = np.asarray(m.apply(qp, state, xj, training=False)[0])
     # probabilities close, argmax nearly always identical
     assert np.abs(y_q - y_fp).max() < 0.05
     agree = (y_q.argmax(-1) == y_fp.argmax(-1)).mean()
@@ -52,12 +48,10 @@ def test_quantized_dense_close_to_float(rng):
 
 def test_quantize_via_inference_model_top1_parity(rng):
     m, x = _trained_mlp(rng)
-    im_fp = InferenceModel().do_load_model(
-        m if not hasattr(m, "model") else m.model, m._params, m._state)
+    im_fp = InferenceModel().do_load_model(m, m._params, m._state)
     y_fp = im_fp.do_predict(x, batch_size=128)
 
-    im_q = InferenceModel().do_load_model(
-        m if not hasattr(m, "model") else m.model, m._params, m._state)
+    im_q = InferenceModel().do_load_model(m, m._params, m._state)
     im_q.do_quantize(jnp.asarray(x[:256]))
     y_q = im_q.do_predict(x, batch_size=128)
     disagree = (y_q.argmax(-1) != y_fp.argmax(-1)).mean()

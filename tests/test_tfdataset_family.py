@@ -1,0 +1,104 @@
+"""TFDataset constructor family (VERDICT r2 #10): from_tfrecord /
+from_image_set / from_text_set / from_string_rdd consumed end-to-end by a
+TFPark KerasModel fit."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.tfrecord import (
+    make_example, parse_example, read_tfrecord, write_tfrecord)
+from analytics_zoo_tpu.interop.tfpark import TFDataset
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    payloads = [make_example({"x": np.arange(4, dtype=np.float32) + i,
+                              "label": np.asarray([i % 2]),
+                              "name": f"rec{i}".encode()})
+                for i in range(5)]
+    write_tfrecord(path, payloads)
+    rows = [parse_example(p) for p in read_tfrecord(path)]
+    assert len(rows) == 5
+    np.testing.assert_allclose(rows[2]["x"], [2, 3, 4, 5])
+    assert rows[3]["label"].tolist() == [1]
+    assert rows[1]["name"][0] == b"rec1"
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "bad.tfrecord")
+    write_tfrecord(path, [make_example({"x": np.ones(3, np.float32)})])
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF                      # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="crc"):
+        list(read_tfrecord(path))
+
+
+def test_from_tfrecord_trains(ctx, tmp_path):
+    from analytics_zoo_tpu.interop.tfpark import KerasModel
+    tf = pytest.importorskip("tensorflow")
+
+    g = np.random.default_rng(0)
+    path = str(tmp_path / "train.tfrecord")
+    xs = g.normal(size=(64, 6)).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int64)
+    write_tfrecord(path, [make_example({"x": x, "label": [int(y)]})
+                          for x, y in zip(xs, ys)])
+
+    ds = TFDataset.from_tfrecord(path, batch_size=16, label_key="label")
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(6,)),
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    model = KerasModel(km, loss="sparse_categorical_crossentropy",
+                       optimizer="adam")
+    hist = model.fit(ds, epochs=3)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_from_image_set_end_to_end(ctx, rng):
+    from analytics_zoo_tpu.feature.image import ImageSet
+
+    imgs = [rng.normal(size=(8, 8, 3)).astype(np.float32) for _ in range(12)]
+    labels = [i % 2 for i in range(12)]
+    iset = ImageSet.from_arrays(imgs, labels)
+    ds = TFDataset.from_image_set(iset, batch_size=4, float_scale=1 / 255.0)
+    xb, yb, _ = next(iter(ds.feature_set.batches(4)))
+    assert np.asarray(xb).shape == (4, 8, 8, 3)
+    assert np.asarray(yb).shape == (4, 1)
+
+
+def test_from_text_set_end_to_end(ctx):
+    from analytics_zoo_tpu.feature.text import TextSet
+
+    ts = TextSet.from_texts(["the cat sat", "the dog ran fast", "a cat ran"],
+                            labels=[0, 1, 0])
+    ts = ts.tokenize().normalize().word2idx().shape_sequence(5)
+    ds = TFDataset.from_text_set(ts, batch_size=2)
+    xb, yb, _ = next(iter(ds.feature_set.batches(2)))
+    assert np.asarray(xb).shape == (2, 5)
+    assert np.asarray(yb).shape[0] == 2
+
+
+def test_from_string_rdd(ctx):
+    strings = ["ab", "abcd", "a"]
+    ds = TFDataset.from_string_rdd(
+        strings, lambda s: [len(s), s.count("a")], labels=[0, 1, 0])
+    xb, yb, _ = next(iter(ds.feature_set.batches(3)))
+    np.testing.assert_allclose(np.asarray(xb),
+                               [[2, 1], [4, 1], [1, 1]])
+
+
+def test_tfrecord_negative_int64():
+    p = parse_example(make_example({"v": np.asarray([-1, -7, 3], np.int64)}))
+    assert p["v"].tolist() == [-1, -7, 3]
+
+
+def test_from_tfrecord_skips_bytes_features(tmp_path):
+    path = str(tmp_path / "img.tfrecord")
+    write_tfrecord(path, [make_example({
+        "image/encoded": b"\x00\x01", "x": np.ones(3, np.float32),
+        "label": np.asarray([1])}) for _ in range(2)])
+    ds = TFDataset.from_tfrecord(path, label_key="label")
+    xb, yb, _ = next(iter(ds.feature_set.batches(2)))
+    assert np.asarray(xb).shape == (2, 3)   # bytes feature auto-skipped
