@@ -90,6 +90,15 @@ class ImageSet:
     def transform(self, op: Preprocessing) -> "ImageSet":
         return ImageSet([op.transform(f) for f in self.features])
 
+    def to_distributed(self, num_shards: int = 4) -> "DistributedImageSet":
+        """Split into roughly equal shards (ImageSet.toDistributed analog)."""
+        idx = np.array_split(np.arange(len(self.features)),
+                             max(num_shards, 1))
+        return DistributedImageSet(
+            [ImageSet([self.features[i] for i in part]) for part in idx])
+
+    is_distributed = False
+
     def __len__(self):
         return len(self.features)
 
@@ -389,3 +398,111 @@ class ImageSetToSample(Preprocessing):
 
     def transform(self, feature):
         return np.asarray(feature["image"], np.float32), feature.get("label")
+
+
+class ImageChannelOrder(ImageTransform):
+    """Swap BGR <-> RGB channel order (ImageChannelOrder.scala)."""
+
+    def apply_image(self, img):
+        return np.ascontiguousarray(img[..., ::-1])
+
+
+class ImageMirror(ImageHFlip):
+    """Horizontal mirror — BigDL's Mirror naming (ImageMirror.scala)."""
+
+
+class ImageRandomResize(ImageTransform):
+    """Resize to a size sampled uniformly from [min_size, max_size]
+    (ImageRandomResize.scala); keeps the aspect ratio square like the
+    reference (resizes both dims to the sampled value)."""
+
+    def __init__(self, min_size: int, max_size: int, seed=None):
+        self.min_size = int(min_size)
+        self.max_size = int(max_size)
+        self.rng = np.random.default_rng(seed)
+
+    def apply_image(self, img):
+        s = int(self.rng.integers(self.min_size, self.max_size + 1))
+        return cv2.resize(img, (s, s))
+
+
+class BufferedImageResize(ImageResize):
+    """Resize alias matching the reference's BufferedImageResize (a JVM
+    BufferedImage code path; same capability = plain resize here)."""
+
+
+class ImagePixelBytesToMat(ImageTransform):
+    """Raw pixel bytes (H*W*C uint8 buffer in the feature) -> ndarray image
+    (ImagePixelBytesToMat.scala)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.shape = (int(height), int(width), int(channels))
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        out = ImageFeature(feature)
+        buf = feature["bytes"] if "bytes" in feature.keys() else feature["image"]
+        out["image"] = np.frombuffer(bytes(buf), np.uint8).reshape(self.shape)
+        return out
+
+
+class ImageMatToTensor(ImageMatToFloats):
+    """float tensor conversion with optional CHW layout
+    (ImageMatToTensor.scala); format="NCHW" transposes."""
+
+    def __init__(self, format: str = "NHWC"):
+        self.format = format
+
+    def apply_image(self, img):
+        out = np.asarray(img, np.float32)
+        if self.format.upper() == "NCHW":
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class ImageFeatureToTensor(ImageMatToTensor):
+    """ImageFeatureToTensor.scala naming alias."""
+
+
+class DistributedImageSet:
+    """Sharded image collection (DistributedImageSet parity): the same
+    transform/to_feature_set API over N shards, with shard transforms
+    running on a thread pool (host-side preprocessing parallelism — the
+    reference's Spark-partition parallelism analog)."""
+
+    def __init__(self, shards: List["ImageSet"]):
+        self.shards = shards
+
+    @staticmethod
+    def read(path: str, num_shards: int = 4, **kw) -> "DistributedImageSet":
+        return ImageSet.read(path, **kw).to_distributed(num_shards)
+
+    def transform(self, op: Preprocessing) -> "DistributedImageSet":
+        import copy
+        from concurrent.futures import ThreadPoolExecutor
+
+        # np.random.Generator is not thread-safe: give each shard its own
+        # deep-copied op with an independently seeded generator
+        ops = []
+        for i in range(len(self.shards)):
+            o = copy.deepcopy(op)
+            if hasattr(o, "rng"):
+                o.rng = np.random.default_rng(
+                    np.random.SeedSequence(entropy=hash((id(op), i)) & (2**63 - 1)))
+            ops.append(o)
+        with ThreadPoolExecutor(max_workers=len(self.shards)) as ex:
+            return DistributedImageSet(
+                list(ex.map(lambda so: so[0].transform(so[1]),
+                            zip(self.shards, ops))))
+
+    def to_local(self) -> "ImageSet":
+        return ImageSet([f for s in self.shards for f in s.features])
+
+    def to_feature_set(self, **kw):
+        return self.to_local().to_feature_set(**kw)
+
+    def __len__(self):
+        return sum(len(s) for s in self.shards)
+
+    is_distributed = True
+
+

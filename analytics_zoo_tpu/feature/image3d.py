@@ -78,3 +78,27 @@ class AffineTransform3D(Preprocessing):
         offset = center - self.matrix @ center + self.translation
         return ndimage.affine_transform(vol, self.matrix, offset=offset,
                                         order=self.order, mode="nearest")
+
+
+class Warp3D(Preprocessing):
+    """Warp a volume by a dense displacement field (WarpTransformer parity:
+    feature/image3d/Warp.scala).  `flow` has shape (3, D, H, W) — per-voxel
+    displacements along each axis; output(v) = input(v + flow(v)) with
+    linear interpolation and edge clamping."""
+
+    def __init__(self, flow: np.ndarray, order: int = 1):
+        self.flow = np.asarray(flow, np.float64)
+        if self.flow.ndim != 4 or self.flow.shape[0] != 3:
+            raise ValueError(f"flow must be (3, D, H, W); got "
+                             f"{self.flow.shape}")
+        self.order = order
+
+    def transform(self, vol):
+        if self.flow.shape[1:] != np.asarray(vol).shape:
+            raise ValueError(
+                f"flow field {self.flow.shape[1:]} does not match volume "
+                f"{np.asarray(vol).shape}")
+        grid = np.meshgrid(*[np.arange(s) for s in vol.shape], indexing="ij")
+        coords = [g + f for g, f in zip(grid, self.flow)]
+        return ndimage.map_coordinates(vol, coords, order=self.order,
+                                       mode="nearest")

@@ -265,40 +265,64 @@ def multibox_loss(y_pred, y_true, *, class_num: int, neg_pos_ratio: float = 3.0,
 # mAP evaluation (EvalUtil / PascalVocEvaluator parity)
 # ---------------------------------------------------------------------------
 
+def _precision_recall(detections, ground_truths, class_id: int,
+                      iou_threshold: float):
+    """Greedy IoU matching -> (precision, recall) curves for one class.
+
+    ground_truths entries are (boxes, labels) or (boxes, labels, difficult);
+    VOC protocol: difficult boxes are excluded from the GT count and
+    detections matching them are ignored (neither TP nor FP)."""
+    scores, matches, ignored = [], [], []
+    total_gt = 0
+    for dets, gt in zip(detections, ground_truths):
+        gt_boxes, gt_labels = gt[0], gt[1]
+        difficult = (np.asarray(gt[2]) if len(gt) > 2
+                     else np.zeros(len(gt_labels), np.int64))
+        gt_mask = np.asarray(gt_labels) == class_id
+        boxes = np.asarray(gt_boxes)[gt_mask]
+        diff = difficult[gt_mask].astype(bool)
+        total_gt += int((~diff).sum())
+        used = np.zeros(boxes.shape[0], bool)
+        for (c, sc, box) in sorted([d for d in dets if d[0] == class_id],
+                                   key=lambda d: -d[1]):
+            scores.append(sc)
+            if boxes.shape[0] == 0:
+                matches.append(0)
+                ignored.append(False)
+                continue
+            ious = iou_matrix(box[None], boxes)[0]
+            j = ious.argmax()
+            if ious[j] >= iou_threshold and diff[j]:
+                matches.append(0)
+                ignored.append(True)          # matched a difficult box
+            elif ious[j] >= iou_threshold and not used[j]:
+                used[j] = True
+                matches.append(1)
+                ignored.append(False)
+            else:
+                matches.append(0)
+                ignored.append(False)
+    if total_gt == 0 or not scores:
+        return None
+    order = np.argsort(-np.asarray(scores))
+    keep = ~np.asarray(ignored)[order]
+    tp = np.asarray(matches)[order][keep]
+    tp_cum = np.cumsum(tp)
+    fp_cum = np.cumsum(1 - tp)
+    recall = tp_cum / total_gt
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-9)
+    return precision, recall
+
+
 def average_precision(detections, ground_truths, class_id: int,
                       iou_threshold: float = 0.5) -> float:
     """detections: per-image [(cls, score, box)]; ground_truths: per-image
-    (boxes (G,4), labels (G,)).  VOC-style AP (all-point interpolation)."""
-    scores, matches = [], []
-    total_gt = 0
-    for dets, (gt_boxes, gt_labels) in zip(detections, ground_truths):
-        gt_mask = np.asarray(gt_labels) == class_id
-        gt = np.asarray(gt_boxes)[gt_mask]
-        total_gt += gt.shape[0]
-        used = np.zeros(gt.shape[0], bool)
-        for (c, s, box) in sorted([d for d in dets if d[0] == class_id],
-                                  key=lambda d: -d[1]):
-            scores.append(s)
-            if gt.shape[0] == 0:
-                matches.append(0)
-                continue
-            ious = iou_matrix(box[None], gt)[0]
-            j = ious.argmax()
-            if ious[j] >= iou_threshold and not used[j]:
-                used[j] = True
-                matches.append(1)
-            else:
-                matches.append(0)
-    if total_gt == 0 or not scores:
+    (boxes (G,4), labels (G,)[, difficult (G,)]).  VOC-style AP
+    (all-point interpolation)."""
+    pr = _precision_recall(detections, ground_truths, class_id, iou_threshold)
+    if pr is None:
         return 0.0
-    order = np.argsort(-np.asarray(scores))
-    tp = np.asarray(matches)[order]
-    fp = 1 - tp
-    tp_cum = np.cumsum(tp)
-    fp_cum = np.cumsum(fp)
-    recall = tp_cum / total_gt
-    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-9)
-    # all-point interpolation
+    precision, recall = pr
     ap = 0.0
     for r in np.linspace(0, 1, 101):
         mask = recall >= r
@@ -311,3 +335,182 @@ def mean_average_precision(detections, ground_truths, num_classes: int,
     aps = [average_precision(detections, ground_truths, c, iou_threshold)
            for c in range(1, num_classes)]
     return float(np.mean(aps)) if aps else 0.0
+
+
+def average_precision_07(detections, ground_truths, class_id: int,
+                         iou_threshold: float = 0.5) -> float:
+    """VOC2007 11-point interpolated AP (EvalUtil.scala use_07_metric path);
+    shares the matching/PR computation with average_precision."""
+    pr = _precision_recall(detections, ground_truths, class_id, iou_threshold)
+    if pr is None:
+        return 0.0
+    precision, recall = pr
+    ap = 0.0
+    for r in np.arange(0.0, 1.1, 0.1):
+        mask = recall >= r
+        ap += precision[mask].max() if mask.any() else 0.0
+    return float(ap / 11.0)
+
+
+# -- dataset plumbing (models/.../common/dataset parity) ----------------------
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+def parse_voc_annotation(xml_path: str,
+                         class_to_id: Optional[Dict[str, int]] = None):
+    """Pascal VOC XML -> (boxes (G,4) [xmin,ymin,xmax,ymax] normalized,
+    labels (G,) 1-based, is_difficult (G,)) (PascalVoc.scala parity)."""
+    import xml.etree.ElementTree as ET
+    root = ET.parse(xml_path).getroot()
+    size = root.find("size")
+    W = float(size.find("width").text)
+    H = float(size.find("height").text)
+    c2i = class_to_id or {c: i + 1 for i, c in enumerate(VOC_CLASSES)}
+    boxes, labels, difficult = [], [], []
+    for obj in root.iter("object"):
+        name = obj.find("name").text.strip()
+        if name not in c2i:
+            continue
+        bb = obj.find("bndbox")
+        boxes.append([float(bb.find("xmin").text) / W,
+                      float(bb.find("ymin").text) / H,
+                      float(bb.find("xmax").text) / W,
+                      float(bb.find("ymax").text) / H])
+        labels.append(c2i[name])
+        d = obj.find("difficult")
+        difficult.append(int(d.text) if d is not None else 0)
+    return (np.asarray(boxes, np.float32).reshape(-1, 4),
+            np.asarray(labels, np.int64),
+            np.asarray(difficult, np.int64))
+
+
+def load_coco_annotations(json_path: str):
+    """COCO instances json -> {image_id: (boxes normalized, labels)}
+    (Coco.scala parity; category ids remapped densely 1..K)."""
+    import json as _json
+    with open(json_path) as f:
+        coco = _json.load(f)
+    dims = {im["id"]: (float(im["width"]), float(im["height"]))
+            for im in coco["images"]}
+    cats = sorted(c["id"] for c in coco.get("categories", []))
+    remap = {cid: i + 1 for i, cid in enumerate(cats)}
+    out: Dict[int, list] = {im_id: ([], []) for im_id in dims}
+    for ann in coco["annotations"]:
+        W, H = dims[ann["image_id"]]
+        x, y, w, h = ann["bbox"]
+        out[ann["image_id"]][0].append(
+            [x / W, y / H, (x + w) / W, (y + h) / H])
+        out[ann["image_id"]][1].append(remap.get(ann["category_id"],
+                                                 ann["category_id"]))
+    return {k: (np.asarray(b, np.float32).reshape(-1, 4),
+                np.asarray(l, np.int64)) for k, (b, l) in out.items()}
+
+
+class PascalVocEvaluator:
+    """mAP evaluator with the VOC2007 (11-point) / VOC2012 (all-point)
+    protocols (common/evaluation/EvalUtil.scala:1-223,
+    PascalVocEvaluator parity)."""
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 use_07_metric: bool = False):
+        self.num_classes = int(num_classes)
+        self.iou = float(iou_threshold)
+        self.use_07 = bool(use_07_metric)
+
+    def evaluate(self, detections, ground_truths) -> Dict[str, float]:
+        ap_fn = average_precision_07 if self.use_07 else average_precision
+        aps = {c: ap_fn(detections, ground_truths, c, self.iou)
+               for c in range(1, self.num_classes)}
+        aps["mAP"] = float(np.mean(list(aps.values()))) if aps else 0.0
+        return aps
+
+
+# -- pretrained config registry (ObjectDetectionConfig.scala:1-176) -----------
+
+class ObjectDetectionConfig:
+    """Per-model-name architecture + preprocessing registry.  The reference
+    resolves published .model files by name ("ssd-vgg16-300x300" etc.);
+    here the registry resolves the native architecture + its preprocessing,
+    and weights load from the zoo save_weights format."""
+
+    _REGISTRY: Dict[str, Dict] = {}
+
+    @classmethod
+    def register(cls, name: str, *, class_num: int, image_size: int,
+                 aspect_ratios=(1.0, 2.0, 0.5), base_filters: int = 32,
+                 mean=(123.0, 117.0, 104.0), scale: float = 1.0,
+                 label_map=None):
+        cls._REGISTRY[name] = dict(
+            class_num=class_num, image_size=image_size,
+            aspect_ratios=tuple(aspect_ratios), base_filters=base_filters,
+            mean=tuple(mean), scale=scale, label_map=label_map)
+
+    @classmethod
+    def get(cls, name: str) -> Dict:
+        if name not in cls._REGISTRY:
+            raise KeyError(
+                f"unknown object-detection model {name!r}; registered: "
+                f"{sorted(cls._REGISTRY)}")
+        return dict(cls._REGISTRY[name])
+
+
+for _name, _cfg in {
+    "ssd-vgg16-300x300": dict(class_num=21, image_size=288,
+                              label_map=("__background__",) + VOC_CLASSES),
+    "ssd-mobilenet-300x300": dict(class_num=21, image_size=288,
+                                  base_filters=16,
+                                  label_map=("__background__",) + VOC_CLASSES),
+    "ssd-vgg16-512x512": dict(class_num=21, image_size=512,
+                              label_map=("__background__",) + VOC_CLASSES),
+}.items():
+    ObjectDetectionConfig.register(_name, **_cfg)
+
+
+class ObjectDetector:
+    """Detection facade (ObjectDetector.scala / ImageModel.doPredictImage):
+    config-by-name, predict over ImageSets, decode + NMS postprocessing."""
+
+    def __init__(self, model_name: str = "ssd-vgg16-300x300",
+                 weights_path: Optional[str] = None):
+        cfg = ObjectDetectionConfig.get(model_name)
+        self.cfg = cfg
+        self.ssd = SSD(cfg["class_num"], image_size=cfg["image_size"],
+                       aspect_ratios=cfg["aspect_ratios"],
+                       base_filters=cfg["base_filters"])
+        self.label_map = cfg.get("label_map")
+        if weights_path:
+            self.ssd.model.load_weights(weights_path)
+        elif getattr(self.ssd.model, "_params", None) is None:
+            self.ssd.model.init_weights()
+
+    def save(self, path: str):
+        self.ssd.model.save_weights(path)
+
+    @staticmethod
+    def load_model(model_name: str, weights_path: str) -> "ObjectDetector":
+        return ObjectDetector(model_name, weights_path)
+
+    def _preprocess(self, images: np.ndarray) -> np.ndarray:
+        x = np.asarray(images, np.float32)
+        return (x - np.asarray(self.cfg["mean"], np.float32)) \
+            * self.cfg["scale"]
+
+    def predict_image_set(self, image_set, score_threshold: float = 0.3,
+                          iou_threshold: float = 0.45, top_k: int = 100):
+        """ImageSet -> per-image [(class_id, score, box)] detections."""
+        import cv2
+        s = self.cfg["image_size"]
+        imgs = np.stack([cv2.resize(np.asarray(f.image, np.float32), (s, s))
+                         for f in image_set.features])
+        return self.predict(imgs, score_threshold=score_threshold,
+                            iou_threshold=iou_threshold, top_k=top_k)
+
+    def predict(self, images: np.ndarray, score_threshold: float = 0.3,
+                iou_threshold: float = 0.45, top_k: int = 100):
+        x = self._preprocess(images)
+        return self.ssd.detect(x, score_threshold=score_threshold,
+                               iou_threshold=iou_threshold, top_k=top_k)

@@ -84,8 +84,16 @@ class KerasNet(Layer):
 
     def load_weights(self, path: str):
         from analytics_zoo_tpu.utils.serialization import load_pytree
-        tree = load_pytree(path, like={"params": self._params, "state": self._state}
-                           if self._params is not None else None)
+        if self._params is not None:
+            like = {"params": self._params, "state": self._state}
+        else:
+            # A flat weights file cannot represent stateless layers' empty {}
+            # state entries — reconstruct the full skeleton so the executor
+            # finds every layer's slot.
+            import jax as _jax
+            p0, s0 = self.init(_jax.random.PRNGKey(0))
+            like = {"params": p0, "state": s0}
+        tree = load_pytree(path, like=like)
         self._params, self._state = tree["params"], tree["state"]
         return self
 

@@ -1,0 +1,240 @@
+"""Cluster Serving lifecycle manager + config loader.
+
+Reference parity: the `scripts/cluster-serving/` lifecycle scripts
+(cluster-serving-init/start/stop/restart/shutdown), `ClusterServingHelper`
+(serving/utils/ClusterServingHelper.scala:1-448 — config.yaml parsing with
+model-type autodetect) and `ClusterServingManager.listenTermination`
+(ClusterServingManager.scala:1-55).
+
+config.yaml surface (scripts/cluster-serving/config.yaml template):
+
+    model:
+      path: /path/to/model            # autodetected: .npz zoo weights with
+                                      # sibling topology.py, SavedModel dir,
+                                      # .onnx, TorchScript .pt
+      type: onnx                      # optional override
+    data:
+      src: redis                      # redis | file:<dir> (cross-process)
+      redis_host: localhost
+      redis_port: 6379
+      stream: image_stream
+    params:
+      batch_size: 4
+      top_n: 5
+      filter_threshold: null
+      pipeline_depth: 2
+
+CLI (used by scripts/cluster-serving/*.sh):
+    python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]
+    python -m analytics_zoo_tpu.serving.manager stop|status|restart
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+
+PIDFILE = "cluster-serving.pid"
+
+
+def load_config(path: str) -> dict:
+    try:
+        import yaml
+        with open(path) as f:
+            return yaml.safe_load(f) or {}
+    except ImportError:
+        # minimal fallback parser for the flat 2-level template above
+        cfg: dict = {}
+        section = None
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].rstrip()
+                if not line.strip():
+                    continue
+                if not line.startswith(" "):
+                    section = line.strip().rstrip(":")
+                    cfg[section] = {}
+                else:
+                    k, _, v = line.strip().partition(":")
+                    v = v.strip()
+                    if v in ("null", ""):
+                        val = None
+                    else:
+                        try:
+                            val = int(v)
+                        except ValueError:
+                            try:
+                                val = float(v)
+                            except ValueError:
+                                val = v
+                    cfg[section][k.strip()] = val
+        return cfg
+
+
+def detect_model_type(path: str) -> str:
+    """ClusterServingHelper's model-type autodetect analog."""
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "saved_model.pb")):
+            return "tensorflow"
+        raise ValueError(f"cannot autodetect model type for dir {path}")
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".onnx":
+        return "onnx"
+    if ext in (".pt", ".pth", ".ts"):
+        return "pytorch"
+    if ext == ".npz":
+        return "zoo"
+    raise ValueError(f"cannot autodetect model type for {path}")
+
+
+def load_model(cfg: dict) -> InferenceModel:
+    mcfg = cfg.get("model", {})
+    path = mcfg.get("path")
+    if not path:
+        raise ValueError("config.yaml: model.path is required")
+    mtype = mcfg.get("type") or detect_model_type(path)
+    im = InferenceModel()
+    if mtype == "tensorflow":
+        return im.do_load_tensorflow(path)
+    if mtype == "onnx":
+        return im.do_load_onnx(path)
+    if mtype == "pytorch":
+        return im.do_load_pytorch(path)
+    if mtype == "zoo":
+        topo = mcfg.get("topology")
+        if not topo:
+            raise ValueError("zoo .npz weights need model.topology "
+                             "(python file defining build_model())")
+        scope: dict = {}
+        with open(topo) as f:
+            exec(compile(f.read(), topo, "exec"), scope)
+        return im.do_load(scope["build_model"], path)
+    raise ValueError(f"unknown model type {mtype!r}")
+
+
+def build_queue(cfg: dict):
+    dcfg = cfg.get("data", {})
+    src = str(dcfg.get("src", "redis"))
+    if src.startswith("file:"):
+        from analytics_zoo_tpu.serving.queues import FileQueue
+        return FileQueue(src.split(":", 1)[1])
+    if src == "inproc":
+        from analytics_zoo_tpu.serving.queues import InProcQueue
+        return InProcQueue()
+    from analytics_zoo_tpu.serving.queues import RedisQueue
+    return RedisQueue(host=dcfg.get("redis_host", "localhost"),
+                      port=int(dcfg.get("redis_port", 6379)),
+                      stream=dcfg.get("stream", "image_stream"))
+
+
+def serving_params(cfg: dict) -> ServingParams:
+    p = cfg.get("params", {})
+    return ServingParams(
+        batch_size=int(p.get("batch_size", 4)),
+        top_n=int(p.get("top_n", 5)),
+        filter_threshold=p.get("filter_threshold"),
+        pipeline_depth=int(p.get("pipeline_depth", 2)),
+        stream_max_len=int(p.get("stream_max_len", 100000)))
+
+
+def serve_from_config(config_path: str,
+                      tensorboard_dir: Optional[str] = None) -> ClusterServing:
+    cfg = load_config(config_path)
+    serving = ClusterServing(load_model(cfg), build_queue(cfg),
+                             params=serving_params(cfg),
+                             tensorboard_dir=tensorboard_dir)
+    return serving
+
+
+def _run_foreground(config_path: str, pidfile: str):
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    serving = serve_from_config(config_path)
+
+    def _terminate(signum, frame):
+        # ClusterServingManager.listenTermination analog: drain + exit
+        serving.shutdown()
+        try:
+            os.unlink(pidfile)
+        except OSError:
+            pass
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    serving.start()
+    while True:
+        time.sleep(1)
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(prog="cluster-serving")
+    ap.add_argument("action",
+                    choices=["start", "stop", "status", "restart"])
+    ap.add_argument("-c", "--config", default="config.yaml")
+    ap.add_argument("--pidfile", default=PIDFILE)
+    ap.add_argument("--foreground", action="store_true")
+    args = ap.parse_args(argv)
+
+    def read_pid():
+        try:
+            with open(args.pidfile) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    if args.action == "status":
+        pid = read_pid()
+        up = pid is not None and alive(pid)
+        print(json.dumps({"running": up, "pid": pid if up else None}))
+        return 0
+    if args.action in ("stop", "restart"):
+        pid = read_pid()
+        if pid is not None and alive(pid):
+            os.kill(pid, signal.SIGTERM)
+            for _ in range(50):
+                if not alive(pid):
+                    break
+                time.sleep(0.1)
+        if args.action == "stop":
+            print(json.dumps({"stopped": True}))
+            return 0
+        if pid is not None and alive(pid):
+            print(json.dumps({"error": f"pid {pid} did not terminate"}),
+                  file=sys.stderr)
+            return 1
+    # start / restart
+    pid = read_pid()
+    if pid is not None and alive(pid):
+        print(json.dumps({"error": f"already running (pid {pid})"}),
+              file=sys.stderr)
+        return 1
+    if args.foreground:
+        _run_foreground(args.config, args.pidfile)
+        return 0
+    pid = os.fork()
+    if pid == 0:                           # child: detach and serve
+        os.setsid()
+        _run_foreground(args.config, args.pidfile)
+        return 0
+    print(json.dumps({"started": True, "pid": pid}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

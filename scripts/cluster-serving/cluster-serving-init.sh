@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# cluster-serving-init: drop a config template into the working directory
+set -e
+src="$(dirname "$0")/config.yaml"
+[ -e config.yaml ] || cp "$src" config.yaml
+echo "config.yaml ready — edit model.path, then run cluster-serving-start.sh"

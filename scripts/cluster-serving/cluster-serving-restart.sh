@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# cluster-serving-restart (reference scripts/cluster-serving parity)
+set -e
+cd "$(dirname "$0")"
+exec python -m analytics_zoo_tpu.serving.manager restart -c "${CS_CONFIG:-config.yaml}" "$@"

@@ -1,0 +1,81 @@
+"""Round-3 image-pipeline additions: new ops, DistributedImageSet, Warp3D."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image import (
+    BufferedImageResize, DistributedImageSet, ImageChannelOrder,
+    ImageFeature, ImageFeatureToTensor, ImageMatToTensor, ImageMirror,
+    ImagePixelBytesToMat, ImageRandomResize, ImageResize, ImageSet)
+from analytics_zoo_tpu.feature.image3d import Warp3D
+
+
+def _iset(rng, n=8, hw=(6, 6)):
+    imgs = [rng.integers(0, 255, hw + (3,)).astype(np.uint8)
+            for _ in range(n)]
+    return ImageSet.from_arrays(imgs, labels=list(range(n))), imgs
+
+
+def test_channel_order_and_mirror(rng):
+    iset, imgs = _iset(rng, n=2)
+    out = iset.transform(ImageChannelOrder())
+    np.testing.assert_array_equal(out.features[0].image,
+                                  imgs[0][..., ::-1])
+    out = iset.transform(ImageMirror())
+    np.testing.assert_array_equal(out.features[1].image, imgs[1][:, ::-1])
+
+
+def test_random_resize_bounds(rng):
+    iset, _ = _iset(rng, n=6)
+    out = iset.transform(ImageRandomResize(8, 12, seed=0))
+    sizes = {f.image.shape[:2] for f in out.features}
+    assert all(8 <= h <= 12 and 8 <= w <= 12 for h, w in sizes)
+    assert len(sizes) > 1                     # actually random
+    out2 = iset.transform(BufferedImageResize(10, 10))
+    assert all(f.image.shape[:2] == (10, 10) for f in out2.features)
+
+
+def test_pixel_bytes_to_mat(rng):
+    raw = rng.integers(0, 255, (4, 5, 3)).astype(np.uint8)
+    f = ImageFeature(image=raw.tobytes())
+    out = ImagePixelBytesToMat(4, 5, 3).transform(f)
+    np.testing.assert_array_equal(out.image, raw)
+
+
+def test_mat_to_tensor_layouts(rng):
+    iset, imgs = _iset(rng, n=1)
+    chw = iset.transform(ImageMatToTensor(format="NCHW")).features[0].image
+    assert chw.shape == (3, 6, 6) and chw.dtype == np.float32
+    hwc = iset.transform(ImageFeatureToTensor()).features[0].image
+    assert hwc.shape == (6, 6, 3)
+
+
+def test_distributed_imageset(rng):
+    iset, _ = _iset(rng, n=10)
+    dist = iset.to_distributed(3)
+    assert dist.is_distributed and not iset.is_distributed
+    assert len(dist.shards) == 3 and len(dist) == 10
+    out = dist.transform(ImageResize(4, 4))
+    assert all(f.image.shape[:2] == (4, 4) for f in out.to_local().features)
+    fs = out.to_feature_set()
+    x, y, _ = next(iter(fs.batches(10)))
+    assert np.asarray(x).shape == (10, 4, 4, 3)
+    # labels survive the shard round trip in order
+    assert sorted(np.asarray(y)[:, 0].tolist()) == list(range(10))
+
+    assert callable(DistributedImageSet.read)            # constructor exists
+
+
+def test_warp3d_identity_and_shift(rng):
+    vol = rng.normal(size=(5, 6, 7)).astype(np.float32)
+    zero = np.zeros((3, 5, 6, 7))
+    np.testing.assert_allclose(Warp3D(zero).transform(vol), vol, atol=1e-6)
+
+    # unit shift along axis 0: out[i] = in[i+1] (edge clamped)
+    flow = zero.copy()
+    flow[0] = 1.0
+    out = Warp3D(flow).transform(vol)
+    np.testing.assert_allclose(out[:-1], vol[1:], atol=1e-5)
+
+    with pytest.raises(ValueError, match="flow"):
+        Warp3D(np.zeros((3, 2, 2, 2))).transform(vol)
