@@ -161,6 +161,15 @@ def _lrn(attrs):
     return fn
 
 
+def _static(*vals):
+    """True when no value is a JAX tracer — shape-arithmetic subgraphs
+    (Shape->Gather->Concat->Reshape, the dynamic-batch idiom) then run
+    host-side in numpy so Reshape receives a CONCRETE target even under jit
+    (jnp ops on constants return tracers inside a trace in current JAX)."""
+    from jax.core import Tracer
+    return not any(isinstance(v, Tracer) for v in vals)
+
+
 @register("Reshape")
 def _reshape(attrs):
     def fn(x, shape=None):
@@ -187,7 +196,14 @@ def _transpose(attrs):
 @register("Concat")
 def _concat(attrs):
     ax = int(attrs["axis"])
-    return lambda *xs: jnp.concatenate(xs, axis=ax)
+
+    def fn(*xs):
+        # int shape-tensors (from Shape OR integer initializers) fold host-side
+        if _static(*xs) and all(
+                np.issubdtype(np.asarray(x).dtype, np.integer) for x in xs):
+            return np.concatenate([np.asarray(x) for x in xs], axis=ax)
+        return jnp.concatenate(xs, axis=ax)
+    return fn
 
 
 @register("Slice")
@@ -210,7 +226,14 @@ def _slice(attrs):
 @register("Gather")
 def _gather(attrs):
     ax = int(attrs.get("axis", 0))
-    return lambda x, idx: jnp.take(x, idx.astype(jnp.int32), axis=ax)
+
+    def fn(x, idx):
+        if _static(x, idx) and \
+                np.issubdtype(np.asarray(x).dtype, np.integer):
+            return np.take(np.asarray(x), np.asarray(idx).astype(np.int64),
+                           axis=ax)
+        return jnp.take(x, idx.astype(jnp.int32), axis=ax)
+    return fn
 
 
 @register("Squeeze")
@@ -227,8 +250,9 @@ def _squeeze(attrs):
 def _unsqueeze(attrs):
     def fn(x, axes=None):
         axes = attrs.get("axes") if axes is None else np.asarray(axes).tolist()
+        xp = np if isinstance(x, (np.ndarray, np.generic)) else jnp
         for a in sorted(int(a) for a in axes):
-            x = jnp.expand_dims(x, a)
+            x = xp.expand_dims(x, a)
         return x
     return fn
 
@@ -258,7 +282,9 @@ def _constant(attrs):
 
 @register("Shape")
 def _shape(attrs):
-    return lambda x: jnp.asarray(x.shape, jnp.int64)
+    # numpy on purpose: shapes are static under jit, and keeping the result
+    # host-side lets downstream Gather/Concat/Reshape constant-fold
+    return lambda x: np.asarray(x.shape, np.int64)
 
 
 def _reduce_op(jnp_fn):

@@ -1,0 +1,239 @@
+"""TFPark text Keras-model family, rebuilt natively (VERDICT r2 row 32).
+
+Reference parity: pyzoo/zoo/tfpark/text/keras/{ner.py, pos_tagging.py,
+intent_extraction.py} — which wrap nlp-architect graphs (word+char BiLSTM
+taggers with a CRF head; a joint intent/entity model).  Here the graphs are
+built from native layers and train through the Estimator; the CRF head is a
+real linear-chain CRF (nn/layers/crf.py) rather than a wrapped dependency.
+
+Input conventions match the reference:
+  NER / SequenceTagger: [word_ids (B, T), char_ids (B, T, W)]
+  IntentEntity:         [word_ids (B, T), char_ids (B, T, W)]
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Embedding
+from analytics_zoo_tpu.nn.layers.crf import CRF
+from analytics_zoo_tpu.nn.layers.recurrent import LSTM, Bidirectional
+from analytics_zoo_tpu.nn.module import Layer
+from analytics_zoo_tpu.nn.optimizers import Adam
+
+
+class _WordCharEncoder(Layer):
+    """Shared tagger trunk: word embedding + char-BiLSTM word features ->
+    sentence BiLSTM states (B, T, 2*lstm_dim).  word_length (when given)
+    validates the char input width against the configured value."""
+
+    def __init__(self, word_vocab_size, char_vocab_size, word_emb_dim=100,
+                 char_emb_dim=30, lstm_dim=100, dropout=0.5,
+                 word_length=None, **kwargs):
+        super().__init__(**kwargs)
+        self.word_emb = Embedding(word_vocab_size, word_emb_dim,
+                                  name=self.name + "_wemb")
+        self.char_emb = Embedding(char_vocab_size, char_emb_dim,
+                                  name=self.name + "_cemb")
+        self.char_lstm = Bidirectional(
+            LSTM(char_emb_dim, inner_activation="sigmoid"),
+            name=self.name + "_clstm")
+        self.sent_lstm = Bidirectional(
+            LSTM(lstm_dim, inner_activation="sigmoid",
+                 return_sequences=True), name=self.name + "_slstm")
+        self.drop = Dropout(dropout, name=self.name + "_drop")
+        self.dims = (word_emb_dim, char_emb_dim, lstm_dim)
+        self.word_length = word_length
+
+    def build(self, rng, input_shape):
+        word_d, char_d, lstm_d = self.dims
+        r = jax.random.split(rng, 4)
+        return {
+            "wemb": self.word_emb.build(r[0], None),
+            "cemb": self.char_emb.build(r[1], None),
+            "clstm": self.char_lstm.build(r[2], (None, char_d)),
+            "slstm": self.sent_lstm.build(r[3],
+                                          (None, word_d + 2 * char_d)),
+        }
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        word_ids, char_ids = inputs
+        B, T = word_ids.shape[:2]
+        W = char_ids.shape[-1]
+        if self.word_length is not None and W != self.word_length:
+            raise ValueError(
+                f"char input width {W} != configured word_length "
+                f"{self.word_length}")
+        w = self.word_emb.call(params["wemb"], word_ids)          # (B,T,Dw)
+        c = self.char_emb.call(params["cemb"],
+                               char_ids.reshape(B * T, W))        # (BT,W,Dc)
+        cw = self.char_lstm.call(params["clstm"], c)              # (BT,2Dc)
+        cw = cw.reshape(B, T, -1)
+        h = jnp.concatenate([w, cw], axis=-1)
+        h = self.drop.call({}, h, training=training, rng=rng)
+        return self.sent_lstm.call(params["slstm"], h,
+                                   training=training, rng=rng)    # (B,T,2H)
+
+
+class _TaggerModel(Layer):
+    """Encoder + per-head token projections (+ CRF for head 0)."""
+
+    def __init__(self, head_dims: Tuple[int, ...], use_crf: bool = True,
+                 pooled_head: Optional[int] = None, **enc_kw):
+        super().__init__()
+        self.encoder = _WordCharEncoder(name=self.name + "_enc", **enc_kw)
+        self.head_dims = tuple(head_dims)
+        self.heads = [Dense(d, name=f"{self.name}_head{i}")
+                      for i, d in enumerate(self.head_dims)]
+        self.use_crf = use_crf
+        self.pooled_head = pooled_head        # head index fed pooled state
+        self.crf = CRF(self.head_dims[0], name=self.name + "_crf") \
+            if use_crf else None
+
+    def build(self, rng, input_shape):
+        r = jax.random.split(rng, 2 + len(self.heads))
+        lstm_out = 2 * self.encoder.dims[2]
+        p = {"enc": self.encoder.build(r[0], input_shape)}
+        for i, head in enumerate(self.heads):
+            p[f"head{i}"] = head.build(r[2 + i], (None, lstm_out))
+        if self.crf is not None:
+            p["crf"] = self.crf.build(r[1], (None, self.head_dims[0]))
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        h = self.encoder.call(params["enc"], inputs, training=training,
+                              rng=rng)                            # (B,T,2H)
+        outs = []
+        for i, head in enumerate(self.heads):
+            x = h.mean(axis=1) if i == self.pooled_head else h
+            outs.append(head.call(params[f"head{i}"], x))
+        if self.crf is not None:
+            # CRF potentials ride along in y_pred (batch-broadcast) so the
+            # Estimator loss differentiates them — the loss callable only
+            # sees (y_pred, y_true), never the param pytree
+            B = outs[0].shape[0]
+            cp = params["crf"]
+            outs += [jnp.broadcast_to(cp["transitions"],
+                                      (B,) + cp["transitions"].shape),
+                     jnp.broadcast_to(cp["start"], (B,) + cp["start"].shape),
+                     jnp.broadcast_to(cp["end"], (B,) + cp["end"].shape)]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class _TextModelBase:
+    """fit/predict plumbing shared by the text models."""
+
+    def __init__(self, model: _TaggerModel, loss, optimizer=None, ctx=None):
+        self.model = model
+        self.estimator = Estimator(model,
+                                   optimizer=optimizer or Adam(lr=1e-3),
+                                   loss=loss, ctx=ctx)
+
+    def fit(self, x, y, *, batch_size=32, epochs=1, **kw):
+        return self.estimator.fit(list(x), y, batch_size=batch_size,
+                                  epochs=epochs, **kw)
+
+    def predict(self, x, *, batch_size=32):
+        return self.estimator.predict(list(x), batch_size=batch_size)
+
+
+class NER(_TextModelBase):
+    """BiLSTM + CRF named-entity tagger (ner.py parity).
+
+    fit labels: (B, T) int tags.  predict returns Viterbi tag paths (B, T)."""
+
+    def __init__(self, num_entities, word_vocab_size, char_vocab_size,
+                 word_length=12, word_emb_dim=100, char_emb_dim=30,
+                 tagger_lstm_dim=100, dropout=0.5, optimizer=None, ctx=None):
+        model = _TaggerModel((num_entities,), use_crf=True,
+                             word_vocab_size=word_vocab_size,
+                             char_vocab_size=char_vocab_size,
+                             word_emb_dim=word_emb_dim,
+                             char_emb_dim=char_emb_dim,
+                             lstm_dim=tagger_lstm_dim, dropout=dropout,
+                             word_length=word_length)
+
+        def crf_loss(y_pred, y_true):
+            emissions, trans, start, end = y_pred
+            tags = jnp.asarray(y_true).astype(jnp.int32)
+            if tags.ndim == 3:
+                tags = tags[..., 0]
+            crf_params = {"transitions": trans[0], "start": start[0],
+                          "end": end[0]}
+            return model.crf.neg_log_likelihood(crf_params, emissions, tags)
+
+        super().__init__(model, crf_loss, optimizer, ctx)
+
+    def predict(self, x, *, batch_size=32):
+        out = super().predict(x, batch_size=batch_size)
+        emissions = out[0]
+        params = jax.device_get(self.estimator.params)
+        return np.asarray(self.model.crf.decode(params["crf"],
+                                                jnp.asarray(emissions)))
+
+
+class SequenceTagger(_TextModelBase):
+    """Joint POS + chunk tagger (pos_tagging.py parity): two per-token
+    softmax heads.  fit labels: (B, T, 2) int [pos, chunk]."""
+
+    def __init__(self, num_pos_labels, num_chunk_labels, word_vocab_size,
+                 char_vocab_size, word_length=12, word_emb_dim=100,
+                 char_emb_dim=30, tagger_lstm_dim=100, dropout=0.5,
+                 optimizer=None, ctx=None):
+        model = _TaggerModel((num_pos_labels, num_chunk_labels),
+                             use_crf=False,
+                             word_vocab_size=word_vocab_size,
+                             char_vocab_size=char_vocab_size,
+                             word_emb_dim=word_emb_dim,
+                             char_emb_dim=char_emb_dim,
+                             lstm_dim=tagger_lstm_dim, dropout=dropout,
+                             word_length=word_length)
+
+        def joint_loss(y_pred, y_true):
+            pos_logits, chunk_logits = y_pred
+            t = jnp.asarray(y_true).astype(jnp.int32)
+            lp = jax.nn.log_softmax(pos_logits, axis=-1)
+            lc = jax.nn.log_softmax(chunk_logits, axis=-1)
+            nll_p = -jnp.take_along_axis(lp, t[..., :1], axis=-1)[..., 0]
+            nll_c = -jnp.take_along_axis(lc, t[..., 1:2], axis=-1)[..., 0]
+            return (nll_p + nll_c).mean(axis=-1)
+
+        super().__init__(model, joint_loss, optimizer, ctx)
+
+
+class IntentEntity(_TextModelBase):
+    """Joint intent classification + entity extraction
+    (intent_extraction.py parity): a pooled intent head + per-token entity
+    head.  fit labels: (B, 1 + T) int [intent, entity tags...]."""
+
+    def __init__(self, num_intents, num_entities, word_vocab_size,
+                 char_vocab_size, word_length=12, word_emb_dim=100,
+                 char_emb_dim=30, tagger_lstm_dim=100, dropout=0.5,
+                 optimizer=None, ctx=None):
+        model = _TaggerModel((num_entities, num_intents), use_crf=False,
+                             pooled_head=1,
+                             word_vocab_size=word_vocab_size,
+                             char_vocab_size=char_vocab_size,
+                             word_emb_dim=word_emb_dim,
+                             char_emb_dim=char_emb_dim,
+                             lstm_dim=tagger_lstm_dim, dropout=dropout,
+                             word_length=word_length)
+
+        def joint_loss(y_pred, y_true):
+            ent_logits, intent_logits = y_pred
+            t = jnp.asarray(y_true).astype(jnp.int32)
+            intent, tags = t[:, 0], t[:, 1:]
+            li = jax.nn.log_softmax(intent_logits, axis=-1)
+            nll_i = -jnp.take_along_axis(li, intent[:, None], axis=-1)[:, 0]
+            le = jax.nn.log_softmax(ent_logits, axis=-1)
+            nll_e = -jnp.take_along_axis(le, tags[..., None],
+                                         axis=-1)[..., 0].mean(axis=-1)
+            return nll_i + nll_e
+
+        super().__init__(model, joint_loss, optimizer, ctx)

@@ -22,6 +22,7 @@ from analytics_zoo_tpu.nn.layers.math import (
     SplitTensor, Sqrt, Square, Threshold)
 from analytics_zoo_tpu.nn.layers.embedding import (
     SparseDense, SparseEmbedding, WordEmbedding)
+from analytics_zoo_tpu.nn.layers.crf import CRF
 from analytics_zoo_tpu.nn.layers.advanced import (
     ELU, LeakyReLU, MaxoutDense, PReLU, SReLU, SpatialDropout1D, SpatialDropout2D,
     ThresholdedReLU, WithinChannelLRN2D)

@@ -1,0 +1,124 @@
+"""Linear-chain Conditional Random Field layer.
+
+Reference parity: the CRF sequence classifier the TFPark text models rely on
+(pyzoo/zoo/tfpark/text/keras/ner.py — nlp-architect NERCRF's CRF head).
+TPU-native: the forward (partition) recursion and Viterbi decode are
+`lax.scan` programs over the time axis — no Python loops, jit/grad friendly.
+
+API:
+    crf = CRF(num_tags)
+    params = crf.build(rng, (T, num_tags))
+    nll = crf.neg_log_likelihood(params, emissions, tags, mask)   # (B,)
+    best = crf.decode(params, emissions, mask)                    # (B, T)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn.module import Layer, to_shape
+
+
+class CRF(Layer):
+    """Emissions (B, T, K) -> CRF with learned (K, K) transition matrix.
+
+    call() returns the emissions unchanged (the CRF shapes training through
+    `neg_log_likelihood`, used as the model loss); decode() gives the
+    Viterbi path."""
+
+    def __init__(self, num_tags: int, **kwargs):
+        super().__init__(**kwargs)
+        self.num_tags = int(num_tags)
+
+    def build(self, rng, input_shape):
+        K = self.num_tags
+        return {"transitions": 0.01 * jax.random.normal(
+            rng, (K, K), dtypes.param_dtype()),
+            "start": jnp.zeros((K,), dtypes.param_dtype()),
+            "end": jnp.zeros((K,), dtypes.param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x
+
+    # -- scoring -------------------------------------------------------------
+    def _mask(self, emissions, mask):
+        if mask is None:
+            return jnp.ones(emissions.shape[:2], jnp.float32)
+        return jnp.asarray(mask, jnp.float32)
+
+    def log_partition(self, params, emissions, mask=None):
+        """log Z via the forward algorithm (scan over T)."""
+        m = self._mask(emissions, mask)                    # (B, T)
+        e = emissions.astype(jnp.float32)
+        trans = params["transitions"].astype(jnp.float32)
+        alpha0 = params["start"].astype(jnp.float32) + e[:, 0]
+
+        def step(alpha, inp):
+            e_t, m_t = inp                                  # (B, K), (B,)
+            scores = alpha[:, :, None] + trans[None] + e_t[:, None, :]
+            new = jax.nn.logsumexp(scores, axis=1)
+            alpha = jnp.where(m_t[:, None] > 0, new, alpha)
+            return alpha, ()
+
+        xs = (jnp.swapaxes(e[:, 1:], 0, 1), jnp.swapaxes(m[:, 1:], 0, 1))
+        alpha, _ = jax.lax.scan(step, alpha0, xs)
+        return jax.nn.logsumexp(alpha + params["end"][None].astype(jnp.float32),
+                                axis=-1)                    # (B,)
+
+    def score(self, params, emissions, tags, mask=None):
+        """Path score of the given tag sequences (B,)."""
+        m = self._mask(emissions, mask)
+        e = emissions.astype(jnp.float32)
+        t = jnp.asarray(tags, jnp.int32)
+        B, T, K = e.shape
+        trans = params["transitions"].astype(jnp.float32)
+        emit = jnp.take_along_axis(e, t[..., None], axis=-1)[..., 0]   # (B,T)
+        emit_score = (emit * m).sum(-1)
+        pair = trans[t[:, :-1], t[:, 1:]] * m[:, 1:]        # (B, T-1)
+        start = params["start"].astype(jnp.float32)[t[:, 0]]
+        # end bonus applies at each sequence's LAST valid position's tag
+        last_idx = jnp.maximum(m.sum(-1).astype(jnp.int32) - 1, 0)
+        last_tag = jnp.take_along_axis(t, last_idx[:, None], axis=1)[:, 0]
+        end = params["end"].astype(jnp.float32)[last_tag]
+        return emit_score + pair.sum(-1) + start + end
+
+    def neg_log_likelihood(self, params, emissions, tags, mask=None):
+        """(B,) per-sequence -log p(tags | emissions); use as Estimator loss."""
+        return self.log_partition(params, emissions, mask) \
+            - self.score(params, emissions, tags, mask)
+
+    # -- decoding ------------------------------------------------------------
+    def decode(self, params, emissions, mask=None):
+        """Viterbi best paths (B, T) int32 (padded steps repeat the last
+        valid tag)."""
+        m = self._mask(emissions, mask)
+        e = emissions.astype(jnp.float32)
+        trans = params["transitions"].astype(jnp.float32)
+        B, T, K = e.shape
+        delta0 = params["start"].astype(jnp.float32) + e[:, 0]
+
+        def fwd(delta, inp):
+            e_t, m_t = inp
+            scores = delta[:, :, None] + trans[None] + e_t[:, None, :]
+            best_prev = jnp.argmax(scores, axis=1)          # (B, K)
+            new = jnp.max(scores, axis=1)
+            delta_new = jnp.where(m_t[:, None] > 0, new, delta)
+            bp = jnp.where(m_t[:, None] > 0, best_prev,
+                           jnp.arange(K)[None, :])          # identity if pad
+            return delta_new, bp
+
+        xs = (jnp.swapaxes(e[:, 1:], 0, 1), jnp.swapaxes(m[:, 1:], 0, 1))
+        delta, bps = jax.lax.scan(fwd, delta0, xs)          # bps (T-1, B, K)
+        last = jnp.argmax(delta + params["end"][None].astype(jnp.float32),
+                          axis=-1)                          # (B,)
+
+        def bwd(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        first, tags_rev = jax.lax.scan(bwd, last, bps[::-1])
+        # scan emits [tag_{T-1}, ..., tag_1] and carries out tag_0
+        tags = jnp.concatenate([first[None], tags_rev[::-1]], axis=0)  # (T, B)
+        return jnp.swapaxes(tags, 0, 1).astype(jnp.int32)
