@@ -37,6 +37,57 @@ log = _unary(jnp.log, "ag_log")
 exp = _unary(jnp.exp, "ag_exp")
 softsign = _unary(jax.nn.soft_sign, "ag_softsign")
 softplus = _unary(jax.nn.softplus, "ag_softplus")
+erf = _unary(jax.scipy.special.erf, "ag_erf")
+contiguous = _unary(lambda t: t, "ag_contiguous")   # layout no-op on TPU
+
+
+def slice(x, dim: int, start_index: int, length: int):  # noqa: A001
+    """AutoGrad.slice parity: slice `length` elements from `start_index`
+    along non-batch axis `dim` (length=-1 takes the rest)."""
+    import builtins
+
+    def fn(t):
+        ax = _nonbatch_axis(t, dim)
+        idx = [builtins.slice(None)] * t.ndim
+        start = start_index if start_index >= 0 \
+            else t.shape[ax] + start_index          # resolve negative starts
+        stop = None if length == -1 else start + length
+        idx[ax] = builtins.slice(start, stop)
+        return t[tuple(idx)]
+    return Lambda(fn, name="ag_slice")(x)
+
+
+def index_select(x, dim: int, index):
+    """AutoGrad.indexSelect parity: gather `index` (int or list of ints)
+    along non-batch axis `dim`; a scalar index drops the axis."""
+    def fn(t):
+        ax = _nonbatch_axis(t, dim)
+        idx = [index] if isinstance(index, int) else list(index)
+        bad = [i for i in idx if not -t.shape[ax] <= int(i) < t.shape[ax]]
+        if bad:
+            raise IndexError(
+                f"index_select indices {bad} out of range for axis {ax} "
+                f"of size {t.shape[ax]}")
+        if isinstance(index, int):
+            return jnp.take(t, index, axis=ax)
+        return jnp.take(t, jnp.asarray(index, jnp.int32), axis=ax)
+    return Lambda(fn, name="ag_index_select")(x)
+
+
+def squeeze(x, dim: int):
+    return Lambda(lambda t: jnp.squeeze(t, axis=_nonbatch_axis(t, dim)),
+                  name="ag_squeeze")(x)
+
+
+def expand(x, sizes):
+    """AutoGrad.broadcast/expand parity: broadcast non-batch dims to `sizes`
+    (-1 keeps a dim)."""
+    def fn(t):
+        tgt = (t.shape[0],) + tuple(
+            t.shape[i + 1] if s == -1 else int(s)
+            for i, s in enumerate(sizes))
+        return jnp.broadcast_to(t, tgt)
+    return Lambda(fn, name="ag_broadcast")(x)
 
 
 def epsilon() -> float:

@@ -10,6 +10,7 @@
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -59,3 +60,53 @@ def test_from_env_tolerates_default_factory(monkeypatch):
     conf = Conf2.from_env()          # previously AttributeError on `extras`
     assert conf.seed == 99
     assert conf.extras == ["whatever"]   # list fields parse comma-separated
+
+
+def test_autograd_round3_functions(rng):
+    """AutoGrad math parity additions: erf/slice/index_select/squeeze/expand
+    (math.scala:32-378)."""
+    from scipy.special import erf as scipy_erf
+
+    from analytics_zoo_tpu.nn import Input, Model, autograd
+
+    x = np.asarray(rng.normal(size=(3, 4, 5)), np.float32)
+
+    def run(sym_out, inp):
+        m = Model(input=inp, output=sym_out)
+        params, _ = m.init(jax.random.PRNGKey(0))
+        return np.asarray(m.call(params, jnp.asarray(x), training=False))
+
+    v = Input(shape=(4, 5))
+    np.testing.assert_allclose(run(autograd.erf(v), v), scipy_erf(x),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(run(autograd.slice(v, 0, 1, 2), v),
+                               x[:, 1:3], rtol=1e-6)
+    np.testing.assert_allclose(run(autograd.slice(v, 1, 2, -1), v),
+                               x[:, :, 2:], rtol=1e-6)
+    np.testing.assert_allclose(run(autograd.index_select(v, 1, [0, 3]), v),
+                               x[:, :, [0, 3]], rtol=1e-6)
+    np.testing.assert_allclose(run(autograd.index_select(v, 0, 2), v),
+                               x[:, 2], rtol=1e-6)
+    # expand_dims uses raw array axes (axis 1 = first non-batch slot)
+    np.testing.assert_allclose(run(autograd.expand(
+        autograd.expand_dims(v, 1), (4, -1, -1)), v)[:, 1],
+        x, rtol=1e-6)
+    np.testing.assert_allclose(
+        run(autograd.squeeze(autograd.expand_dims(v, 1), 0), v), x, rtol=1e-6)
+    np.testing.assert_allclose(run(autograd.contiguous(v), v), x)
+
+
+def test_autograd_slice_negative_start_and_bad_index(rng):
+    from analytics_zoo_tpu.nn import Input, Model, autograd
+
+    x = np.asarray(rng.normal(size=(2, 3, 4)), np.float32)
+    v = Input(shape=(3, 4))
+    m = Model(input=v, output=autograd.slice(v, 1, -2, 2))
+    params, _ = m.init(jax.random.PRNGKey(0))
+    got = np.asarray(m.call(params, jnp.asarray(x), training=False))
+    np.testing.assert_allclose(got, x[:, :, -2:], rtol=1e-6)
+
+    with pytest.raises(IndexError, match="out of range"):
+        m2 = Model(input=v, output=autograd.index_select(v, 1, 99))
+        p2, _ = m2.init(jax.random.PRNGKey(0))
+        m2.call(p2, jnp.asarray(x), training=False)
