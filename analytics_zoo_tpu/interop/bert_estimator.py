@@ -16,7 +16,7 @@ tests/test_bert_estimator.py.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

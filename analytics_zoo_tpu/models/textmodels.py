@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.common import dtypes
 from analytics_zoo_tpu.estimator.estimator import Estimator
 from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Embedding
 from analytics_zoo_tpu.nn.layers.crf import CRF

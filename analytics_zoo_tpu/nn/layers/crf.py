@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.common import dtypes
-from analytics_zoo_tpu.nn.module import Layer, to_shape
+from analytics_zoo_tpu.nn.module import Layer
 
 
 class CRF(Layer):
