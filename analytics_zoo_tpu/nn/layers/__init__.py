@@ -23,6 +23,7 @@ from analytics_zoo_tpu.nn.layers.math import (
 from analytics_zoo_tpu.nn.layers.embedding import (
     SparseDense, SparseEmbedding, WordEmbedding)
 from analytics_zoo_tpu.nn.layers.crf import CRF
+from analytics_zoo_tpu.nn.layers.moe import MixtureOfExperts
 from analytics_zoo_tpu.nn.layers.advanced import (
     ELU, LeakyReLU, MaxoutDense, PReLU, SReLU, SpatialDropout1D, SpatialDropout2D,
     ThresholdedReLU, WithinChannelLRN2D)

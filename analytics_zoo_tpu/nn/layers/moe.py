@@ -1,0 +1,104 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Green-field for the reference (SURVEY §2.3 lists expert parallelism as NOT
+present — this is a TPU-native capability extension alongside ring
+attention): a dense top-k-gated MoE FFN whose expert weights are stacked on
+a leading E axis, designed so that sharding that axis over a mesh
+("expert" axis) gives expert parallelism for free under GSPMD — each device
+computes its experts' token outputs, and the gate-weighted combine reduces
+over the sharded axis (XLA inserts the psum).
+
+Dense-compute formulation (every expert sees every token, softmax top-k
+gate zeroes the rest): no capacity factor / token dropping, static shapes,
+exact gradients — the right starting point for XLA; a Pallas-routed sparse
+kernel is the later optimization, not a semantic change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common import dtypes
+from analytics_zoo_tpu.nn import activations
+from analytics_zoo_tpu.nn.module import Layer, to_shape
+
+
+class MixtureOfExperts(Layer):
+    """Top-k gated MoE FFN: (B, T, D) -> (B, T, D).
+
+    params:
+      gate/W (D, E)                      — router
+      experts/{W1 (E, D, H), b1 (E, H), W2 (E, H, D), b2 (E, D)}
+
+    Shard the leading E axis of the expert weights over an "expert" mesh
+    axis for expert parallelism (see parallel/sharding.ShardingPlan and
+    __graft_entry__.dryrun_multichip's ep section)."""
+
+    def __init__(self, num_experts: int, hidden_dim: int, top_k: int = 2,
+                 activation="gelu", aux_loss_weight: float = 0.01, **kwargs):
+        super().__init__(**kwargs)
+        self.E = int(num_experts)
+        self.H = int(hidden_dim)
+        self.k = int(top_k)
+        if not 1 <= self.k <= self.E:
+            raise ValueError(f"top_k={top_k} out of range for {num_experts} "
+                             "experts")
+        self.act = activations.get(activation)
+        self.aux_loss_weight = float(aux_loss_weight)
+
+    def build(self, rng, input_shape):
+        D = to_shape(input_shape)[-1]
+        rg, r1, r2 = jax.random.split(rng, 3)
+        std = 0.02
+        return {
+            "gate": {"W": std * jax.random.normal(rg, (D, self.E),
+                                                  dtypes.param_dtype())},
+            "experts": {
+                "W1": std * jax.random.normal(r1, (self.E, D, self.H),
+                                              dtypes.param_dtype()),
+                "b1": jnp.zeros((self.E, self.H), dtypes.param_dtype()),
+                "W2": std * jax.random.normal(r2, (self.E, self.H, D),
+                                              dtypes.param_dtype()),
+                "b2": jnp.zeros((self.E, D), dtypes.param_dtype()),
+            },
+        }
+
+    def gates(self, params, x):
+        """(B, T, E) top-k softmax gate weights (zeros outside the top-k)."""
+        logits = jnp.einsum("btd,de->bte", *dtypes.cast_compute(
+            x, params["gate"]["W"]),
+            preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if self.k >= self.E:
+            return probs
+        # lax.top_k breaks ties deterministically by index (a threshold test
+        # would activate >k experts on tied probs, e.g. zero tokens)
+        _, idx = jax.lax.top_k(probs, self.k)
+        mask = jnp.zeros_like(probs).at[
+            jnp.arange(probs.shape[0])[:, None, None],
+            jnp.arange(probs.shape[1])[None, :, None], idx].set(1.0)
+        topk = probs * mask
+        return topk / jnp.maximum(topk.sum(-1, keepdims=True), 1e-9)
+
+    def aux_load_balance_loss(self, gates):
+        """Switch-style load-balance penalty: E * sum_e f_e * p_e."""
+        p = gates.mean(axis=(0, 1))                       # mean gate prob
+        f = (gates > 0).astype(jnp.float32).mean(axis=(0, 1))
+        return self.E * jnp.sum(p * f)
+
+    def call(self, params, x, *, training=False, rng=None):
+        g = self.gates(params, x)                          # (B, T, E)
+        ep = params["experts"]
+        xw, W1, W2 = dtypes.cast_compute(x, ep["W1"], ep["W2"])
+        # every expert on every token; the e axis is the EP shard axis —
+        # with W1/W2 sharded on e, each device computes its experts and the
+        # final contraction over e is the cross-expert combine (psum)
+        h = self.act(jnp.einsum("btd,edh->bteh", xw, W1,
+                                preferred_element_type=jnp.float32)
+                     + ep["b1"][None, None])
+        y = jnp.einsum("bteh,ehd->bted", h.astype(xw.dtype), W2,
+                       preferred_element_type=jnp.float32) \
+            + ep["b2"][None, None]
+        out = jnp.einsum("bted,bte->btd", y, g)
+        return out.astype(x.dtype)
