@@ -5,7 +5,8 @@ from analytics_zoo_tpu.nn.layers.core import (
 from analytics_zoo_tpu.nn.layers.conv import (
     AtrousConvolution1D, AtrousConvolution2D, Convolution1D, Convolution2D,
     Convolution3D, Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
-    LocallyConnected1D, LocallyConnected2D, LRN2D, ResizeBilinear,
+    DepthwiseConvolution2D, LocallyConnected1D, LocallyConnected2D, LRN2D,
+    ResizeBilinear,
     SeparableConvolution2D, ShareConvolution2D, SpaceToDepth, UpSampling1D,
     UpSampling2D, UpSampling3D, ZeroPadding1D, ZeroPadding2D, ZeroPadding3D)
 from analytics_zoo_tpu.nn.layers.pooling import (

@@ -42,7 +42,7 @@ class _ConvND(Layer):
     def __init__(self, nb_filter: int, kernel_size, activation=None,
                  border_mode="valid", subsample=1, dilation=1,
                  init="glorot_uniform", bias: bool = True,
-                 dim_ordering: str = "tf", **kwargs):
+                 dim_ordering: str = "tf", groups: int = 1, **kwargs):
         super().__init__(**kwargs)
         self.nb_filter = int(nb_filter)
         self.kernel_size = _pair(kernel_size, self.ndim)
@@ -53,6 +53,7 @@ class _ConvND(Layer):
         self.init_name = init
         self.bias = bias
         self.dim_ordering = dim_ordering  # "tf"=channels_last, "th"=channels_first
+        self.groups = int(groups)         # grouped conv (AlexNet two-tower style)
 
     def _dn(self):
         spatial = "".join("DHW"[-self.ndim:])
@@ -79,6 +80,11 @@ class _ConvND(Layer):
 
     def build(self, rng, input_shape):
         cin = self._in_channels(input_shape)
+        if cin % self.groups or self.nb_filter % self.groups:
+            raise ValueError(
+                f"groups={self.groups} must divide both in-channels ({cin}) "
+                f"and nb_filter ({self.nb_filter})")
+        cin //= self.groups
         rw, _ = jax.random.split(rng)
         kshape = self.kernel_size + (cin, self.nb_filter)
         fan_in = int(np.prod(self.kernel_size)) * cin
@@ -100,7 +106,7 @@ class _ConvND(Layer):
             acc = jax.lax.conv_general_dilated(
                 xq, params["W_q"], window_strides=self.subsample,
                 padding=_pad_str(self.border_mode), rhs_dilation=self.dilation,
-                dimension_numbers=self._dn(),
+                dimension_numbers=self._dn(), feature_group_count=self.groups,
                 preferred_element_type=jnp.int32)
             y = acc.astype(jnp.float32) * (s_x * params["s_w"])
             if self.bias:
@@ -110,6 +116,7 @@ class _ConvND(Layer):
         y = jax.lax.conv_general_dilated(
             xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode),
             rhs_dilation=self.dilation, dimension_numbers=self._dn(),
+            feature_group_count=self.groups,
             preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
@@ -249,6 +256,58 @@ class SeparableConvolution2D(Layer):
             dtypes.cast_compute(y), pw, window_strides=(1, 1), padding="VALID",
             dimension_numbers=jax.lax.conv_dimension_numbers(
                 y.shape, params["pointwise"].shape, ("NHWC", "HWIO", "NHWC")),
+            preferred_element_type=dtypes.conv_out_dtype())
+        if self.bias:
+            y = y + params["b"]
+        y = self.activation(y)
+        return jnp.transpose(y, (0, 3, 1, 2)) if th else y
+
+
+class DepthwiseConvolution2D(Layer):
+    """Standalone depthwise 2D conv (the depthwise half of
+    SeparableConvolution2D.scala) — the MobileNet building block, where a
+    BatchNorm sits between the depthwise and pointwise convs so the fused
+    separable layer cannot be used."""
+
+    def __init__(self, kernel_size, depth_multiplier=1, activation=None,
+                 subsample=1, border_mode="valid", init="glorot_uniform",
+                 bias=True, dim_ordering="tf", **kwargs):
+        super().__init__(**kwargs)
+        self.kernel_size = _pair(kernel_size)
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = activations.get(activation)
+        self.subsample = _pair(subsample)
+        self.border_mode = border_mode
+        self.init_name = init
+        self.bias = bias
+        self.dim_ordering = dim_ordering
+
+    def build(self, rng, input_shape):
+        s = to_shape(input_shape)
+        cin = s[0] if self.dim_ordering == "th" else s[-1]
+        p = {"depthwise": initializer(
+                self.init_name, rng,
+                self.kernel_size + (1, cin * self.depth_multiplier),
+                dtypes.param_dtype(),
+                fan_in=int(np.prod(self.kernel_size)),
+                fan_out=int(np.prod(self.kernel_size)) * self.depth_multiplier)}
+        if self.bias:
+            p["b"] = jnp.zeros((cin * self.depth_multiplier,),
+                               dtypes.param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        th = self.dim_ordering == "th"
+        if th:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        cin = x.shape[-1]
+        xw, dw = dtypes.cast_compute(x, params["depthwise"])
+        y = jax.lax.conv_general_dilated(
+            xw, dw, window_strides=self.subsample,
+            padding=_pad_str(self.border_mode),
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                xw.shape, dw.shape, ("NHWC", "HWIO", "NHWC")),
+            feature_group_count=cin,
             preferred_element_type=dtypes.conv_out_dtype())
         if self.bias:
             y = y + params["b"]
