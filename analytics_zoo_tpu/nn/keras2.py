@@ -64,12 +64,25 @@ def Conv1D(filters, kernel_size, strides=1, padding="valid", activation=None,
 
 def Conv2D(filters, kernel_size, strides=1, padding="valid", activation=None,
            kernel_initializer="glorot_uniform", use_bias=True,
-           dilation_rate=1, data_format="channels_last", **kw):
+           dilation_rate=1, data_format="channels_last", groups=1, **kw):
     return _conv.Convolution2D(
         filters, kernel_size, activation=activation, border_mode=padding,
         subsample=strides, dilation=dilation_rate, init=kernel_initializer,
-        bias=use_bias,
+        bias=use_bias, groups=groups,
         dim_ordering=_do(data_format), **kw)
+
+
+def DepthwiseConv2D(kernel_size, strides=1, padding="valid", activation=None,
+                    depth_multiplier=1, depthwise_initializer="glorot_uniform",
+                    use_bias=True, data_format="channels_last",
+                    dilation_rate=1, **kw):
+    if dilation_rate not in (1, (1, 1)):
+        raise NotImplementedError(
+            "DepthwiseConv2D dilation_rate != 1 is not supported")
+    return _conv.DepthwiseConvolution2D(
+        kernel_size, depth_multiplier=depth_multiplier, activation=activation,
+        subsample=strides, border_mode=padding, init=depthwise_initializer,
+        bias=use_bias, dim_ordering=_do(data_format), **kw)
 
 
 def MaxPooling1D(pool_size=2, strides=None, padding="valid", **kw):

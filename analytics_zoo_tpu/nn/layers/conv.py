@@ -53,6 +53,8 @@ class _ConvND(Layer):
         self.init_name = init
         self.bias = bias
         self.dim_ordering = dim_ordering  # "tf"=channels_last, "th"=channels_first
+        if groups != int(groups) or int(groups) < 1:
+            raise ValueError(f"groups must be a positive integer, got {groups}")
         self.groups = int(groups)         # grouped conv (AlexNet two-tower style)
 
     def _dn(self):

@@ -95,3 +95,20 @@ def test_autograd_negative_axis(rng):
     got = m.call(params, x, training=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(x.mean(axis=-1)),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_keras2_conv_groups_and_depthwise(rng):
+    """keras2 compat shim exposes groups= and DepthwiseConv2D (ADVICE r3)."""
+    from analytics_zoo_tpu.nn import keras2
+    from analytics_zoo_tpu.nn.layers.conv import (Convolution2D,
+                                                  DepthwiseConvolution2D)
+
+    c = keras2.Conv2D(6, 3, groups=2)
+    assert isinstance(c, Convolution2D) and c.groups == 2
+    d = keras2.DepthwiseConv2D(3, depth_multiplier=2)
+    assert isinstance(d, DepthwiseConvolution2D) and d.depth_multiplier == 2
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 4)), jnp.float32)
+    for layer in (c, d):
+        params = layer.build(jax.random.PRNGKey(0), (8, 8, 4))
+        y, _ = layer.apply(params, {}, x, training=False)
+        assert y.shape[0] == 2

@@ -33,7 +33,8 @@ from analytics_zoo_tpu.nn.layers import (             # noqa: E402
     ELU, GRU, LSTM, AtrousConvolution1D, AtrousConvolution2D,
     AveragePooling1D, AveragePooling2D, AveragePooling3D, BatchNormalization,
     Bidirectional, ConvLSTM2D, Convolution1D, Convolution2D, Convolution3D,
-    Cropping1D, Cropping2D, Deconvolution2D, Dense, Embedding, Flatten,
+    Cropping1D, Cropping2D, Deconvolution2D, Dense, DepthwiseConvolution2D,
+    Embedding, Flatten,
     GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalAveragePooling3D,
     GlobalMaxPooling1D, GlobalMaxPooling2D, GlobalMaxPooling3D, LeakyReLU,
     MaxPooling1D, MaxPooling2D, MaxPooling3D, Permute, PReLU, RepeatVector,
@@ -230,6 +231,56 @@ CASES = [
          lambda: KL.ConvLSTM2D(4, 3, padding="same", return_sequences=True),
          (3, 6, 6, 2), wm_rnn),
 ]
+
+
+def wm_dw(kw, p):
+    kh, kw_, cin, dm = kw[0].shape
+    out = {"depthwise": kw[0].reshape(kh, kw_, 1, cin * dm)}
+    if len(kw) > 1:
+        out["b"] = kw[1]
+    return out
+
+
+CASES += [
+    Case("conv2d_groups2", lambda: Convolution2D(6, 3, groups=2),
+         lambda: KL.Conv2D(6, 3, groups=2, padding="valid"), (8, 8, 4), wm_Wb),
+    Case("conv2d_groups3_s2_same",
+         lambda: Convolution2D(9, 3, groups=3, subsample=2,
+                               border_mode="same"),
+         lambda: KL.Conv2D(9, 3, groups=3, strides=2, padding="same"),
+         (9, 9, 6), wm_Wb),
+    Case("depthwise2d", lambda: DepthwiseConvolution2D(3),
+         lambda: KL.DepthwiseConv2D(3, padding="valid"), (8, 8, 3), wm_dw),
+    Case("depthwise2d_dm2_s2",
+         lambda: DepthwiseConvolution2D(3, depth_multiplier=2, subsample=2,
+                                        border_mode="same"),
+         lambda: KL.DepthwiseConv2D(3, depth_multiplier=2, strides=2,
+                                    padding="same"), (8, 8, 3), wm_dw),
+]
+
+
+def test_depthwise_th_ordering_matches_tf_ordering(rng):
+    """dim_ordering='th' is pure transpose plumbing around the same kernel
+    (keras CPU can't oracle channels_first convs, so check self-consistency)."""
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)  # NCHW
+    th = DepthwiseConvolution2D(3, depth_multiplier=2, dim_ordering="th")
+    tf_ = DepthwiseConvolution2D(3, depth_multiplier=2, dim_ordering="tf")
+    params = th.build(jax.random.PRNGKey(0), (3, 8, 8))
+    y_th, _ = th.apply(params, {}, jnp.asarray(x), training=False)
+    y_tf, _ = tf_.apply(params, {}, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                        training=False)
+    np.testing.assert_allclose(np.asarray(y_th),
+                               np.asarray(y_tf).transpose(0, 3, 1, 2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_groups_validation():
+    with pytest.raises(ValueError):
+        Convolution2D(6, 3, groups=0)
+    with pytest.raises(ValueError):
+        Convolution2D(6, 3, groups=-1)
+    with pytest.raises(ValueError):
+        Convolution2D(6, 3, groups=4).build(jax.random.PRNGKey(0), (8, 8, 3))
 
 
 def _keras_forward_and_grad(klayer, x, need_grad=True):
