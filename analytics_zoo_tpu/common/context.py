@@ -58,6 +58,16 @@ class ZooConf:
     # Profiling: directory for jax.profiler traces; empty = disabled.  Also
     # switchable via ZOO_TPU_PROFILE=1 (traces land in ./zoo_tpu_profile).
     profile_dir: str = ""
+    # Multi-host (multi-process) bootstrap — the TPU-pod analog of the
+    # reference's Spark cluster deploy (wp-bigdl.md:160-164 scaling story).
+    # coordinator_address non-empty => jax.distributed.initialize() is called
+    # by init_context before device discovery; every process then sees the
+    # GLOBAL device set and the mesh spans the pod.  num_processes/process_id
+    # default to -1 = let JAX infer from the TPU runtime (on Cloud TPU the
+    # runtime knows); set both explicitly for CPU/GPU clusters.
+    coordinator_address: str = ""
+    num_processes: int = -1
+    process_id: int = -1
 
     @classmethod
     def from_env(cls, **overrides) -> "ZooConf":
@@ -90,6 +100,19 @@ class ZooConf:
                 and not conf.profile_dir:
             conf.profile_dir = "zoo_tpu_profile"
         return conf
+
+
+def global_put(leaf, sharding):
+    """device_put that also works when the sharding spans processes
+    (multi-host pods): device_put cannot target non-addressable devices, so
+    each process fills only its addressable shards from the (identical)
+    host value via make_array_from_callback.  Single shared implementation
+    for ZooContext.global_device_put and ShardingPlan.shard."""
+    if jax.process_count() == 1:
+        return jax.device_put(leaf, sharding)
+    a = np.asarray(leaf)
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
 
 
 class ZooContext:
@@ -127,6 +150,29 @@ class ZooContext:
     @property
     def data_parallel_size(self) -> int:
         return self.mesh.shape.get(DATA_AXIS, 1)
+
+    # -- multi-host topology --------------------------------------------------
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.process_count > 1
+
+    def local_devices(self):
+        return [d for d in self.devices
+                if d.process_index == jax.process_index()]
+
+    def global_device_put(self, tree, sharding):
+        """Place a host-local pytree under a (possibly cross-process) sharding
+        (see `global_put`: every process holds the same host value and fills
+        only its addressable shards)."""
+        return jax.tree.map(lambda a: global_put(a, sharding), tree)
 
     # -- sharding helpers ---------------------------------------------------
     def data_sharding(self, batch_rank: int = 1) -> NamedSharding:
@@ -168,9 +214,32 @@ def init_context(conf: Optional[ZooConf] = None, *, mesh_axes=None, mesh_shape=N
         conf.mesh_shape = tuple(mesh_shape)
     if seed is not None:
         conf.seed = seed
+    if conf.coordinator_address:
+        _ensure_distributed(conf)
     with _ctx_lock:
         _global_ctx = ZooContext(conf, devices=devices)
         return _global_ctx
+
+
+_distributed_initialized = False
+
+
+def _ensure_distributed(conf: ZooConf) -> None:
+    """Multi-process bootstrap (idempotent): after this, jax.devices() is the
+    GLOBAL device set and collective programs span all processes.  The analog
+    of the reference's cluster Engine init (NNContext.scala:133-186 +
+    wp-bigdl's parameter-server bootstrap); on TPU pods the runtime already
+    knows the topology, so only the coordinator address is required."""
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    kw = {"coordinator_address": conf.coordinator_address}
+    if conf.num_processes >= 0:
+        kw["num_processes"] = conf.num_processes
+    if conf.process_id >= 0:
+        kw["process_id"] = conf.process_id
+    jax.distributed.initialize(**kw)
+    _distributed_initialized = True
 
 
 # API-parity alias (pyzoo/zoo/common/nncontext.py:23)

@@ -118,6 +118,19 @@ class ArrayFeatureSet(FeatureSet):
                    (ys[0] if len(ys) == 1 else ys) if ys else None,
                    w)
 
+    def partition(self, index: int, count: int) -> "ArrayFeatureSet":
+        """This process's contiguous shard for multi-host training: process p
+        of `count` feeds rows [p*n/count, (p+1)*n/count) (the analog of a
+        Spark partition pinned to an executor).  Row order must match across
+        processes for the global-batch assembly in Estimator._shard."""
+        if not (0 <= index < count):
+            raise ValueError(f"partition index {index} not in [0, {count})")
+        lo = (self._n * index) // count
+        hi = (self._n * (index + 1)) // count
+        return ArrayFeatureSet([x[lo:hi] for x in self.xs],
+                               [y[lo:hi] for y in self.ys] or None,
+                               self.memory_type)
+
     def split(self, fraction: float, seed: int = 0):
         """Random train/val split (reference FeatureSet has no built-in split; this
         replaces ad-hoc RDD randomSplit usage in examples)."""

@@ -23,6 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from analytics_zoo_tpu.common.context import global_put
+
 
 def leaf_paths(tree):
     """Flatten a pytree into ("a/b/c", leaf) pairs."""
@@ -62,7 +64,7 @@ class ShardingPlan:
         placed = []
         for (path, leaf), _ in zip(pairs, flat):
             spec = self._fit(self.spec_for(path, leaf), mesh, np.shape(leaf))
-            placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+            placed.append(global_put(leaf, NamedSharding(mesh, spec)))
         return jax.tree_util.tree_unflatten(treedef, placed)
 
     def shardings(self, tree, mesh: Mesh):

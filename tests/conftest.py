@@ -31,10 +31,13 @@ def _session_ctx():
 
 @pytest.fixture()
 def ctx(_session_ctx):
-    # Re-seed per test so each test sees a deterministic rng stream regardless
-    # of which (or how many) other tests ran before it.
-    _session_ctx.set_seed(42)
-    return _session_ctx
+    # Always hand out the CURRENT global context (a test may have replaced it
+    # via init_context), re-seeded so each test sees a deterministic rng
+    # stream regardless of which (or how many) other tests ran before it.
+    from analytics_zoo_tpu.common.context import get_context
+    c = get_context()
+    c.set_seed(42)
+    return c
 
 
 @pytest.fixture()

@@ -1,0 +1,88 @@
+"""Multi-host training worker (spawned by tests/test_multihost.py and by
+__graft_entry__.dryrun_multichip's multihost phase).
+
+Each process: 4 virtual CPU devices, jax.distributed.initialize via
+ZooConf.coordinator_address, global 8-device mesh, trains on ITS partition of
+the dataset, prints one JSON line with per-epoch losses / eval / predictions.
+
+Run: python tests/multihost_worker.py <coordinator> <num_procs> <pid> \
+         [devices_per_proc=4]
+"""
+
+import json
+import os
+import sys
+
+def _argv_int(i: int, default: int) -> int:
+    """Defensive: this module is also IMPORTED (for make_data) by pytest,
+    whose own argv must not be parsed as the worker's."""
+    try:
+        return int(sys.argv[i])
+    except (IndexError, ValueError):
+        return default
+
+
+_DEV_COUNT = _argv_int(4, 4)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_DEV_COUNT}")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def make_data(n=None, d=6):
+    n = n or int(os.environ.get("ZOO_TEST_N", "256"))
+    g = np.random.default_rng(5)
+    x = g.normal(size=(n, d)).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+    return x, y
+
+
+def main():
+    coord, nprocs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from analytics_zoo_tpu.common.context import ZooConf, init_context
+    conf = ZooConf(seed=42, coordinator_address=coord,
+                   num_processes=nprocs, process_id=pid)
+    ctx = init_context(conf)  # dtype policy defaults to pure f32 (comparable)
+    assert len(jax.devices()) == _DEV_COUNT * nprocs, jax.devices()
+    assert ctx.process_count == nprocs
+
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    x, y = make_data()
+    full = ArrayFeatureSet(x, y)
+    part = full.partition(pid, nprocs) if nprocs > 1 else full
+
+    model = Sequential()
+    model.add(Dense(16, activation="tanh", input_shape=(x.shape[1],)))
+    model.add(Dense(1, activation="sigmoid"))
+    est = Estimator(model, optimizer="sgd", loss="binary_crossentropy",
+                    metrics=["accuracy"], ctx=ctx)
+    hist = est.fit(part, batch_size=32, epochs=3, shuffle=False,
+                   verbose=False)
+    ev = est.evaluate(part, batch_size=32)
+    pred = est.predict(part, batch_size=32)
+    print(json.dumps({
+        "pid": pid,
+        "losses": [round(v, 6) for v in hist.history["loss"]],
+        "accuracy": round(ev["accuracy"], 6),
+        "pred_sum": round(float(np.sum(pred)), 5),
+        "pred_rows": int(pred.shape[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

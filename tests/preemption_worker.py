@@ -1,0 +1,68 @@
+"""Preemption test worker (spawned by tests/test_preemption.py).
+
+Trains with checkpointing on; a step listener slows the loop down so the
+parent's SIGTERM lands mid-epoch.  On SIGTERM the Estimator snapshots and
+exits 128+15; a rerun with --resume must continue from the snapshot's
+global_step rather than 0.
+
+Run: python tests/preemption_worker.py <ckpt_dir> [--resume] [--slow]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    resume = "--resume" in sys.argv
+    slow = "--slow" in sys.argv
+
+    from analytics_zoo_tpu.common.context import init_context
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    ctx = init_context(seed=11)
+    g = np.random.default_rng(2)
+    x = g.normal(size=(512, 6)).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+
+    model = Sequential()
+    model.add(Dense(8, activation="tanh", input_shape=(6,)))
+    model.add(Dense(1, activation="sigmoid"))
+    est = Estimator(model, optimizer="sgd", loss="binary_crossentropy",
+                    ctx=ctx)
+    est.set_checkpoint(ckpt_dir, trigger=SeveralIteration(4))
+
+    start_step = None
+
+    def observe(step, loss):
+        nonlocal start_step
+        if start_step is None:
+            start_step = step
+        if slow:
+            time.sleep(0.05)   # give the parent's SIGTERM a window
+
+    est._listeners.append(observe)
+    print(json.dumps({"phase": "start", "resume": resume}), flush=True)
+    est.fit(x, y, batch_size=32, epochs=40, verbose=False, resume=resume)
+    print(json.dumps({"phase": "done", "first_step_seen": start_step,
+                      "final_step": est.global_step}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

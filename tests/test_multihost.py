@@ -1,0 +1,134 @@
+"""Multi-host (multi-process) training test (VERDICT r4 #2).
+
+Spawns 2 worker processes, each with 4 virtual CPU devices, bootstrapped via
+``jax.distributed.initialize`` through ``ZooConf.coordinator_address``.  The
+global mesh is 8 devices; each process feeds only its partition; the global
+batch is assembled with ``jax.make_array_from_process_local_data``.  Training
+losses must match a single-process 8-device run on the same data exactly
+(pure f32, shuffle off) — the reference's claim to fame is this kind of
+scale-out equivalence (wp-bigdl.md:160-164).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(coord, nprocs, pid, n_rows=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    if n_rows is not None:
+        env["ZOO_TEST_N"] = str(n_rows)
+    return subprocess.Popen(
+        [sys.executable, WORKER, coord, str(nprocs), str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+
+
+def _run_workers(nprocs, n_rows=None):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [_spawn(coord, nprocs, pid, n_rows) for pid in range(nprocs)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def _single_process_reference():
+    """In-process 8-device run over data REORDERED to the multi-host global
+    batch layout: global batch k = [proc0 rows 16k:16k+16, proc1 rows
+    16k:16k+16] — same global arrays, same mesh size, so the losses must
+    match the 2-process run exactly."""
+    import sys
+    sys.path.insert(0, os.path.dirname(WORKER))
+    from multihost_worker import make_data
+    from analytics_zoo_tpu.common.context import get_context
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    x, y = make_data()
+    n, half, fb = len(x), len(x) // 2, 16
+    order = np.concatenate([
+        np.concatenate([np.arange(k * fb, (k + 1) * fb),
+                        half + np.arange(k * fb, (k + 1) * fb)])
+        for k in range(half // fb)])
+    x, y = x[order], y[order]
+
+    # reuse (and reseed) the session context — init_context here would
+    # REPLACE the process-global ctx and leave other tests' fixtures stale
+    ctx = get_context()
+    ctx.set_seed(42)
+    model = Sequential()
+    model.add(Dense(16, activation="tanh", input_shape=(x.shape[1],)))
+    model.add(Dense(1, activation="sigmoid"))
+    est = Estimator(model, optimizer="sgd", loss="binary_crossentropy",
+                    metrics=["accuracy"], ctx=ctx)
+    hist = est.fit(x, y, batch_size=32, epochs=3, shuffle=False,
+                   verbose=False)
+    ev = est.evaluate(x, y, batch_size=32)
+    pred = est.predict(x, batch_size=32)
+    return {"losses": [round(v, 6) for v in hist.history["loss"]],
+            "accuracy": round(ev["accuracy"], 6),
+            "pred_sum": round(float(np.sum(pred)), 5),
+            "pred_rows": int(pred.shape[0])}
+
+
+@pytest.fixture(scope="module")
+def runs():
+    multi = _run_workers(2)
+    single = _single_process_reference()
+    return multi, single
+
+
+def test_two_process_training_matches_single_process(runs):
+    multi, ref = runs
+    for w in multi:
+        np.testing.assert_allclose(w["losses"], ref["losses"],
+                                   rtol=1e-5, atol=1e-6)
+    assert len(ref["losses"]) == 3
+
+
+def test_uneven_partitions_do_not_deadlock():
+    """n=257 -> partitions of 128/129 rows -> differing local batch counts;
+    Estimator._sync_batch_count must pad the short process with weight-0
+    batches so the collective step counts match (otherwise the 9th psum on
+    one process blocks forever)."""
+    outs = _run_workers(2, n_rows=257)
+    assert outs[0]["losses"] == outs[1]["losses"]
+    assert outs[0]["pred_rows"] + outs[1]["pred_rows"] == 257
+
+
+def test_two_process_eval_and_predict_consistent(runs):
+    multi, ref = runs
+    # evaluate() feeds each process's partition -> global metrics, identical
+    # on every process and equal to the single-process run
+    for w in multi:
+        assert abs(w["accuracy"] - ref["accuracy"]) < 1e-5
+    # predict() returns each process's local rows; union == full dataset
+    assert multi[0]["pred_rows"] + multi[1]["pred_rows"] == ref["pred_rows"]
+    total = multi[0]["pred_sum"] + multi[1]["pred_sum"]
+    np.testing.assert_allclose(total, ref["pred_sum"], rtol=1e-4)
