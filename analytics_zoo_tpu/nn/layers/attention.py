@@ -22,7 +22,7 @@ import numpy as np
 from analytics_zoo_tpu.common import dtypes
 from analytics_zoo_tpu.nn import activations
 from analytics_zoo_tpu.nn.module import Layer, initializer, split_rng, to_shape
-from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.ops.attention import attention_bthd
 
 
 class LayerNorm(Layer):
@@ -83,16 +83,16 @@ class MultiHeadAttention(Layer):
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
-            return jnp.transpose(t.reshape(B, T, nh, hd), (0, 2, 1, 3))
+            return t.reshape(B, T, nh, hd)   # stay in (B, T, h, d) layout
 
         attn_rng = resid_rng = None
         if training and rng is not None:
             attn_rng, resid_rng = jax.random.split(rng)
-        y = dot_product_attention(heads(q), heads(k), heads(v), mask=mask,
-                                  causal=self.causal,
-                                  dropout_rate=self.attn_drop if training else 0.0,
-                                  dropout_rng=attn_rng)
-        y = jnp.transpose(y, (0, 2, 1, 3)).reshape(B, T, H)
+        y = attention_bthd(heads(q), heads(k), heads(v), mask=mask,
+                           causal=self.causal,
+                           dropout_rate=self.attn_drop if training else 0.0,
+                           dropout_rng=attn_rng)
+        y = y.reshape(B, T, H)
         y = _linear(params["out"], y)
         if training and resid_rng is not None and self.resid_drop > 0:
             keep = 1.0 - self.resid_drop

@@ -24,12 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _attention_xla(q, k, v, mask=None, causal=False, scale=None,
-                   dropout_rate=0.0, dropout_rng=None):
-    """q,k,v: (B, H, T, D).  mask: broadcastable to (B, H, Tq, Tk), 1=keep."""
+def _attention_core(q, k, v, eq_qk, eq_av, mask=None, causal=False,
+                    scale=None, dropout_rate=0.0, dropout_rng=None):
+    """Shared einsum-softmax body; the two public layouts differ only in the
+    contraction subscripts (logits are always (B, H, Tq, Tk))."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    logits = jnp.einsum(eq_qk, q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         Tq, Tk = logits.shape[-2], logits.shape[-1]
@@ -43,8 +44,79 @@ def _attention_xla(q, k, v, mask=None, causal=False, scale=None,
         probs = jnp.where(
             jax.random.bernoulli(dropout_rng, keep, probs.shape),
             probs / keep, 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+    return jnp.einsum(eq_av, probs.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+def _attention_xla(q, k, v, mask=None, causal=False, scale=None,
+                   dropout_rate=0.0, dropout_rng=None):
+    """q,k,v: (B, H, T, D).  mask: broadcastable to (B, H, Tq, Tk), 1=keep."""
+    return _attention_core(q, k, v, "bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd",
+                           mask=mask, causal=causal, scale=scale,
+                           dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+
+def _attention_xla_bthd(q, k, v, mask=None, causal=False, scale=None,
+                        dropout_rate=0.0, dropout_rng=None):
+    """Same math in (B, T, H, D) layout - no head transpose is materialized
+    (the (0,2,1,3) transposes showed up as ~7% of the BERT train step in the
+    xprof trace; einsum lets XLA contract directly from projection layout)."""
+    return _attention_core(q, k, v, "bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd",
+                           mask=mask, causal=causal, scale=scale,
+                           dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+
+def _flash_worthwhile(t: int) -> bool:
+    """Flash crossover: measured on v5e (BERT-Large train, 2026-07-30), the
+    Pallas kernel ran ~0.8ms/layer at T=512 where the XLA einsum path is
+    several times faster — the O(T^2) probs tensor only starts to hurt XLA
+    past ~1k tokens.  Flash engages above that."""
+    return t > 1024
+
+
+def _select_flash(use_flash, t_len, head_dim, mask, dropping, warn=False):
+    """Shared flash-eligibility policy for both layout front-ends."""
+    if use_flash is None:
+        auto = (jax.default_backend() == "tpu" and _flash_worthwhile(t_len)
+                and mask is None and head_dim <= 256 and not dropping)
+        if (warn and dropping and jax.default_backend() == "tpu"
+                and _flash_worthwhile(t_len)):
+            warnings.warn(
+                "attention dropout forces the O(T^2) XLA attention path; the "
+                "flash kernel does not implement it — consider attn_drop=0 "
+                "for long sequences", stacklevel=3)
+        return auto
+    if use_flash and (dropping or mask is not None):
+        # The flash kernel implements neither prob-dropout nor explicit
+        # masks; honouring use_flash=True would silently compute wrongly.
+        return False
+    return use_flash
+
+
+def attention_bthd(q, k, v, mask=None, causal: bool = False,
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   dropout_rate: float = 0.0, dropout_rng=None):
+    """(B, T, heads, D) front-end used by MultiHeadAttention: the XLA path
+    contracts directly in projection layout (no materialized head transpose);
+    the flash kernel needs (B, heads, T, D), so the transposes are paid only
+    when it is actually selected."""
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
+    use_flash = _select_flash(use_flash, q.shape[1], q.shape[-1], mask,
+                              dropping, warn=True)
+    if use_flash:
+        try:
+            from analytics_zoo_tpu.ops.flash_attention import flash_attention
+
+            def t(a):
+                return jnp.transpose(a, (0, 2, 1, 3))
+            return t(flash_attention(t(q), t(k), t(v), causal=causal,
+                                     scale=scale))
+        except Exception:
+            pass
+    return _attention_xla_bthd(q, k, v, mask=mask, causal=causal, scale=scale,
+                               dropout_rate=dropout_rate,
+                               dropout_rng=dropout_rng)
 
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False,
@@ -56,19 +128,8 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False,
     0 with an rng) always routes to the XLA path — the flash kernel does not
     implement it."""
     dropping = dropout_rate > 0.0 and dropout_rng is not None
-    if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu" and q.shape[-2] >= 512
-                     and mask is None and q.shape[-1] <= 256
-                     and not dropping)
-        if dropping and jax.default_backend() == "tpu" and q.shape[-2] >= 512:
-            warnings.warn(
-                "attention dropout forces the O(T^2) XLA attention path; the "
-                "flash kernel does not implement it — consider attn_drop=0 "
-                "for long sequences", stacklevel=2)
-    elif use_flash and (dropping or mask is not None):
-        # The flash kernel implements neither prob-dropout nor explicit masks;
-        # honouring use_flash=True here would silently compute the wrong thing.
-        use_flash = False
+    use_flash = _select_flash(use_flash, q.shape[-2], q.shape[-1], mask,
+                              dropping, warn=True)
     if use_flash:
         try:
             from analytics_zoo_tpu.ops.flash_attention import flash_attention

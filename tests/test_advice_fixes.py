@@ -112,3 +112,22 @@ def test_keras2_conv_groups_and_depthwise(rng):
         params = layer.build(jax.random.PRNGKey(0), (8, 8, 4))
         y, _ = layer.apply(params, {}, x, training=False)
         assert y.shape[0] == 2
+
+
+def test_attention_bthd_matches_bhtd(rng):
+    """The transpose-free (B,T,h,d) attention path must equal the canonical
+    (B,h,T,d) einsum path (it feeds MultiHeadAttention now)."""
+    from analytics_zoo_tpu.ops.attention import (_attention_xla,
+                                                 _attention_xla_bthd)
+
+    B, T, nh, hd = 2, 10, 4, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, nh, hd)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(rng.integers(0, 2, (B, 1, 1, T)), jnp.float32)
+    for kw in ({}, {"causal": True}, {"mask": mask}):
+        got = _attention_xla_bthd(q, k, v, **kw)
+        ref = jnp.transpose(
+            _attention_xla(*(jnp.transpose(t, (0, 2, 1, 3))
+                             for t in (q, k, v)), **kw), (0, 2, 1, 3))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)

@@ -1,0 +1,104 @@
+"""Per-line xplane analysis: serial self-time on the 'XLA Ops' line, grouped
+by op kind (conv fwd / dgrad / wgrad / BN-stat reduce / elementwise / pool /
+copy), per step.  Companion to xprof_summary.py — answers 'where does the
+45ms step actually go on the core?'.
+
+Run: python tools/xprof_lines.py --dir /tmp/xprof_xxx [--steps 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+
+
+def classify(name: str) -> str:
+    n = name.lower()
+    if "convolution" in n or "conv" in n and "fusion" in n:
+        pass
+    if n.startswith("%copy") or ".copy" in n:
+        return "copy"
+    if "select-and-scatter" in n:
+        return "maxpool_bwd"
+    if "reduce-window" in n:
+        return "pool"
+    if "multiply_reduce_fusion" in n or "reduce_fusion" in n:
+        return "reduce_fusion(BN stats/bwd)"
+    if "convolution" in n:
+        return "conv"
+    if "fusion" in n:
+        return "fusion(elementwise/other)"
+    if "slice" in n:
+        return "slice"
+    if "all-reduce" in n or "all-gather" in n:
+        return "collective"
+    return "other"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--top", type=int, default=20)
+    args = ap.parse_args()
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(args.dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    for plane in space.planes:
+        if "TPU" not in plane.name:
+            continue
+        names = {mid: m.name for mid, m in plane.event_metadata.items()}
+        cat_sid = next((sid for sid, sm in plane.stat_metadata.items()
+                        if sm.name == "hlo_category"), None)
+        stat_names = {sid: sm.name for sid, sm in plane.stat_metadata.items()}
+
+        def hlo_cat(meta_id):
+            meta = plane.event_metadata.get(meta_id)
+            if meta is not None:
+                for st in meta.stats:
+                    if st.metadata_id == cat_sid:
+                        return st.str_value
+            return "?"
+
+        # long_name stat sometimes carries the full HLO; keep short name
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            per_kind = collections.Counter()
+            per_cat = collections.Counter()
+            per_op = collections.Counter()
+            total = 0
+            for ev in line.events:
+                nm = names.get(ev.metadata_id, "?")
+                k = classify(nm)
+                per_kind[k] += ev.duration_ps
+                per_cat[hlo_cat(ev.metadata_id)] += ev.duration_ps
+                per_op[nm.split(" = ")[0]] += ev.duration_ps
+                total += ev.duration_ps
+            print(json.dumps({
+                "plane": plane.name,
+                "line": line.name,
+                "total_ms_per_step": round(total / 1e9 / args.steps, 3),
+                "by_kind_ms_per_step": {
+                    k: round(v / 1e9 / args.steps, 3)
+                    for k, v in per_kind.most_common()},
+                "by_hlo_category_ms_per_step": {
+                    k: round(v / 1e9 / args.steps, 3)
+                    for k, v in per_cat.most_common()},
+                "top_ops_ms_per_step": {
+                    k: round(v / 1e9 / args.steps, 3)
+                    for k, v in per_op.most_common(args.top)},
+            }, indent=1))
+
+
+if __name__ == "__main__":
+    main()

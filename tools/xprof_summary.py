@@ -1,0 +1,151 @@
+"""Capture + summarize a device-side profile of the bench ResNet-50 step.
+
+`jax.profiler.trace` writes xplane protobufs; this tool parses them directly
+(tensorflow.tsl xplane_pb2 — no TensorBoard UI needed in this environment) and
+prints, for the TPU device plane, total busy time and the top-N ops by
+self-time, each tagged with its HLO category.  This is the "profile" half of
+the scaling-book profile→iterate loop for the MFU work (VERDICT r4 #1).
+
+Run: python tools/xprof_summary.py [--batch 128] [--steps 20] [--top 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(batch: int, steps: int, outdir: str, stem: str = "s2d"):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.common import dtypes
+    from analytics_zoo_tpu.models.imageclassification import resnet
+    from analytics_zoo_tpu.nn import objectives
+    from analytics_zoo_tpu.nn.optimizers import SGD
+
+    dtypes.mixed_bf16()
+    model = resnet(50, num_classes=1000, stem=stem)
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt = SGD(lr=0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    loss_fn = objectives.get("sparse_categorical_crossentropy")
+
+    key = jax.random.PRNGKey(1)
+    imgs = jax.random.normal(key, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(key, (batch, 1), 0, 1000).astype(jnp.float32)
+
+    @jax.jit
+    def step(p, o, s):
+        def loss_of(pp):
+            y_pred, s2 = model.apply(pp, s, imgs, training=True, rng=None)
+            return loss_fn(y_pred, labels).mean(), s2
+        (_, s2), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+        updates, o = opt.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, s2
+
+    # warm up (compile outside the trace)
+    p, o, s = step(params, opt_state, state)
+    jax.block_until_ready(p)
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            p, o, s = step(p, o, s)
+        jax.block_until_ready(p)
+
+
+def summarize(outdir: str, top: int):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {outdir}")
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    device_planes = [pl for pl in space.planes
+                     if "TPU" in pl.name or "/device:" in pl.name]
+    if not device_planes:
+        raise SystemExit(
+            "no device plane; planes = " + str([p.name for p in space.planes]))
+
+    out = {}
+    for plane in device_planes:
+        names = {m.id: m.name for m, in
+                 ((meta,) for meta in plane.event_metadata.values())}
+        cat_stat = None
+        for sid, smeta in plane.stat_metadata.items():
+            if smeta.name == "hlo_category":
+                cat_stat = sid
+        per_op = collections.Counter()
+        per_cat = collections.Counter()
+        span_lo, span_hi = float("inf"), 0.0
+        busy_ps = 0.0
+        for line in plane.lines:
+            lname = line.name.lower()
+            # only true execution lines — skip launch/annotation lines
+            if not ("xla op" in lname or "ops" == lname.strip()
+                    or "tensorcore" in lname or "step" in lname):
+                continue
+            for ev in line.events:
+                nm = names.get(ev.metadata_id, "?")
+                dur = ev.duration_ps
+                per_op[nm] += dur
+                busy_ps += dur
+                t0 = line.timestamp_ns * 1000 + ev.offset_ps
+                span_lo = min(span_lo, t0)
+                span_hi = max(span_hi, t0 + dur)
+                meta = plane.event_metadata.get(ev.metadata_id)
+                cat = None
+                if meta is not None:
+                    for st in meta.stats:
+                        if st.metadata_id == cat_stat:
+                            cat = st.str_value or None
+                if cat is None:
+                    base = nm.split(".")[0].split("-")[0]
+                    cat = base
+                per_cat[cat] += dur
+        if not per_op:
+            continue
+        wall_ms = (span_hi - span_lo) / 1e9
+        out[plane.name] = {
+            "busy_ms": round(busy_ps / 1e9, 3),
+            "span_ms": round(wall_ms, 3),
+            "lines": [ln.name for ln in plane.lines],
+            "by_category_ms": {k: round(v / 1e9, 3)
+                               for k, v in per_cat.most_common()},
+            "top_ops_ms": {k: round(v / 1e9, 3)
+                           for k, v in per_op.most_common(top)},
+        }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--stem", default="s2d")
+    ap.add_argument("--dir", default=None,
+                    help="summarize an existing trace dir instead of capturing")
+    args = ap.parse_args()
+
+    outdir = args.dir or tempfile.mkdtemp(prefix="xprof_")
+    if args.dir is None:
+        capture(args.batch, args.steps, outdir, stem=args.stem)
+    res = summarize(outdir, args.top)
+    print(json.dumps({"batch": args.batch, "steps": args.steps,
+                      "trace_dir": outdir, "planes": res}, indent=1))
+
+
+if __name__ == "__main__":
+    main()
