@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.nnframes.nn_estimator import (
+    NNClassifier, NNClassifierModel, NNEstimator, NNImageReader, NNModel,
+    Pipeline, PipelineModel, SQLTransformer)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader", "Pipeline", "PipelineModel", "SQLTransformer"]

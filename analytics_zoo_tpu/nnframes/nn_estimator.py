@@ -31,13 +31,22 @@ def _column_to_array(df: pd.DataFrame, col: str) -> np.ndarray:
 
 
 class NNEstimator:
+    """DataFrame estimator with the reference's preprocessing-param surface
+    (NNEstimator.scala:382-412): `feature_preprocessing` /
+    `label_preprocessing` accept a `feature.common.Preprocessing` chain
+    (built with `>>`) or any callable; `sample_preprocessing` operates on the
+    whole (features, label) pair and OVERRIDES the two-sided params when set
+    (setSamplePreprocessing semantics)."""
+
     def __init__(self, model: Layer, loss,
                  feature_preprocessing: Optional[Callable] = None,
-                 label_preprocessing: Optional[Callable] = None):
+                 label_preprocessing: Optional[Callable] = None,
+                 sample_preprocessing: Optional[Callable] = None):
         self.model = model
         self.loss = loss
         self.feature_preprocessing = feature_preprocessing
         self.label_preprocessing = label_preprocessing
+        self.sample_preprocessing = sample_preprocessing
         self.features_col: Union[str, List[str]] = "features"
         self.label_col = "label"
         self.batch_size = 32
@@ -51,6 +60,25 @@ class NNEstimator:
     # -- Spark-ML-style param setters ----------------------------------------
     def set_features_col(self, col):
         self.features_col = col
+        return self
+
+    def set_feature_preprocessing(self, pre: Callable):
+        """Preprocessing chain (feature/common.py) or callable applied to each
+        feature array (setFeaturePreprocessing parity)."""
+        self.feature_preprocessing = pre
+        return self
+
+    def set_label_preprocessing(self, pre: Callable):
+        self.label_preprocessing = pre
+        return self
+
+    def set_sample_preprocessing(self, pre: Callable):
+        """Whole-sample (features, label) -> (features, label) transform;
+        overrides feature/label preprocessing (setSamplePreprocessing
+        parity, NNEstimator.scala:382-412).  The callable must tolerate
+        label=None: NNModel.transform invokes it at predict time with
+        (features, None) and uses only the returned features."""
+        self.sample_preprocessing = pre
         return self
 
     def set_label_col(self, col):
@@ -90,14 +118,19 @@ class NNEstimator:
         cols = (self.features_col if isinstance(self.features_col, list)
                 else [self.features_col])
         xs = [_column_to_array(df, c) for c in cols]
-        if self.feature_preprocessing is not None:
-            xs = [self.feature_preprocessing(x) for x in xs]
-        x = xs if len(xs) > 1 else xs[0]
         y = None
         if with_label and self.label_col in df.columns:
             y = _column_to_array(df, self.label_col)
-            if self.label_preprocessing is not None:
-                y = self.label_preprocessing(y)
+        if self.sample_preprocessing is not None:
+            # whole-sample transform wins (setSamplePreprocessing semantics)
+            x = xs if len(xs) > 1 else xs[0]
+            x, y = self.sample_preprocessing((x, y))
+            return x, y
+        if self.feature_preprocessing is not None:
+            xs = [np.asarray(self.feature_preprocessing(x)) for x in xs]
+        x = xs if len(xs) > 1 else xs[0]
+        if y is not None and self.label_preprocessing is not None:
+            y = np.asarray(self.label_preprocessing(y))
         return x, y
 
     # -- fit -------------------------------------------------------------------
@@ -120,6 +153,7 @@ class NNEstimator:
         m = NNModel(self.model, est)
         m.features_col = self.features_col
         m.feature_preprocessing = self.feature_preprocessing
+        m.sample_preprocessing = self.sample_preprocessing
         m.batch_size = self.batch_size
         return m
 
@@ -132,6 +166,7 @@ class NNModel:
         self.est = est or Estimator(model)
         self.features_col: Union[str, List[str]] = "features"
         self.feature_preprocessing: Optional[Callable] = None
+        self.sample_preprocessing: Optional[Callable] = None
         self.batch_size = 32
         self.prediction_col = "prediction"
 
@@ -143,9 +178,13 @@ class NNModel:
         cols = (self.features_col if isinstance(self.features_col, list)
                 else [self.features_col])
         xs = [_column_to_array(df, c) for c in cols]
-        if self.feature_preprocessing is not None:
-            xs = [self.feature_preprocessing(x) for x in xs]
-        x = xs if len(xs) > 1 else xs[0]
+        if self.sample_preprocessing is not None:
+            x = xs if len(xs) > 1 else xs[0]
+            x, _ = self.sample_preprocessing((x, None))
+        else:
+            if self.feature_preprocessing is not None:
+                xs = [np.asarray(self.feature_preprocessing(x)) for x in xs]
+            x = xs if len(xs) > 1 else xs[0]
         pred = self.est.predict(x, batch_size=self.batch_size)
         out = df.copy()
         out[self.prediction_col] = [self._format(p) for p in np.asarray(pred)]
@@ -173,6 +212,61 @@ class NNClassifierModel(NNModel):
         if p.ndim == 0 or p.size == 1:
             return float(np.ravel(p)[0] > 0.5)
         return float(int(np.argmax(p)))
+
+
+class Pipeline:
+    """Spark-ML Pipeline analog for DataFrame stages (the composability the
+    reference gets for free from org.apache.spark.ml.Pipeline — NNEstimator
+    is designed to slot into one, NNEstimator.scala:198-254).
+
+    A stage is either a *transformer* (has .transform(df)) or an *estimator*
+    (has .fit(df) returning a transformer).  fit() walks the stages in order,
+    fitting estimators on the progressively-transformed frame; the result is
+    a PipelineModel of the fitted transformers."""
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def fit(self, df: pd.DataFrame) -> "PipelineModel":
+        fitted = []
+        cur = df
+        for stage in self.stages:
+            if hasattr(stage, "fit"):
+                model = stage.fit(cur)
+                fitted.append(model)
+                cur = model.transform(cur)
+            elif hasattr(stage, "transform"):
+                fitted.append(stage)
+                cur = stage.transform(cur)
+            else:
+                raise TypeError(f"pipeline stage {stage!r} has neither "
+                                "fit() nor transform()")
+        return PipelineModel(fitted)
+
+
+class PipelineModel:
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df
+
+
+class SQLTransformer:
+    """Column-expression transformer for pipelines (the pandas stand-in for
+    Spark's SQLTransformer): each output column is computed by a callable on
+    the frame."""
+
+    def __init__(self, **columns: Callable[[pd.DataFrame], "pd.Series"]):
+        self.columns = columns
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        out = df.copy()
+        for name, fn in self.columns.items():
+            out[name] = fn(out)
+        return out
 
 
 class NNImageReader:

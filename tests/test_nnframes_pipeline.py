@@ -1,0 +1,90 @@
+"""NNFrames round-4 depth: preprocessing params, samplePreprocessing override,
+and Spark-ML-style Pipeline composition (NNEstimator.scala:382-412,
+Pipeline semantics) — VERDICT r4 #5/#6.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.feature.common import FnPreprocessing
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.nnframes import (NNClassifier, NNEstimator, Pipeline,
+                                        PipelineModel, SQLTransformer)
+
+
+def _df(n=200, d=4, seed=0):
+    g = np.random.default_rng(seed)
+    x = g.normal(size=(n, d)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.float32)
+    return pd.DataFrame({"features": [row for row in x], "label": y})
+
+
+def _model(d=4):
+    m = Sequential()
+    m.add(Dense(8, activation="tanh", input_shape=(d,)))
+    m.add(Dense(1, activation="sigmoid"))
+    return m
+
+
+def test_feature_preprocessing_chain(ctx):
+    df = _df()
+    # chain: scale then shift — built with >> exactly like the reference's ->
+    pre = (FnPreprocessing(lambda a: a * 2.0)
+           >> FnPreprocessing(lambda a: a - 0.5))
+    est = (NNEstimator(_model(), "binary_crossentropy")
+           .set_feature_preprocessing(pre)
+           .set_label_preprocessing(FnPreprocessing(
+               lambda y: np.asarray(y, np.float32)))
+           .set_batch_size(32).set_max_epoch(2))
+    model = est.fit(df)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    assert len(out) == len(df)
+
+
+def test_sample_preprocessing_overrides(ctx):
+    df = _df()
+    calls = []
+
+    def sp(sample):
+        x, y = sample
+        calls.append(np.shape(x))
+        return np.asarray(x, np.float32) * 0.5, y
+
+    est = (NNEstimator(_model(), "mse",
+                       feature_preprocessing=FnPreprocessing(
+                           lambda a: 1 / 0))  # must NOT run: sample_pre wins
+           .set_sample_preprocessing(sp)
+           .set_batch_size(32).set_max_epoch(1))
+    model = est.fit(df)
+    assert calls, "sample_preprocessing was not applied"
+    out = model.transform(df)      # transform path must also use it
+    assert len(calls) >= 2
+    assert len(out) == len(df)
+
+
+def test_pipeline_transformer_then_estimator(ctx):
+    g = np.random.default_rng(1)
+    df = pd.DataFrame({"a": g.normal(size=300).astype(np.float32),
+                       "b": g.normal(size=300).astype(np.float32)})
+    df["label"] = (df["a"] + df["b"] > 0).astype(np.float32)
+
+    assembler = SQLTransformer(
+        features=lambda d: [list(v) for v in zip(d["a"], d["b"])])
+    clf = (NNClassifier(_model(d=2), "binary_crossentropy")
+           .set_batch_size(16).set_max_epoch(25))
+    pipe = Pipeline([assembler, clf])
+    fitted = pipe.fit(df)
+    assert isinstance(fitted, PipelineModel)
+
+    scored = fitted.transform(df)
+    acc = (scored["prediction"].to_numpy()
+           == df["label"].to_numpy()).mean()
+    assert acc > 0.85, acc
+
+
+def test_pipeline_rejects_bad_stage():
+    with pytest.raises(TypeError):
+        Pipeline([object()]).fit(pd.DataFrame({"x": [1]}))
