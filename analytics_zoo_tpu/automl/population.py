@@ -1,0 +1,103 @@
+"""vmap population training — the TPU-native answer to parallel AutoML trials
+for SMALL models (SURVEY §7 step 9; reference scale-out analog:
+RayTuneSearchEngine.py:133-150 running trials on cluster workers).
+
+A Ray cluster parallelizes trials across machines; on a TPU chip the same
+small-model trials leave the chip idle.  Here K hyperparameter variants of
+ONE architecture (different lr / init / dropout keys) train SIMULTANEOUSLY
+inside a single jitted program: parameters carry a leading population axis
+via `jax.vmap`, so the MXU sees K-wide batched matmuls instead of K
+sequential tiny ones.  Candidates must share shapes (architecture fixed);
+lr is a per-member traced scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PopulationTrainer:
+    """Train K same-architecture members at once with per-member Adam(lr).
+
+    model: a built (uncompiled) Layer/Sequential; its init/apply are vmapped
+    over a leading population axis.  Members differ in init rng and lr.
+    """
+
+    def __init__(self, model, loss_fn: Optional[Callable] = None):
+        from analytics_zoo_tpu.nn import objectives
+        self.model = model
+        self.loss_fn = objectives.get(loss_fn or "mse")
+
+    def fit(self, x, y, lrs: Sequence[float], *, epochs: int = 5,
+            batch_size: int = 32, seed: int = 0) -> Dict:
+        model, loss_fn = self.model, self.loss_fn
+        K = len(lrs)
+        lr_vec = jnp.asarray(lrs, jnp.float32)
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        in_shape = tuple(x.shape[1:])
+
+        member_rngs = jax.random.split(jax.random.PRNGKey(seed), K)
+        params = jax.vmap(lambda r: model.init(r, in_shape)[0])(member_rngs)
+        m_state = jax.tree.map(jnp.zeros_like, params)
+        v_state = jax.tree.map(jnp.zeros_like, params)
+        state0 = model.init_state(in_shape)
+
+        n = x.shape[0]
+        steps = max(n // batch_size, 1)
+
+        def member_train_step(carry, batch):
+            p, m, v, t, lr = carry
+            bx, by, dkey = batch
+
+            def loss_of(pp):
+                pred, _ = model.apply(pp, state0, bx, training=True, rng=dkey)
+                return loss_fn(pred, by).mean()
+
+            l, g = jax.value_and_grad(loss_of)(p)
+            m = jax.tree.map(lambda mm, gg: 0.9 * mm + 0.1 * gg, m, g)
+            v = jax.tree.map(lambda vv, gg: 0.999 * vv + 0.001 * gg * gg,
+                             v, g)
+            p = jax.tree.map(
+                lambda pp, mm, vv: pp - lr * (mm / (1 - 0.9 ** t))
+                / (jnp.sqrt(vv / (1 - 0.999 ** t)) + 1e-8), p, m, v)
+            return (p, m, v, t + 1.0, lr), l
+
+        def member_epoch(p, m, v, lr, t0, xb, yb, dkeys):
+            (p, m, v, t, _), ls = jax.lax.scan(
+                member_train_step, (p, m, v, t0, lr), (xb, yb, dkeys))
+            return p, m, v, ls.mean()
+
+        @jax.jit
+        def run_epoch(params, m_state, v_state, t0, epoch_key):
+            perm = jax.random.permutation(epoch_key, n)[:steps * batch_size]
+            xb = x[perm].reshape(steps, batch_size, *x.shape[1:])
+            yb = y[perm].reshape(steps, batch_size, *y.shape[1:])
+            dkeys = jax.random.split(
+                epoch_key, K * steps).reshape(K, steps, -1)
+            return jax.vmap(
+                member_epoch,
+                in_axes=(0, 0, 0, 0, None, None, None, 0))(
+                params, m_state, v_state, lr_vec, t0, xb, yb, dkeys)
+
+        t0 = jnp.ones((), jnp.float32)
+        history = []
+        key = jax.random.PRNGKey(seed + 1)
+        for _ in range(epochs):
+            key, ek = jax.random.split(key)
+            params, m_state, v_state, mean_loss = run_epoch(
+                params, m_state, v_state, t0, ek)
+            t0 = t0 + steps
+            history.append(np.asarray(mean_loss))
+
+        final = history[-1]
+        best = int(np.argmin(final))
+        best_params = jax.tree.map(lambda a: np.asarray(a[best]), params)
+        return {"losses": np.stack(history),          # (epochs, K)
+                "final_losses": final, "best_index": best,
+                "best_lr": float(lrs[best]), "best_params": best_params,
+                "population_size": K}

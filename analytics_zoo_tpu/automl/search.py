@@ -78,10 +78,38 @@ class Choice(Sampler):
         return list(self.options)
 
 
+class GridSearch(Choice):
+    """A Choice that grid engines expand exhaustively instead of sampling
+    (recipe.py GridSearch parity)."""
+
+
+class SampleFn(Sampler):
+    """Config-dependent sampler: fn(config_so_far, rng) -> value — the
+    RandomSample(lambda spec: ...) analog, incl. dependent params like
+    MTNet's past_seq_len = (long_num + 1) * time_step
+    (recipe.py:339-341)."""
+
+    def __init__(self, fn: Callable[[Dict, np.random.Generator], Any]):
+        self.fn = fn
+
+    def sample(self, rng, config: Optional[Dict] = None):
+        return self.fn(config or {}, rng)
+
+
 def sample_config(space: Dict[str, Any], rng: np.random.Generator) -> Dict:
+    """Two passes: independent samplers first, then SampleFn entries (which
+    may read previously-sampled values)."""
     out = {}
+    deferred = []
     for k, v in space.items():
-        out[k] = v.sample(rng) if isinstance(v, Sampler) else v
+        if isinstance(v, SampleFn):
+            deferred.append(k)
+        elif isinstance(v, Sampler):
+            out[k] = v.sample(rng)
+        else:
+            out[k] = v
+    for k in deferred:
+        out[k] = space[k].sample(rng, out)
     return out
 
 
@@ -154,6 +182,46 @@ class GridSearchEngine(SearchEngine):
                 {k: v for k, v in space.items() if k not in grid_keys}, rng)
             cfg.update(dict(zip(grid_keys, combo)))
             self.trials.append(Trial(cfg, float(train_fn(cfg))))
+        return self.trials
+
+
+class GridRandomSearchEngine(SearchEngine):
+    """Grid dims (GridSearch) expanded exhaustively × num_rand_samples random
+    draws of everything else, trials executed CONCURRENTLY on a thread pool
+    (the native stand-in for RayTuneSearchEngine.py:133-150 tune.run over a
+    cluster: trials share the single accelerator but overlap host-side work —
+    unroll, batch prep, eval readback — with device compute)."""
+
+    def __init__(self, num_rand_samples: int = 1, mode: str = "min",
+                 seed: int = 0, parallelism: int = 2):
+        super().__init__(mode)
+        self.num_rand_samples = num_rand_samples
+        self.seed = seed
+        self.parallelism = max(1, int(parallelism))
+
+    def sample_all(self, space: Dict) -> List[Dict]:
+        import itertools
+        rng = np.random.default_rng(self.seed)
+        grid_keys = [k for k, v in space.items() if isinstance(v, GridSearch)]
+        grids = [space[k].grid() for k in grid_keys]
+        configs = []
+        for combo in (itertools.product(*grids) if grids else [()]):
+            for _ in range(self.num_rand_samples):
+                cfg = sample_config(
+                    {k: v for k, v in space.items() if k not in grid_keys},
+                    rng)
+                cfg.update(dict(zip(grid_keys, combo)))
+                configs.append(cfg)
+        return configs
+
+    def run(self, train_fn, space):
+        configs = self.sample_all(space)
+        if self.parallelism > 1:
+            with ThreadPoolExecutor(self.parallelism) as pool:
+                metrics = list(pool.map(train_fn, configs))
+        else:
+            metrics = [train_fn(c) for c in configs]
+        self.trials = [Trial(c, float(m)) for c, m in zip(configs, metrics)]
         return self.trials
 
 

@@ -10,11 +10,13 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
 from analytics_zoo_tpu.automl.regression import (
     Recipe, TimeSequencePipeline, TimeSequencePredictor)
+from analytics_zoo_tpu.nn.module import Layer as _Layer
 from analytics_zoo_tpu.models.common import ZooModel
 from analytics_zoo_tpu.nn.graph import Input
 from analytics_zoo_tpu.nn.layers.conv import Convolution1D
@@ -81,35 +83,133 @@ class Seq2SeqForecaster(Forecaster):
         return m
 
 
+class MTNetLayer(_Layer):
+    """Memory Time-series Network (MTNet, Chang et al. 2018) — the FULL
+    architecture behind the reference's MTNetForecaster
+    (zouwu/model/forecast.py:108-160 over zoo.automl.model.MTNet):
+
+    Input (B, (long_num + 1) * time_step, D): the first long_num*time_step
+    rows are the long-term memory blocks X_1..X_n; the last time_step rows
+    are the short-term query series Q.
+
+      * three block encoders (separate weights, shared across blocks):
+        Conv1D(filters, kernel, same) -> relu -> dropout -> GRU(uni_size)
+        last state: Enc_m (memory keys m_i), Enc_c (memory values c_i),
+        Enc_in (query embedding u from Q);
+      * memory attention: p = softmax(<m_i, u>); context o = sum_i p_i c_i;
+      * nonlinear head: y_nl = [o ; u] W + b;
+      * autoregressive highway on the target channel's last ar_size steps:
+        y = y_nl + y_ar.
+    """
+
+    def __init__(self, horizon: int, time_step: int, long_num: int,
+                 filters: int = 32, kernel: int = 3, uni_size: int = 32,
+                 ar_size: int = 4, dropout: float = 0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.horizon = int(horizon)
+        self.time_step = int(time_step)
+        self.long_num = int(long_num)
+        # ar_size=0 disables the autoregressive highway entirely
+        self.ar_size = min(max(int(ar_size), 0), self.time_step)
+        self.drop = float(dropout)
+        nm = self.name
+        self._encs = {}
+        for which in ("m", "c", "q"):
+            self._encs[which] = (
+                Convolution1D(filters, kernel, activation="relu",
+                              border_mode="same", name=f"{nm}_conv_{which}"),
+                GRU(uni_size, return_sequences=False,
+                    name=f"{nm}_gru_{which}"))
+        self.uni = int(uni_size)
+
+    def build(self, rng, input_shape):
+        import jax
+        T, D = input_shape[-2] // (self.long_num + 1), input_shape[-1]
+        rs = jax.random.split(rng, 8)
+        p = {}
+        for i, which in enumerate(("m", "c", "q")):
+            conv, gru = self._encs[which]
+            p[f"conv_{which}"] = conv.build(rs[2 * i], (T, D))
+            cout = self._encs[which][0].nb_filter
+            p[f"gru_{which}"] = gru.build(rs[2 * i + 1], (T, cout))
+        p["head"] = {
+            "W": 0.05 * jax.random.normal(rs[6], (2 * self.uni, self.horizon)),
+            "b": jnp.zeros((self.horizon,))}
+        if self.ar_size > 0:
+            p["ar"] = {
+                "W": 0.05 * jax.random.normal(
+                    rs[7], (self.ar_size, self.horizon)),
+                "b": jnp.zeros((self.horizon,))}
+        return p
+
+    def _encode(self, params, which, x, *, training, rng):
+        conv, gru = self._encs[which]
+        h = conv.call(params[f"conv_{which}"], x, training=training)
+        if training and rng is not None and self.drop > 0:
+            import jax
+            keep = 1.0 - self.drop
+            h = jnp.where(jax.random.bernoulli(rng, keep, h.shape),
+                          h / keep, 0.0)
+        return gru.call(params[f"gru_{which}"], h, training=training)
+
+    def call(self, params, x, *, training=False, rng=None):
+        import jax
+        B, total, D = x.shape
+        n, T = self.long_num, self.time_step
+        mem = x[:, :n * T].reshape(B * n, T, D)
+        q = x[:, n * T:]
+        rngs = (jax.random.split(rng, 3) if rng is not None
+                else (None, None, None))
+        m = self._encode(params, "m", mem, training=training,
+                         rng=rngs[0]).reshape(B, n, self.uni)
+        c = self._encode(params, "c", mem, training=training,
+                         rng=rngs[1]).reshape(B, n, self.uni)
+        u = self._encode(params, "q", q, training=training, rng=rngs[2])
+        att = jax.nn.softmax(jnp.einsum("bnu,bu->bn", m, u), axis=-1)
+        o = jnp.einsum("bn,bnu->bu", att, c)
+        y_nl = jnp.concatenate([o, u], axis=-1) @ params["head"]["W"] \
+            + params["head"]["b"]
+        if self.ar_size == 0:
+            return y_nl
+        ar_in = x[:, -self.ar_size:, 0]
+        y_ar = ar_in @ params["ar"]["W"] + params["ar"]["b"]
+        return y_nl + y_ar
+
+
 class MTNetForecaster(Forecaster):
-    """Memory-augmented CNN + attention + autoregressive skip path
-    (MTNet, zouwu model/forecast.py:108-160; simplified long/short memory series)."""
+    """MTNet forecaster (reference zouwu model/forecast.py:108-160).
+
+    lookback must equal (long_num + 1) * time_step; when time_step is not
+    given it is derived as lookback // (long_num + 1)."""
 
     def __init__(self, horizon: int = 1, feature_dim: int = 1,
                  lookback: int = 16, cnn_filters: int = 32,
                  cnn_kernel: int = 3, ar_window: int = 4,
-                 dropout: float = 0.1):
+                 dropout: float = 0.1, long_num: int = 3,
+                 time_step: Optional[int] = None, uni_size: int = 32):
         self.horizon = horizon
         self.feature_dim = feature_dim
+        self.long_num = int(long_num)
+        self.time_step = (int(time_step) if time_step
+                          else lookback // (self.long_num + 1))
+        if (self.long_num + 1) * self.time_step != lookback:
+            raise ValueError(
+                f"lookback={lookback} must equal (long_num+1)*time_step "
+                f"= {(self.long_num + 1) * self.time_step}")
         self.lookback = lookback
         self.filters = cnn_filters
         self.kernel = cnn_kernel
-        self.ar_window = min(ar_window, lookback)
+        self.ar_window = ar_window
         self.dropout = dropout
+        self.uni_size = uni_size
         super().__init__()
 
     def build_model(self) -> Model:
-        import jax.numpy as jnp
         inp = Input(shape=(self.lookback, self.feature_dim), name="mt_input")
-        conv = Convolution1D(self.filters, self.kernel, activation="relu",
-                             border_mode="same", name="mt_conv")(inp)
-        enc = GRU(self.filters, return_sequences=False, name="mt_gru")(conv)
-        enc = Dropout(self.dropout, name="mt_drop")(enc)
-        nonlinear = Dense(self.horizon, name="mt_nl_out")(enc)
-        # autoregressive highway on the target channel (last ar_window steps)
-        ar_in = Lambda(lambda t: t[:, -self.ar_window:, 0], name="mt_ar_slice")(inp)
-        ar = Dense(self.horizon, name="mt_ar")(ar_in)
-        out = merge([nonlinear, ar], mode="sum", name="mt_sum")
+        out = MTNetLayer(self.horizon, self.time_step, self.long_num,
+                         filters=self.filters, kernel=self.kernel,
+                         uni_size=self.uni_size, ar_size=self.ar_window,
+                         dropout=self.dropout, name="mt_net")(inp)
         return Model(input=inp, output=out, name="MTNetForecaster")
 
 
