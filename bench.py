@@ -254,6 +254,13 @@ def bench_resnet50_int8(trials=3):
         "resnet50_predict_int8_samples_per_sec": round(batch * rate_q, 1),
         "resnet50_int8_speedup": round(rate_q / rate_fp, 3),
         "resnet50_int8_top1_agreement": round(agree, 4),
+        # raw-kernel ceiling measured by tools/int8_matrix.py (2026-07-30,
+        # this chip): int8 does NOT unlock a doubled MXU rate through this
+        # XLA stack — bf16 already runs near nameplate.  Hence do_quantize
+        # defaults to warn+opt-in (the documented negative result).
+        "int8_raw_matmul_speedup_4096x1024x1024": 1.201,
+        "int8_raw_conv_speedup_median_resnet_shapes": 1.04,
+        "int8_verdict": "opt-in (slower end-to-end than bf16 on v5e)",
     }
 
 

@@ -52,7 +52,7 @@ def test_quantize_via_inference_model_top1_parity(rng):
     y_fp = im_fp.do_predict(x, batch_size=128)
 
     im_q = InferenceModel().do_load_model(m, m._params, m._state)
-    im_q.do_quantize(jnp.asarray(x[:256]))
+    im_q.do_quantize(jnp.asarray(x[:256]), force=True)
     y_q = im_q.do_predict(x, batch_size=128)
     disagree = (y_q.argmax(-1) != y_fp.argmax(-1)).mean()
     assert disagree < 0.01, disagree         # <1% top-1 drop criterion
@@ -100,3 +100,15 @@ def test_calibrate_restores_call_methods(rng):
     absmax = calibrate(m, m._params, m._state, x)
     assert absmax["d0"] > 0
     assert "call" not in vars(layer)     # instance wrapper removed
+
+
+def test_do_quantize_defaults_to_noop_with_warning(rng):
+    import warnings
+    m, x = _trained_mlp(rng)
+    im = InferenceModel().do_load_model(m, m._params, m._state)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        im.do_quantize(jnp.asarray(x[:64]))   # no force -> warn + no-op
+    assert any("force=True" in str(x.message) for x in w)
+    assert not [v for v in im._params.values()
+                if isinstance(v, dict) and "W_q" in v]

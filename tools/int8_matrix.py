@@ -24,35 +24,41 @@ from conv_ceiling import _rate_two_point  # noqa: E402
 def time_matmul(m, k, n, dtype, trials):
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     @jax.jit
-    def loop(x, w, it, seed):
-        x = x * (1 + seed * 0)
-
-        def body(i, c):
-            xx, acc = c
+    def loop(x, w, it):
+        # weight in the carry, output fed back in, so XLA cannot hoist the
+        # dot out of the loop (conv_ceiling.py methodology)
+        def body(i, ww):
             if dtype == "int8":
-                y = jax.lax.dot(xx, w, preferred_element_type=jnp.int32)
-                return xx, acc + y.sum(dtype=jnp.int32)
-            y = jax.lax.dot(xx, w, preferred_element_type=jnp.float32)
-            return xx, acc + y.sum()
-        zero = jnp.zeros((), jnp.int32 if dtype == "int8" else jnp.float32)
-        _, acc = jax.lax.fori_loop(0, it, body, (x, zero))
-        return acc
+                y = jax.lax.dot(x, ww, preferred_element_type=jnp.int32)
+                return ww + (y.sum() & 1).astype(jnp.int8)
+            y = jax.lax.dot(x, ww, preferred_element_type=jnp.float32)
+            return ww + (y.mean() * 1e-30).astype(ww.dtype)
+        out = jax.lax.fori_loop(0, it, body, w)
+        return out.astype(jnp.float32).sum()
 
-    import numpy as np
     rng = np.random.default_rng(0)
     if dtype == "int8":
         x = jnp.asarray(rng.integers(-127, 127, (m, k)), jnp.int8)
         w = jnp.asarray(rng.integers(-127, 127, (k, n)), jnp.int8)
+
+        def run(it, trial=0):
+            # trial-perturbed weights: no two timing dispatches are
+            # byte-identical (the relay must not serve cached replies)
+            float(loop(x, w + jnp.int8(trial % 2), it))
     else:
         x = jnp.asarray(rng.normal(size=(m, k)), jnp.bfloat16)
         w = jnp.asarray(rng.normal(size=(k, n)), jnp.bfloat16)
 
-    def run(it, seed=0):
-        jax.block_until_ready(loop(x, w, it, seed))
+        def run(it, trial=0):
+            float(loop(x, w + jnp.bfloat16(trial * 1e-8), it))
 
-    return _rate_two_point(run, 2.0 * m * k * n, trials, 20) / 1e12
+    fl = 2.0 * m * k * n
+    # (5n-n) window must rise above relay jitter (conv_ceiling sizing rule)
+    n_lo = max(8, int(25e12 / fl))
+    return _rate_two_point(run, fl, trials, n_lo) / 1e12
 
 
 def time_conv(batch, h, cin, cout, kk, stride, dtype, trials):
@@ -61,36 +67,41 @@ def time_conv(batch, h, cin, cout, kk, stride, dtype, trials):
     import numpy as np
 
     rng = np.random.default_rng(0)
-    if dtype == "int8":
-        x = jnp.asarray(rng.integers(-127, 127, (batch, h, h, cin)), jnp.int8)
-        w = jnp.asarray(rng.integers(-127, 127, (kk, kk, cin, cout)), jnp.int8)
-        pet = jnp.int32
-    else:
-        x = jnp.asarray(rng.normal(size=(batch, h, h, cin)), jnp.bfloat16)
-        w = jnp.asarray(rng.normal(size=(kk, kk, cin, cout)), jnp.bfloat16)
-        pet = jnp.float32
-
-    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+    dn = jax.lax.conv_dimension_numbers((batch, h, h, cin),
+                                        (kk, kk, cin, cout),
                                         ("NHWC", "HWIO", "NHWC"))
 
     @jax.jit
-    def loop(x, w, it, seed):
-        def body(i, c):
-            xx, acc = c
+    def loop(x, w, it):
+        def body(i, ww):
             y = jax.lax.conv_general_dilated(
-                xx, w, (stride, stride), "SAME", dimension_numbers=dn,
-                preferred_element_type=pet)
-            return xx, acc + y.sum(dtype=pet)
-        zero = jnp.zeros((), pet)
-        _, acc = jax.lax.fori_loop(0, it, body, (x, zero))
-        return acc
+                x, ww, (stride, stride), "SAME", dimension_numbers=dn,
+                preferred_element_type=(jnp.int32 if dtype == "int8"
+                                        else jnp.float32))
+            if dtype == "int8":
+                return ww + (y.sum() & 1).astype(jnp.int8)
+            return ww + (y.mean() * 1e-30).astype(ww.dtype)
+        out = jax.lax.fori_loop(0, it, body, w)
+        return out.astype(jnp.float32).sum()
 
-    def run(it, seed=0):
-        jax.block_until_ready(loop(x, w, it, seed))
+    if dtype == "int8":
+        x = jnp.asarray(rng.integers(-127, 127, (batch, h, h, cin)), jnp.int8)
+        w = jnp.asarray(rng.integers(-127, 127, (kk, kk, cin, cout)),
+                        jnp.int8)
+
+        def run(it, trial=0):
+            float(loop(x, w + jnp.int8(trial % 2), it))
+    else:
+        x = jnp.asarray(rng.normal(size=(batch, h, h, cin)), jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(kk, kk, cin, cout)), jnp.bfloat16)
+
+        def run(it, trial=0):
+            float(loop(x, w + jnp.bfloat16(trial * 1e-8), it))
 
     h_out = -(-h // stride)
     fl = 2.0 * batch * h_out * h_out * kk * kk * cin * cout
-    return _rate_two_point(run, fl, trials, 10) / 1e12
+    n_lo = max(8, int(25e12 / fl))
+    return _rate_two_point(run, fl, trials, n_lo) / 1e12
 
 
 def main():
