@@ -28,6 +28,30 @@ from analytics_zoo_tpu.nn.layers.core import (
 from analytics_zoo_tpu.nn.models import Model
 
 
+def _cross_columns(cross_name: str, columns: dict) -> List[str]:
+    """Resolve a cross-column name ("colA_colB") into its component column
+    names.  Column names may themselves contain underscores, so the split is
+    a greedy longest-prefix match against the available columns (a naive
+    split('_') silently matched nothing for e.g. 'education_id_occupation_id',
+    leaving the cross feature constant)."""
+    usable = {k for k, v in columns.items() if v is not None}
+    parts: List[str] = []
+    rest = cross_name
+    while rest:
+        tokens = rest.split("_")
+        for take in range(len(tokens), 0, -1):
+            cand = "_".join(tokens[:take])
+            # never match the whole cross name to itself (callers may pass it
+            # as a None placeholder meaning "compute from parts")
+            if cand in usable and cand != cross_name:
+                parts.append(cand)
+                rest = "_".join(tokens[take:])
+                break
+        else:
+            return []  # an unmatched leading token: unresolvable
+    return parts
+
+
 @dataclasses.dataclass
 class ColumnFeatureInfo:
     """Column declaration (WideAndDeep.scala ColumnFeatureInfo)."""
@@ -121,11 +145,14 @@ class WideAndDeep(ZooModel, Recommender):
                 wide[np.arange(B), off + ids] = 1.0
                 off += d
             for cc, d in zip(info.wide_cross_cols, info.wide_cross_dims):
-                parts = cc.split("_")  # cross col name: "colA_colB"
+                parts = _cross_columns(cc, columns)
+                if not parts:
+                    raise ValueError(
+                        f"cross column '{cc}' matches no input columns "
+                        f"(have {sorted(columns)})")
                 h = np.ones(B, np.int64)
                 for pcol in parts:
-                    if pcol in columns:
-                        h = h * (np.asarray(columns[pcol], np.int64) + 1)
+                    h = h * (np.asarray(columns[pcol], np.int64) + 1)
                 wide[np.arange(B), off + (h % d)] = 1.0
                 off += d
             out.append(wide)

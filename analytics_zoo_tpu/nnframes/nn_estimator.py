@@ -203,6 +203,7 @@ class NNClassifier(NNEstimator):
         m = NNClassifierModel(self.model, base.est)
         m.features_col = base.features_col
         m.feature_preprocessing = base.feature_preprocessing
+        m.sample_preprocessing = base.sample_preprocessing
         m.batch_size = base.batch_size
         return m
 

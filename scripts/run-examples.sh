@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Example-suite smoke runner — the reference's apps/run-app-tests.sh analog:
+# every runnable example executes end-to-end in quick mode; any nonzero exit
+# fails the run.  Usage: scripts/run-examples.sh [python]
+set -u
+PY="${1:-python}"
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
+pass=0; fail=0; failed=()
+run() {
+  local name="$1"; shift
+  echo "== $name"
+  if "$PY" "$@" > "/tmp/example_$name.log" 2>&1; then
+    pass=$((pass+1)); echo "   ok"
+  else
+    fail=$((fail+1)); failed+=("$name")
+    echo "   FAIL (tail of /tmp/example_$name.log):"
+    tail -5 "/tmp/example_$name.log" | sed 's/^/   /'
+  fi
+}
+
+run ncf            examples/ncf_train.py --quick --epochs 2
+run wide_deep      examples/wide_deep_census.py --epochs 1
+run anomaly        examples/anomaly_detection.py --epochs 3
+run sentiment      examples/sentiment_classification.py --epochs 2
+run augmentation   examples/image_augmentation.py
+run similarity     examples/image_similarity.py
+run ssd_voc        examples/ssd_voc_eval.py --epochs 4
+run image_cls      examples/image_classification.py
+run serving        examples/serving_roundtrip.py
+
+echo
+echo "examples: $pass passed, $fail failed ${failed[*]:-}"
+exit $((fail > 0))
