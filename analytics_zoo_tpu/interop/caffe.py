@@ -15,11 +15,16 @@ Returns (model, params, state) and a CaffeModel facade with .predict, wired
 into `Net.load_caffe` (nn/net.py) and
 `InferenceModel.do_load_caffe` (inference/inference_model.py).
 
-Supported layer types (Converter.scala's core set): Input/Data, Convolution,
-InnerProduct, Pooling (MAX/AVE incl. Caffe's ceil-mode via asymmetric pad),
-ReLU (incl. negative_slope), Sigmoid, TanH, Softmax, Dropout, LRN
-(across-channel), BatchNorm (+ scale factor), Scale, Eltwise (SUM/PROD/MAX),
-Concat, Flatten, Reshape.  Unsupported types raise with the layer name.
+Supported layer types (Converter.scala's core set + round-4 breadth,
+V1LayerConverter.scala:1-690 legacy path): Input/Data, Convolution (incl.
+grouped — the AlexNet two-tower form), Deconvolution (valid transposed conv +
+crop), InnerProduct, Pooling (MAX/AVE incl. Caffe's ceil-mode via asymmetric
+pad), ReLU (incl. negative_slope), Sigmoid, TanH, Softmax, SoftmaxWithLoss
+(inference pass-through), Dropout, LRN (across-channel), BatchNorm (+ scale
+factor), Scale, Eltwise (SUM/PROD/MAX), Concat, Flatten, Reshape, Power,
+Crop (spatial), Split.  Both V2 `layer` and legacy V1 `layers` blocks are
+read, in binary (.caffemodel field 2/100) and prototxt (enum type names)
+forms.  Unsupported types raise with the layer name.
 """
 
 from __future__ import annotations
@@ -33,8 +38,8 @@ import numpy as np
 from analytics_zoo_tpu.interop import caffe_pb
 from analytics_zoo_tpu.nn.graph import Input
 from analytics_zoo_tpu.nn.layers import (
-    Activation, Dropout, Flatten, LeakyReLU, Merge, Reshape, Scale,
-    ShareConvolution2D)
+    Activation, Cropping2D, Deconvolution2D, Dropout, Flatten, Lambda,
+    LeakyReLU, Merge, Reshape, Scale, ShareConvolution2D)
 from analytics_zoo_tpu.nn.layers.conv import LRN2D
 from analytics_zoo_tpu.nn.layers.pooling import AveragePooling2D, MaxPooling2D
 from analytics_zoo_tpu.nn.layers.core import Dense
@@ -47,15 +52,33 @@ def _as_list(v) -> list:
     return v if isinstance(v, list) else [v]
 
 
+# V1 prototxt enum names ("layers { type: CONVOLUTION }") -> V2 type names
+_V1_PROTOTXT_TYPES = {
+    "CONCAT": "Concat", "CONVOLUTION": "Convolution", "DATA": "Data",
+    "DROPOUT": "Dropout", "FLATTEN": "Flatten",
+    "INNER_PRODUCT": "InnerProduct", "LRN": "LRN", "POOLING": "Pooling",
+    "RELU": "ReLU", "SIGMOID": "Sigmoid", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss", "SPLIT": "Split", "TANH": "TanH",
+    "ELTWISE": "Eltwise", "POWER": "Power", "DECONVOLUTION": "Deconvolution",
+}
+
+
 def _layers_from_prototxt(txt: Dict[str, Any]) -> List[caffe_pb.CaffeLayer]:
     out = []
-    for entry in _as_list(txt.get("layer")):
+    # V2 "layer { type: "Convolution" }" blocks and V1 legacy
+    # "layers { type: CONVOLUTION }" blocks (V1LayerConverter.scala path)
+    entries = [(e, False) for e in _as_list(txt.get("layer"))] \
+        + [(e, True) for e in _as_list(txt.get("layers"))]
+    for entry, v1 in entries:
         params = {k: v for k, v in entry.items()
                   if isinstance(v, dict) and k.endswith("_param")}
+        t = str(entry.get("type", ""))
+        if v1:
+            t = _V1_PROTOTXT_TYPES.get(t.upper().strip('"'), t)
         out.append(caffe_pb.CaffeLayer(
-            name=str(entry.get("name", "")), type=str(entry.get("type", "")),
+            name=str(entry.get("name", "")), type=t,
             bottoms=[str(b) for b in _as_list(entry.get("bottom"))],
-            tops=[str(t) for t in _as_list(entry.get("top"))],
+            tops=[str(t2) for t2 in _as_list(entry.get("top"))],
             blobs=[], params=params))
     return out
 
@@ -84,6 +107,22 @@ def _input_decl(txt: Optional[Dict[str, Any]], net: caffe_pb.CaffeNet,
                 shapes.append([int(d) for d in _as_list(
                     first.get("dim") if isinstance(first, dict) else first)])
     return names, shapes
+
+
+def _conv_geometry(p: Dict[str, Any]):
+    """(kh, kw, sh, sw, ph, pw, bias) from a convolution_param dict —
+    shared by the Convolution and Deconvolution branches."""
+    ks = _as_list(p.get("kernel_size", []))
+    kh = int(p.get("kernel_h", ks[0] if ks else 3))
+    kw = int(p.get("kernel_w", ks[-1] if ks else kh))
+    st = _as_list(p.get("stride", []))
+    sh = int(p.get("stride_h", st[0] if st else 1))
+    sw = int(p.get("stride_w", st[-1] if st else 1))
+    pd = _as_list(p.get("pad", []))
+    ph = int(p.get("pad_h", pd[0] if pd else 0))
+    pw = int(p.get("pad_w", pd[-1] if pd else 0))
+    bias = bool(p.get("bias_term", True))
+    return kh, kw, sh, sw, ph, pw, bias
 
 
 _POOL_ENUM = {0: "MAX", 1: "AVE", "MAX": "MAX", "AVE": "AVE"}
@@ -156,31 +195,34 @@ def load_caffe(def_path: Optional[str], model_path: str):
     for l in struct_layers:
         if l.type in ("Input", "Data"):
             continue
-        bots = [env[b] for b in l.bottoms]
+        t = l.type
+        if t in ("SoftmaxWithLoss",):
+            # loss heads may reference a label top (train-net Data layers
+            # emit [data, label]) that inference graphs never materialize
+            bots = [env[l.bottoms[0]]] if l.bottoms else []
+        else:
+            missing = [b for b in l.bottoms if b not in env]
+            if missing:
+                raise ValueError(
+                    f"caffe layer {l.name!r}: undefined bottom(s) {missing}")
+            bots = [env[b] for b in l.bottoms]
         x = bots[0] if bots else None
         blobs = weight_blobs.get(l.name, l.blobs)
-        t = l.type
 
         if t == "Convolution":
             p = l.params.get("convolution_param", {})
-            if int(p.get("group", 1)) != 1:
-                raise NotImplementedError(f"{l.name}: grouped conv")
-            ks = _as_list(p.get("kernel_size", []))
-            kh = int(p.get("kernel_h", ks[0] if ks else 3))
-            kw = int(p.get("kernel_w", ks[-1] if ks else kh))
-            st = _as_list(p.get("stride", []))
-            sh = int(p.get("stride_h", st[0] if st else 1))
-            sw = int(p.get("stride_w", st[-1] if st else 1))
-            pd = _as_list(p.get("pad", []))
-            ph = int(p.get("pad_h", pd[0] if pd else 0))
-            pw = int(p.get("pad_w", pd[-1] if pd else 0))
-            bias = bool(p.get("bias_term", True))
+            groups = int(p.get("group", 1))
+            kh, kw, sh, sw, ph, pw, bias = _conv_geometry(p)
             layer = ShareConvolution2D(
                 int(p["num_output"]), (kh, kw), pad_h=ph, pad_w=pw,
-                subsample=(sh, sw), bias=bias, dim_ordering="th", name=l.name)
+                subsample=(sh, sw), bias=bias, dim_ordering="th",
+                groups=groups, name=l.name)
             y = layer(x)
             if blobs:
-                W = blobs[0].data                     # (O, I, kH, kW)
+                # grouped or not, the blob is (O, I/g, kH, kW) and our kernel
+                # is (kH, kW, I/g, O) with feature_group_count handling the
+                # group block-structure (AlexNet two-tower convs included)
+                W = blobs[0].data
                 weights[l.name] = {"W": W.transpose(2, 3, 1, 0)}
                 if bias and len(blobs) > 1:
                     weights[l.name]["b"] = blobs[1].data.reshape(-1)
@@ -188,6 +230,30 @@ def load_caffe(def_path: Optional[str], model_path: str):
                 h, w = hw[l.bottoms[0]]
                 hw[l.tops[0]] = ((h + 2 * ph - kh) // sh + 1,
                                  (w + 2 * pw - kw) // sw + 1)
+        elif t == "Deconvolution":
+            p = l.params.get("convolution_param", {})
+            if int(p.get("group", 1)) != 1:
+                raise NotImplementedError(f"{l.name}: grouped deconvolution")
+            kh, kw, sh, sw, ph, pw, bias = _conv_geometry(p)
+            # caffe deconv output = (H-1)*s + k - 2p: a VALID transposed conv
+            # followed by cropping p on each side
+            layer = Deconvolution2D(int(p["num_output"]), (kh, kw),
+                                    subsample=(sh, sw), border_mode="valid",
+                                    bias=bias, dim_ordering="th", name=l.name)
+            y = layer(x)
+            if ph or pw:
+                y = Cropping2D(((ph, ph), (pw, pw)), dim_ordering="th",
+                               name=l.name + "_crop")(y)
+            if blobs:
+                # caffe deconv blob: (I, O, kH, kW); ours: (kH, kW, O, I)
+                W = blobs[0].data
+                weights[l.name] = {"W": W.transpose(2, 3, 1, 0)}
+                if bias and len(blobs) > 1:
+                    weights[l.name]["b"] = blobs[1].data.reshape(-1)
+            if l.bottoms[0] in hw:
+                h, w = hw[l.bottoms[0]]
+                hw[l.tops[0]] = ((h - 1) * sh + kh - 2 * ph,
+                                 (w - 1) * sw + kw - 2 * pw)
         elif t == "InnerProduct":
             p = l.params.get("inner_product_param", {})
             bias = bool(p.get("bias_term", True))
@@ -278,6 +344,45 @@ def load_caffe(def_path: Optional[str], model_path: str):
             p = l.params.get("concat_param", {})
             axis = int(p.get("axis", p.get("concat_dim", 1)))
             y = Merge(mode="concat", concat_axis=axis, name=l.name)(bots)
+        elif t == "Power":
+            p = l.params.get("power_param", {})
+            power = float(p.get("power", 1.0))
+            scale = float(p.get("scale", 1.0))
+            shift = float(p.get("shift", 0.0))
+            y = Lambda(lambda v, a=power, s=scale, c=shift:
+                       (c + s * v) ** a, name=l.name)(x)
+        elif t == "Crop":
+            # crop bottoms[0] spatially to bottoms[1]'s size at `offset`
+            # (CropParameter; axis defaults to 2 = spatial-only here)
+            p = l.params.get("crop_param", {})
+            axis = int(p.get("axis", 2))
+            if axis not in (2, 3):
+                raise NotImplementedError(
+                    f"{l.name}: Crop along axis {axis} (channel/batch)")
+            offs = [int(o) for o in _as_list(p.get("offset", [0]))]
+            if len(offs) == 1:
+                offs = offs * 2
+            if l.bottoms[0] not in hw or l.bottoms[1] not in hw:
+                raise NotImplementedError(
+                    f"{l.name}: Crop needs known spatial dims")
+            sh_, sw_ = hw[l.bottoms[0]]
+            th_, tw_ = hw[l.bottoms[1]]
+            if axis == 3:       # W-only crop: H passes through unchanged
+                th_, offs = sh_, [0, offs[0]]
+            y = Cropping2D(((offs[0], sh_ - th_ - offs[0]),
+                            (offs[1], sw_ - tw_ - offs[1])),
+                           dim_ordering="th", name=l.name)(x)
+            hw[l.tops[0]] = (th_, tw_)
+        elif t == "Split":
+            # identity fan-out: every top aliases the bottom
+            for top in l.tops:
+                env[top] = x
+                if l.bottoms[0] in hw:
+                    hw[top] = hw[l.bottoms[0]]
+            continue
+        elif t in ("SoftmaxWithLoss",):
+            # training-only loss head: inference graphs pass through softmax
+            y = Activation("softmax", name=l.name)(x)
         elif t == "Flatten":
             y = Flatten(name=l.name)(x)
         elif t == "Reshape":
@@ -295,7 +400,8 @@ def load_caffe(def_path: Optional[str], model_path: str):
                 and l.bottoms[0] in hw and t in ("ReLU", "Sigmoid", "TanH",
                                                  "Dropout", "LRN",
                                                  "BatchNorm", "Scale",
-                                                 "Eltwise", "Concat"):
+                                                 "Eltwise", "Concat",
+                                                 "Power", "SoftmaxWithLoss"):
             # Eltwise/Concat preserve spatial dims (Concat joins channels)
             hw[l.tops[0]] = hw[l.bottoms[0]]
 
@@ -322,4 +428,6 @@ class CaffeModel:
             lambda p, s, x: model.apply(p, s, x, training=False)[0])
 
     def predict(self, x) -> np.ndarray:
-        return np.asarray(self._jit(self.params, self.state, jnp.asarray(x)))
+        arg = ([jnp.asarray(a) for a in x] if isinstance(x, (list, tuple))
+               else jnp.asarray(x))
+        return np.asarray(self._jit(self.params, self.state, arg))

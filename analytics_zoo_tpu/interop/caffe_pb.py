@@ -122,9 +122,55 @@ _LAYER_PARAM_FIELDS = {
     135: ("flatten_param", {1: ("axis", "varint"), 2: ("end_axis", "varint")}),
     143: ("input_param", {1: ("shape", "blobshape")}),
     123: ("relu_param", {1: ("negative_slope", "float")}),
+    122: ("power_param", {
+        1: ("power", "float"), 2: ("scale", "float"), 3: ("shift", "float")}),
+    144: ("crop_param", {
+        1: ("axis", "varint"), 2: ("offset", "repeated_varint")}),
 }
 _PARAM_BY_NAME = {name: (fnum, schema)
                   for fnum, (name, schema) in _LAYER_PARAM_FIELDS.items()}
+
+# ---- V1 legacy layers (NetParameter.layers, field 2) ------------------------
+# V1LayerParameter wires: bottom=2, top=3, name=4, type(enum)=5, blobs=6,
+# per-layer params at V1-specific numbers (caffe.proto upstream).
+V1_TYPE_NAMES = {
+    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout", 8: "Flatten",
+    14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU", 19: "Sigmoid",
+    20: "Softmax", 21: "SoftmaxWithLoss", 22: "Split", 23: "TanH",
+    25: "Eltwise", 26: "Power", 39: "Deconvolution",
+}
+
+_V1_PARAM_FIELDS = {
+    10: _LAYER_PARAM_FIELDS[106],   # convolution_param
+    17: _LAYER_PARAM_FIELDS[117],   # inner_product_param
+    19: _LAYER_PARAM_FIELDS[121],   # pooling_param
+    18: _LAYER_PARAM_FIELDS[118],   # lrn_param
+    12: _LAYER_PARAM_FIELDS[108],   # dropout_param
+    24: _LAYER_PARAM_FIELDS[110],   # eltwise_param
+    9: _LAYER_PARAM_FIELDS[104],    # concat_param
+    39: _LAYER_PARAM_FIELDS[125],   # softmax_param
+    30: _LAYER_PARAM_FIELDS[123],   # relu_param
+    21: _LAYER_PARAM_FIELDS[122],   # power_param
+}
+
+
+def _decode_layer_v1(buf: bytes) -> CaffeLayer:
+    layer = CaffeLayer("", "", [], [], [], {})
+    for fnum, wtype, val in iter_fields(buf):
+        if fnum == 4:
+            layer.name = val.decode("utf-8")
+        elif fnum == 5:
+            layer.type = V1_TYPE_NAMES.get(int(val), f"V1_{int(val)}")
+        elif fnum == 2:
+            layer.bottoms.append(val.decode("utf-8"))
+        elif fnum == 3:
+            layer.tops.append(val.decode("utf-8"))
+        elif fnum == 6:
+            layer.blobs.append(_decode_blob(val))
+        elif fnum in _V1_PARAM_FIELDS:
+            name, schema = _V1_PARAM_FIELDS[fnum]
+            layer.params[name] = _decode_param(schema, val)
+    return layer
 
 
 def _decode_param(schema, buf: bytes) -> Dict[str, Any]:
@@ -197,6 +243,8 @@ def load_net(data: bytes) -> CaffeNet:
             net.name = val.decode("utf-8")
         elif fnum == 100:                                  # V2 layers
             net.layers.append(_decode_layer(val))
+        elif fnum == 2 and wtype == _WIRE_LEN:             # V1 legacy layers
+            net.layers.append(_decode_layer_v1(val))
         elif fnum == 3:
             net.inputs.append(val.decode("utf-8"))
         elif fnum == 8 and wtype == _WIRE_LEN:             # input_shape
@@ -219,8 +267,11 @@ def load_net(data: bytes) -> CaffeNet:
 # ---------------------------------------------------------------- encoder
 # (for building test fixtures; the reference never writes caffemodels)
 
-def encode_param(name: str, fields: Dict[str, Any]) -> bytes:
+def encode_param(name: str, fields: Dict[str, Any],
+                 fnum_override: int = None) -> bytes:
     fnum, schema = _PARAM_BY_NAME[name]
+    if fnum_override is not None:
+        fnum = fnum_override
     rev = {n: (f, kind) for f, (n, kind) in schema.items()}
     out = b""
     for k, v in fields.items():
@@ -252,14 +303,33 @@ def encode_layer(layer: CaffeLayer) -> bytes:
     return _f_bytes(100, out)
 
 
-def encode_net(net: CaffeNet) -> bytes:
+def encode_layer_v1(layer: CaffeLayer) -> bytes:
+    """Encode as a legacy V1LayerParameter (NetParameter.layers, field 2) —
+    for building V1-path test fixtures."""
+    type_rev = {v: k for k, v in V1_TYPE_NAMES.items()}
+    v1_pnum = {name_schema[0]: f for f, name_schema in
+               _V1_PARAM_FIELDS.items()}
+    out = _f_str(4, layer.name) + _f_varint(5, type_rev[layer.type])
+    for b in layer.bottoms:
+        out += _f_str(2, b)
+    for t in layer.tops:
+        out += _f_str(3, t)
+    for blob in layer.blobs:
+        out += _f_bytes(6, blob.encode())
+    for pname, fields in layer.params.items():
+        out += encode_param(pname, fields, fnum_override=v1_pnum[pname])
+    return _f_bytes(2, out)
+
+
+def encode_net(net: CaffeNet, v1: bool = False) -> bytes:
     out = _f_str(1, net.name)
     for i, inp in enumerate(net.inputs):
         out += _f_str(3, inp)
     for dims in net.input_shapes:
         packed = b"".join(_write_varint(int(d)) for d in dims)
         out += _f_bytes(8, _f_bytes(1, packed))
-    body = b"".join(encode_layer(l) for l in net.layers)
+    enc = encode_layer_v1 if v1 else encode_layer
+    body = b"".join(enc(l) for l in net.layers)
     return out + body
 
 

@@ -77,10 +77,25 @@ class Seq2seq(KerasNet):
     def __init__(self, vocab_size: int, embed_dim: int = 64,
                  hidden_sizes: Sequence[int] = (128,),
                  bridge: str = "dense", init="glorot_uniform", **kwargs):
+        """`bridge` — the encoder→decoder state adapter family
+        (Bridge.scala:1-156):
+          * None / "passthrough": encoder states pass through unchanged
+            (PassThroughBridge);
+          * "dense": ALL layers' (h, c) states are flattened into one vector,
+            mapped by a single bias-free Dense, and split back — cross-layer
+            state mixing, exactly the reference's Merge→Dense→SplitTensor;
+          * "densenonlinear": same with tanh;
+          * a callable: customized bridge fn(flat (B, S)) -> (B, S)
+            (Bridge(bridge: KerasLayer) analog)."""
         super().__init__(**kwargs)
         self.vocab_size = int(vocab_size)
         self.embed_dim = int(embed_dim)
         self.hidden_sizes = tuple(hidden_sizes)
+        if not (bridge in (None, "passthrough", "dense", "densenonlinear")
+                or callable(bridge)):
+            raise ValueError(
+                f"bridge must be None/'passthrough'/'dense'/'densenonlinear' "
+                f"or a callable, got {bridge!r}")
         self.bridge_kind = bridge
         self.init_name = init
         self._declared_input_shape = [(None,), (None,)]
@@ -101,17 +116,12 @@ class Seq2seq(KerasNet):
                                      dtypes.param_dtype()),
                     "b": jnp.zeros((self.vocab_size,), dtypes.param_dtype())},
         }
-        if self.bridge_kind == "dense":
-            bridges = []
-            for i, h in enumerate(H):
-                r = jax.random.fold_in(rb, i)
-                r1, r2 = jax.random.split(r)
-                bridges.append({
-                    "Wh": initializer(self.init_name, r1, (h, h),
-                                      dtypes.param_dtype()),
-                    "Wc": initializer(self.init_name, r2, (h, h),
-                                      dtypes.param_dtype())})
-            p["bridge"] = bridges
+        if self.bridge_kind in ("dense", "densenonlinear"):
+            # one bias-free Dense over the flat concat of every layer's
+            # (h, c) — Bridge.scala's Merge -> Dense -> SplitTensor
+            S = sum(2 * h for h in H)
+            p["bridge"] = {"W": initializer(self.init_name, rb, (S, S),
+                                            dtypes.param_dtype())}
         return p
 
     def _embed(self, params, ids):
@@ -129,12 +139,21 @@ class Seq2seq(KerasNet):
         return final_states
 
     def _bridge(self, params, states):
-        if self.bridge_kind != "dense":
+        kind = self.bridge_kind
+        if kind in (None, "passthrough"):
             return states
-        out = []
-        for p, (h, c) in zip(params["bridge"], states):
-            out.append((jnp.tanh(h @ p["Wh"]), jnp.tanh(c @ p["Wc"])))
-        return out
+        flat = jnp.concatenate([t for hc in states for t in hc], axis=-1)
+        if callable(kind):
+            out = kind(flat)
+        else:
+            out = flat @ params["bridge"]["W"]
+            if kind == "densenonlinear":
+                out = jnp.tanh(out)
+        news, off = [], 0
+        for h in self.hidden_sizes:
+            news.append((out[:, off:off + h], out[:, off + h:off + 2 * h]))
+            off += 2 * h
+        return news
 
     def _project(self, params, h):
         hw, W = dtypes.cast_compute(h, params["out"]["W"])
