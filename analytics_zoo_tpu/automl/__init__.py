@@ -1,0 +1,22 @@
+from analytics_zoo_tpu.automl.feature import (ALL_DT_FEATURES,
+                                              TimeSequenceFeatureTransformer)
+from analytics_zoo_tpu.automl.population import PopulationTrainer
+from analytics_zoo_tpu.automl.regression import (
+    BayesRecipe, GridRandomRecipe, LSTMGridRandomRecipe, MTNetGridRandomRecipe,
+    MTNetSmokeRecipe, RandomRecipe, Recipe, SmokeRecipe, TimeSequencePipeline,
+    TimeSequencePredictor)
+from analytics_zoo_tpu.automl.search import (
+    BayesSearchEngine, Choice, GridRandomSearchEngine, GridSearch,
+    GridSearchEngine, LogUniform, QUniform, RandInt, RandomSearchEngine,
+    SampleFn, SearchEngine, Uniform)
+
+__all__ = [
+    "ALL_DT_FEATURES", "TimeSequenceFeatureTransformer", "PopulationTrainer",
+    "Recipe", "SmokeRecipe", "MTNetSmokeRecipe", "RandomRecipe", "BayesRecipe",
+    "GridRandomRecipe", "LSTMGridRandomRecipe", "MTNetGridRandomRecipe",
+    "TimeSequencePredictor", "TimeSequencePipeline",
+    "SearchEngine", "RandomSearchEngine", "GridSearchEngine",
+    "GridRandomSearchEngine", "BayesSearchEngine",
+    "Uniform", "LogUniform", "RandInt", "QUniform", "Choice", "GridSearch",
+    "SampleFn",
+]

@@ -64,6 +64,19 @@ class SmokeRecipe(Recipe):
                 "epochs": Choice([6]), "batch_size": Choice([32])}
 
 
+class MTNetSmokeRecipe(Recipe):
+    """One-config MTNet smoke (recipe.py:83-108 MTNetSmokeRecipe parity)."""
+
+    n_trials = 1
+
+    def search_space(self, all_available_features=()):
+        return {"model": "MTNet", "lr": Choice([0.005]),
+                "batch_size": Choice([32]), "epochs": Choice([3]),
+                "dropout": Choice([0.1]), "time_step": Choice([4]),
+                "filter_size": Choice([8]), "long_num": Choice([3]),
+                "ar_size": Choice([2]), "lookback": Choice([16])}
+
+
 class RandomRecipe(Recipe):
     def __init__(self, n_trials: int = 5, lookback_range=(6, 16),
                  parallelism: int = 1):

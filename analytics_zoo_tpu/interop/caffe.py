@@ -52,17 +52,6 @@ def _as_list(v) -> list:
     return v if isinstance(v, list) else [v]
 
 
-# V1 prototxt enum names ("layers { type: CONVOLUTION }") -> V2 type names
-_V1_PROTOTXT_TYPES = {
-    "CONCAT": "Concat", "CONVOLUTION": "Convolution", "DATA": "Data",
-    "DROPOUT": "Dropout", "FLATTEN": "Flatten",
-    "INNER_PRODUCT": "InnerProduct", "LRN": "LRN", "POOLING": "Pooling",
-    "RELU": "ReLU", "SIGMOID": "Sigmoid", "SOFTMAX": "Softmax",
-    "SOFTMAX_LOSS": "SoftmaxWithLoss", "SPLIT": "Split", "TANH": "TanH",
-    "ELTWISE": "Eltwise", "POWER": "Power", "DECONVOLUTION": "Deconvolution",
-}
-
-
 def _layers_from_prototxt(txt: Dict[str, Any]) -> List[caffe_pb.CaffeLayer]:
     out = []
     # V2 "layer { type: "Convolution" }" blocks and V1 legacy
@@ -74,7 +63,7 @@ def _layers_from_prototxt(txt: Dict[str, Any]) -> List[caffe_pb.CaffeLayer]:
                   if isinstance(v, dict) and k.endswith("_param")}
         t = str(entry.get("type", ""))
         if v1:
-            t = _V1_PROTOTXT_TYPES.get(t.upper().strip('"'), t)
+            t = caffe_pb.V1_PROTOTXT_TYPES.get(t.upper().strip('"'), t)
         out.append(caffe_pb.CaffeLayer(
             name=str(entry.get("name", "")), type=t,
             bottoms=[str(b) for b in _as_list(entry.get("bottom"))],
@@ -107,6 +96,15 @@ def _input_decl(txt: Optional[Dict[str, Any]], net: caffe_pb.CaffeNet,
                 shapes.append([int(d) for d in _as_list(
                     first.get("dim") if isinstance(first, dict) else first)])
     return names, shapes
+
+
+def _caffe_softmax(l, x):
+    """Caffe softmax normalizes over AXIS 1 (channels) by default — on NCHW
+    score maps (FCN-style heads) jax.nn.softmax's axis=-1 default would
+    silently normalize over width instead."""
+    axis = int(l.params.get("softmax_param", {}).get("axis", 1))
+    return Lambda(lambda t, a=axis: jax.nn.softmax(t, axis=a),
+                  name=l.name)(x)
 
 
 def _conv_geometry(p: Dict[str, Any]):
@@ -196,16 +194,15 @@ def load_caffe(def_path: Optional[str], model_path: str):
         if l.type in ("Input", "Data"):
             continue
         t = l.type
-        if t in ("SoftmaxWithLoss",):
-            # loss heads may reference a label top (train-net Data layers
-            # emit [data, label]) that inference graphs never materialize
-            bots = [env[l.bottoms[0]]] if l.bottoms else []
-        else:
-            missing = [b for b in l.bottoms if b not in env]
-            if missing:
-                raise ValueError(
-                    f"caffe layer {l.name!r}: undefined bottom(s) {missing}")
-            bots = [env[b] for b in l.bottoms]
+        # loss heads may reference a label top (train-net Data layers emit
+        # [data, label]) that inference graphs never materialize — only
+        # their bottoms[1:] are exempt from the undefined-bottom check
+        check = l.bottoms[:1] if t in ("SoftmaxWithLoss",) else l.bottoms
+        missing = [b for b in check if b not in env]
+        if missing:
+            raise ValueError(
+                f"caffe layer {l.name!r}: undefined bottom(s) {missing}")
+        bots = [env[b] for b in check]
         x = bots[0] if bots else None
         blobs = weight_blobs.get(l.name, l.blobs)
 
@@ -288,7 +285,7 @@ def load_caffe(def_path: Optional[str], model_path: str):
         elif t == "TanH":
             y = Activation("tanh", name=l.name)(x)
         elif t == "Softmax":
-            y = Activation("softmax", name=l.name)(x)
+            y = _caffe_softmax(l, x)
         elif t == "Dropout":
             ratio = l.params.get("dropout_param", {}).get("dropout_ratio", 0.5)
             y = Dropout(float(ratio), name=l.name)(x)
@@ -369,6 +366,11 @@ def load_caffe(def_path: Optional[str], model_path: str):
             th_, tw_ = hw[l.bottoms[1]]
             if axis == 3:       # W-only crop: H passes through unchanged
                 th_, offs = sh_, [0, offs[0]]
+            if th_ + offs[0] > sh_ or tw_ + offs[1] > sw_:
+                raise ValueError(
+                    f"{l.name}: crop offset+target exceeds source "
+                    f"(source {(sh_, sw_)}, target {(th_, tw_)}, "
+                    f"offset {offs})")
             y = Cropping2D(((offs[0], sh_ - th_ - offs[0]),
                             (offs[1], sw_ - tw_ - offs[1])),
                            dim_ordering="th", name=l.name)(x)
@@ -382,7 +384,7 @@ def load_caffe(def_path: Optional[str], model_path: str):
             continue
         elif t in ("SoftmaxWithLoss",):
             # training-only loss head: inference graphs pass through softmax
-            y = Activation("softmax", name=l.name)(x)
+            y = _caffe_softmax(l, x)
         elif t == "Flatten":
             y = Flatten(name=l.name)(x)
         elif t == "Reshape":

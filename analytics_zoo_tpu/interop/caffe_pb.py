@@ -133,12 +133,21 @@ _PARAM_BY_NAME = {name: (fnum, schema)
 # ---- V1 legacy layers (NetParameter.layers, field 2) ------------------------
 # V1LayerParameter wires: bottom=2, top=3, name=4, type(enum)=5, blobs=6,
 # per-layer params at V1-specific numbers (caffe.proto upstream).
-V1_TYPE_NAMES = {
-    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout", 8: "Flatten",
-    14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU", 19: "Sigmoid",
-    20: "Softmax", 21: "SoftmaxWithLoss", 22: "Split", 23: "TanH",
-    25: "Eltwise", 26: "Power", 39: "Deconvolution",
+# Single source of truth for the V1 type set: enum value -> (enum name as it
+# appears in V1 prototxt, V2 type name).  Both the binary decoder and the
+# prototxt parser derive from this table.
+V1_TYPES = {
+    3: ("CONCAT", "Concat"), 4: ("CONVOLUTION", "Convolution"),
+    5: ("DATA", "Data"), 6: ("DROPOUT", "Dropout"),
+    8: ("FLATTEN", "Flatten"), 14: ("INNER_PRODUCT", "InnerProduct"),
+    15: ("LRN", "LRN"), 17: ("POOLING", "Pooling"), 18: ("RELU", "ReLU"),
+    19: ("SIGMOID", "Sigmoid"), 20: ("SOFTMAX", "Softmax"),
+    21: ("SOFTMAX_LOSS", "SoftmaxWithLoss"), 22: ("SPLIT", "Split"),
+    23: ("TANH", "TanH"), 25: ("ELTWISE", "Eltwise"), 26: ("POWER", "Power"),
+    39: ("DECONVOLUTION", "Deconvolution"),
 }
+V1_TYPE_NAMES = {enum: v2 for enum, (_, v2) in V1_TYPES.items()}
+V1_PROTOTXT_TYPES = {txt: v2 for _, (txt, v2) in V1_TYPES.items()}
 
 _V1_PARAM_FIELDS = {
     10: _LAYER_PARAM_FIELDS[106],   # convolution_param

@@ -1,0 +1,6 @@
+from analytics_zoo_tpu.zouwu.forecast import (
+    AutoTSTrainer, Forecaster, LSTMForecaster, MTNetForecaster, MTNetLayer,
+    Seq2SeqForecaster, TSPipeline)
+
+__all__ = ["AutoTSTrainer", "TSPipeline", "Forecaster", "LSTMForecaster",
+           "Seq2SeqForecaster", "MTNetForecaster", "MTNetLayer"]

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Multi-host training launcher — the job-launch tooling analog of the
+# reference's spark-submit wrappers (scripts/ in the reference repo).
+#
+# Two modes:
+#
+# 1) TPU pod (one process per host, run ON each host; the TPU runtime knows
+#    the topology so only the coordinator is needed):
+#       ZOO_TPU_COORDINATOR_ADDRESS=<host0>:8476 python train.py
+#    (init_context() picks the env var up via ZooConf.from_env and calls
+#    jax.distributed.initialize.)
+#
+# 2) Local simulation (this script): N processes x D virtual CPU devices on
+#    one machine, for testing multi-host code paths without a pod:
+#       scripts/launch-multihost.sh [-n procs] [-d devices_per_proc] \
+#           script.py [args...]
+#    Each process gets ZOO_TPU_COORDINATOR_ADDRESS / ZOO_TPU_NUM_PROCESSES /
+#    ZOO_TPU_PROCESS_ID plus JAX CPU-mesh flags; the script should call
+#    init_context() and partition its data by
+#    get_context().process_index / process_count
+#    (see tests/multihost_worker.py for the canonical shape).
+set -euo pipefail
+
+NPROCS=2
+DEVICES=4
+while getopts "n:d:" opt; do
+  case "$opt" in
+    n) NPROCS="$OPTARG" ;;
+    d) DEVICES="$OPTARG" ;;
+    *) echo "usage: $0 [-n procs] [-d devices_per_proc] script.py [args...]" >&2
+       exit 2 ;;
+  esac
+done
+shift $((OPTIND - 1))
+[ $# -ge 1 ] || { echo "usage: $0 [-n procs] [-d devices] script.py" >&2; exit 2; }
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1])
+EOF
+)
+COORD="127.0.0.1:$PORT"
+echo "launching $NPROCS processes x $DEVICES devices, coordinator $COORD"
+
+pids=()
+for ((p = 0; p < NPROCS; p++)); do
+  ZOO_TPU_COORDINATOR_ADDRESS="$COORD" \
+  ZOO_TPU_NUM_PROCESSES="$NPROCS" \
+  ZOO_TPU_PROCESS_ID="$p" \
+  XLA_FLAGS="--xla_force_host_platform_device_count=$DEVICES" \
+  JAX_PLATFORMS=cpu \
+  python "$@" &
+  pids+=($!)
+done
+
+rc=0
+for pid in "${pids[@]}"; do
+  wait "$pid" || rc=$?
+done
+exit "$rc"

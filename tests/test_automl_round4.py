@@ -186,3 +186,16 @@ def test_mtnet_real_architecture_learns(ctx):
     assert out.shape == (4, 1)
     with pytest.raises(ValueError):
         MTNetForecaster(lookback=15, long_num=3)  # not divisible
+
+
+def test_package_exports_and_mtnet_smoke_recipe(ctx):
+    import analytics_zoo_tpu.automl as automl
+    import analytics_zoo_tpu.zouwu as zouwu
+
+    assert automl.PopulationTrainer and zouwu.MTNetForecaster
+    df = _ts_df(180)
+    predictor = automl.TimeSequencePredictor(
+        recipe=automl.MTNetSmokeRecipe())
+    pipe = predictor.fit(df)
+    assert pipe.config["model"] == "MTNet"
+    assert np.isfinite(pipe.evaluate(df, metrics=("mse",))["mse"])

@@ -248,3 +248,51 @@ def test_undefined_bottom_raises(tmp_path):
     path.write_bytes(caffe_pb.encode_net(net))
     with pytest.raises(ValueError, match="ghost"):
         load_caffe(None, str(path))
+
+
+def test_softmax_axis1_on_nchw_maps(tmp_path, rng):
+    """Caffe softmax normalizes over channels (axis 1), not width — the
+    FCN-style score-map case."""
+    L = caffe_pb.CaffeLayer
+    net = caffe_pb.CaffeNet("fcnhead", [
+        L("scores", "Input", [], ["scores"], [],
+          {"input_param": {"shape": [[1, 3, 4, 5]]}}),
+        L("prob", "Softmax", ["scores"], ["prob"], [], {}),
+    ], [], [])
+    path = tmp_path / "s.caffemodel"
+    path.write_bytes(caffe_pb.encode_net(net))
+    m = load_caffe(None, str(path))
+    x = rng.normal(size=(2, 3, 4, 5)).astype(np.float32)
+    got = m.predict(x)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_crop_overflow_raises(tmp_path):
+    L = caffe_pb.CaffeLayer
+    net = caffe_pb.CaffeNet("badcrop", [
+        L("a", "Input", [], ["a"], [],
+          {"input_param": {"shape": [[1, 1, 8, 8]]}}),
+        L("b", "Input", [], ["b"], [],
+          {"input_param": {"shape": [[1, 1, 5, 5]]}}),
+        L("crop", "Crop", ["a", "b"], ["crop"], [],
+          {"crop_param": {"axis": 2, "offset": [4, 0]}}),
+    ], [], [])
+    path = tmp_path / "bc.caffemodel"
+    path.write_bytes(caffe_pb.encode_net(net))
+    with pytest.raises(ValueError, match="exceeds source"):
+        load_caffe(None, str(path))
+
+
+def test_loss_head_missing_data_bottom_is_descriptive(tmp_path):
+    L = caffe_pb.CaffeLayer
+    net = caffe_pb.CaffeNet("badloss", [
+        L("loss", "SoftmaxWithLoss", ["fc_missing", "label"], ["loss"], [],
+          {}),
+    ], ["data"], [[1, 4]])
+    path = tmp_path / "bl.caffemodel"
+    path.write_bytes(caffe_pb.encode_net(net))
+    with pytest.raises(ValueError, match="fc_missing"):
+        load_caffe(None, str(path))
