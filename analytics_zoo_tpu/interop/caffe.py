@@ -366,9 +366,10 @@ def load_caffe(def_path: Optional[str], model_path: str):
             th_, tw_ = hw[l.bottoms[1]]
             if axis == 3:       # W-only crop: H passes through unchanged
                 th_, offs = sh_, [0, offs[0]]
-            if th_ + offs[0] > sh_ or tw_ + offs[1] > sw_:
+            if (min(offs) < 0 or th_ + offs[0] > sh_
+                    or tw_ + offs[1] > sw_):
                 raise ValueError(
-                    f"{l.name}: crop offset+target exceeds source "
+                    f"{l.name}: crop offset+target outside source "
                     f"(source {(sh_, sw_)}, target {(th_, tw_)}, "
                     f"offset {offs})")
             y = Cropping2D(((offs[0], sh_ - th_ - offs[0]),

@@ -67,11 +67,17 @@ def _attention_xla_bthd(q, k, v, mask=None, causal=False, scale=None,
 
 
 def _flash_worthwhile(t: int) -> bool:
-    """Flash crossover: measured on v5e (BERT-Large train, 2026-07-30), the
-    Pallas kernel ran ~0.8ms/layer at T=512 where the XLA einsum path is
-    several times faster — the O(T^2) probs tensor only starts to hurt XLA
-    past ~1k tokens.  Flash engages above that."""
-    return t > 1024
+    """Flash crossover, measured on v5e (2026-07-30, B=4 H=8 D=64, fwd):
+    with the tuned (512, 1024) blocks the Pallas kernel runs 59-69 TF/s flat
+    across T, while the XLA einsum path drops from ~72 TF/s at T=512 to
+    ~22 TF/s once the (T, T) probs tensor dominates HBM traffic:
+
+        T=512:  flash 0.82x XLA   T=1024: flash 3.2x XLA
+        T=2048: flash 2.8x        T=4096: flash 2.9x
+
+    so flash engages from 1k tokens up (and is mandatory far beyond, where
+    the O(T^2) probs would not fit at all)."""
+    return t >= 1024
 
 
 def _select_flash(use_flash, t_len, head_dim, mask, dropping, warn=False):

@@ -105,8 +105,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 1024, interpret: Optional[bool] = None):
     """q/k/v: (B, H, T, D).  Any T: the sequence axis is padded to the block grid
     internally (padded keys masked, padded query rows sliced off).  Returns
     softmax(qk^T * scale) v."""

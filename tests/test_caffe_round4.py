@@ -282,7 +282,7 @@ def test_crop_overflow_raises(tmp_path):
     ], [], [])
     path = tmp_path / "bc.caffemodel"
     path.write_bytes(caffe_pb.encode_net(net))
-    with pytest.raises(ValueError, match="exceeds source"):
+    with pytest.raises(ValueError, match="outside source"):
         load_caffe(None, str(path))
 
 
