@@ -122,8 +122,10 @@ class _PreemptionGuard:
     TPU preemptions arrive as SIGTERM; the reference's failure story only
     covered in-process exceptions (Topology.scala:1180-1262 retry).  While a
     fit() with checkpointing is active, the first SIGTERM/SIGINT sets a flag;
-    the step loop notices, writes a synchronous snapshot, and exits with the
-    conventional 128+signum code so a supervisor restarts with resume=True.
+    the step loop notices, writes a synchronous snapshot, then exits with the
+    conventional 128+signum code (SIGTERM — so a supervisor restarts with
+    resume=True) or re-raises KeyboardInterrupt (SIGINT — so a Ctrl-C keeps
+    its normal semantics for surrounding cleanup code after the snapshot).
     A second signal falls through to the previous disposition (force kill).
     Installed only when checkpointing is configured — a plain fit() keeps
     normal Ctrl-C semantics.  No-op off the main thread (signal() is
@@ -289,11 +291,13 @@ class Estimator:
             if multi:
                 out.append(jax.tree.map(
                     lambda v: jax.make_array_from_process_local_data(
-                        self.ctx.data_sharding(np.ndim(v)), np.asarray(v)), a))
+                        self.ctx.batch_sharding_for(np.shape(v)),
+                        np.asarray(v)), a))
             else:
                 out.append(jax.tree.map(
                     lambda v: jax.device_put(
-                        jnp.asarray(v), self.ctx.data_sharding(np.ndim(v))), a))
+                        jnp.asarray(v),
+                        self.ctx.batch_sharding_for(np.shape(v))), a))
         return out
 
     def _shard_grouped(self, *arrays):
@@ -427,6 +431,7 @@ class Estimator:
         np_rng = np.random.default_rng(self.ctx.conf.seed)
         log_every = log_every or self.ctx.conf.log_every_n_steps
 
+        self._require_data(data)
         first = next(iter(data.batches(feed_bs)))
         self._ensure_init(first[0])
         if resume:
@@ -526,20 +531,24 @@ class Estimator:
                         self.save_checkpoint()
                     guard = getattr(self, "_guard", None)
                     if guard is not None and guard.fired is not None:
-                        # preemption: synchronous snapshot, then exit with
-                        # the conventional 128+signum for the supervisor
+                        import signal as _signal
+
+                        # preemption: synchronous snapshot first, then exit
                         if self._ckpt_mgr is not None:
                             self.save_checkpoint(wait=True)
+                        if guard.fired == _signal.SIGINT:
+                            # a Ctrl-C should surface as KeyboardInterrupt to
+                            # the caller (REPL/script cleanup code), not kill
+                            # the interpreter — only SIGTERM (the preemption
+                            # path proper) exits with 128+signum for the
+                            # supervisor (ADVICE r4)
+                            raise KeyboardInterrupt
                         raise SystemExit(128 + guard.fired)
                     if end_trigger is not None and end_trigger(tstate):
                         break
-                if isinstance(feed, _DevicePrefetcher):
-                    feed.close()
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:
-                if isinstance(feed, _DevicePrefetcher):
-                    feed.close()
                 # failure-retry with checkpoint restore
                 # (Topology.scala:1180-1262 semantics)
                 if retries_left > 0 and self._ckpt_mgr is not None \
@@ -558,6 +567,12 @@ class Estimator:
                         self._train_step = self._build_train_step()
                     continue
                 raise
+            finally:
+                # close on EVERY exit — including KeyboardInterrupt/SystemExit
+                # (the preemption path), which would otherwise leak a spinning
+                # infeed worker thread in long-lived processes (ADVICE r4)
+                if isinstance(feed, _DevicePrefetcher):
+                    feed.close()
 
             self.epoch += 1
             epoch += 1
@@ -665,6 +680,18 @@ class Estimator:
         return validation_data[0], (validation_data[1]
                                     if len(validation_data) > 1 else None)
 
+    def _require_data(self, data: FeatureSet):
+        """Raise the descriptive empty-partition error BEFORE the first
+        next(iter(...)) peek, which would otherwise surface as a bare
+        StopIteration (ADVICE r4).  In multi-host runs an empty LOCAL
+        partition deadlocks the collective step, so the check is per
+        process."""
+        if data.size() <= 0:
+            raise ValueError(
+                "empty data partition on process "
+                f"{self.ctx.process_index}: every process must hold data "
+                "(got size()=0 — check FeatureSet.partition() counts)")
+
     def _batch_sizes(self, batch_size: int) -> Tuple[int, int]:
         """(global, per-process-feed) batch sizes: global rounded up to a
         data-axis multiple, feed = global / process_count (each host supplies
@@ -677,6 +704,7 @@ class Estimator:
     def evaluate(self, x, y=None, *, batch_size=32) -> Dict[str, float]:
         data = _as_feature_set(x, y)
         _, feed_bs = self._batch_sizes(batch_size)
+        self._require_data(data)
         first = next(iter(data.batches(feed_bs)))
         self._ensure_init(first[0])
         if self._eval_step is None:
@@ -704,9 +732,40 @@ class Estimator:
             out["loss"] = float(loss_sum) / w_sum
         return out
 
+    def _local_row_offset(self, batch) -> int:
+        """Global row index where this process's rows start in a data-sharded
+        batch, derived from the sharding's device→index map — NOT from
+        process_index, which silently returns other processes' rows under a
+        custom device permutation (ADVICE r4).  Requires the process's rows
+        to form one contiguous block (true for any process-major mesh);
+        raises otherwise instead of mis-slicing."""
+        leaf = jax.tree.leaves(batch)[0]
+        sh = getattr(leaf, "sharding", None)
+        if sh is None or not hasattr(sh, "devices_indices_map"):
+            return 0
+        n = leaf.shape[0]
+        pr = self.ctx.process_index
+        ranges = sorted({((idx[0].start or 0),
+                          (idx[0].stop if idx[0].stop is not None else n))
+                         for d, idx in sh.devices_indices_map(leaf.shape)
+                         .items() if d.process_index == pr})
+        merged: List[Tuple[int, int]] = []
+        for s, e in ranges:
+            if merged and s <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(e, merged[-1][1]))
+            else:
+                merged.append((s, e))
+        if len(merged) != 1:
+            raise ValueError(
+                "multi-host predict() needs each process's rows contiguous "
+                f"along the data axis (process-major mesh); process {pr} "
+                f"owns row ranges {merged}")
+        return merged[0][0]
+
     def predict(self, x, *, batch_size=128) -> np.ndarray:
         data = _as_feature_set(x, None)
         _, feed_bs = self._batch_sizes(batch_size)
+        self._require_data(data)
         first = next(iter(data.batches(feed_bs)))
         self._ensure_init(first[0])
         if self._predict_step is None:
@@ -716,26 +775,29 @@ class Estimator:
         feed = self._feed(self._sync_batch_count(
             data.batches(feed_bs, pad_final=True), feed_bs, data.size()),
             lambda b: (self._shard(b[0])[0], int(b[2].shape[0])))
-        pidx = self.ctx.process_index
 
-        def readback(yb, nb):
+        def readback(yb, nb, off):
             nonlocal n_left
             take = min(n_left, nb)
             if self.ctx.is_multi_host:
                 # replicated global output -> this process's row segment
                 outs.append(jax.tree.map(
-                    lambda a: np.asarray(a)[pidx * nb:pidx * nb + take], yb))
+                    lambda a: np.asarray(a)[off:off + take], yb))
             else:
                 outs.append(jax.tree.map(lambda a: np.asarray(a)[:take], yb))
             n_left -= take
 
         pending = None  # one-batch-lag readback: batch k's (blocking) host
+        off = None      # constant across batches (fixed shapes/sharding)
         try:            # copy overlaps batch k+1's device compute
             for sx, nb in feed:
+                if off is None:
+                    off = (self._local_row_offset(sx)
+                           if self.ctx.is_multi_host else 0)
                 yb = self._predict_step(self.params, self.state, sx)
                 if pending is not None:
                     readback(*pending)
-                pending = (yb, nb)
+                pending = (yb, nb, off)
         finally:
             if isinstance(feed, _DevicePrefetcher):
                 feed.close()

@@ -113,12 +113,13 @@ class InferenceModel:
         weights become int8 with per-output-channel scales; predict() then
         runs the quantized graph.
 
-        OPT-IN on TPU v5e (measured 2026-07-30, tools/int8_matrix.py): raw
+        OPT-IN on TPU v5e (re-measured 2026-07-30 round 5 with the
+        LICM-proof timing loop, bench.py bench_resnet50_int8): raw
         s8xs8->s32 kernels reach only ~1.0-1.2x the bf16 rate through this
-        XLA stack (bf16 already runs near the 197 TF/s nameplate; int8 does
-        NOT unlock a doubled MXU rate), and the per-layer quantize/clip/
-        dequant elementwise passes push the END-TO-END quantized ResNet-50 to
-        ~0.84x bf16 (bench.py resnet50_int8_speedup).  Unlike the reference's
+        XLA stack (tools/int8_matrix.py; bf16 already runs near the
+        197 TF/s nameplate — int8 does NOT unlock a doubled MXU rate), and
+        the per-layer quantize/clip/dequant elementwise passes push the
+        END-TO-END quantized ResNet-50 to 0.82x bf16.  Unlike the reference's
         AVX512-VNNI target, int8 here costs speed; accuracy parity holds
         (top-1 agreement 1.0).  Pass force=True to quantize anyway (memory
         footprint, numerics experiments)."""

@@ -35,21 +35,26 @@ def _cross_columns(cross_name: str, columns: dict) -> List[str]:
     split('_') silently matched nothing for e.g. 'education_id_occupation_id',
     leaving the cross feature constant)."""
     usable = {k for k, v in columns.items() if v is not None}
-    parts: List[str] = []
-    rest = cross_name
-    while rest:
-        tokens = rest.split("_")
-        for take in range(len(tokens), 0, -1):
-            cand = "_".join(tokens[:take])
+    tokens = cross_name.split("_")
+
+    def solve(i: int) -> Optional[List[str]]:
+        # longest-prefix first, but BACKTRACK on a failed suffix: with
+        # columns {'a','a_b','b_c'} the name 'a_b_c' must resolve as
+        # 'a'+'b_c' even though 'a_b' matches the longer prefix
+        if i == len(tokens):
+            return []
+        for take in range(len(tokens) - i, 0, -1):
+            cand = "_".join(tokens[i:i + take])
             # never match the whole cross name to itself (callers may pass it
             # as a None placeholder meaning "compute from parts")
             if cand in usable and cand != cross_name:
-                parts.append(cand)
-                rest = "_".join(tokens[take:])
-                break
-        else:
-            return []  # an unmatched leading token: unresolvable
-    return parts
+                rest = solve(i + take)
+                if rest is not None:
+                    return [cand] + rest
+        return None
+
+    out = solve(0)
+    return out if out is not None else []
 
 
 @dataclasses.dataclass
