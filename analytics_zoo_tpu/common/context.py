@@ -183,6 +183,20 @@ class ZooContext:
     def replicated_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    def batch_sharding_for(self, shape) -> NamedSharding:
+        """Sharding for one batch array: leading axis over `data`, and — when
+        the mesh has a seq axis > 1 (sequence-parallel training) — the second
+        (token) axis over `seq`, provided it divides evenly.  Arrays whose
+        token dim doesn't divide (e.g. (B, 1) labels, (B,) weights) stay
+        data-sharded only; ops/attention.py then rides the ring for the
+        sharded activations."""
+        rank = len(shape)
+        axes = [DATA_AXIS] + [None] * (rank - 1)
+        n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
+        if rank >= 2 and n_seq > 1 and shape[1] % n_seq == 0 and shape[1] > 1:
+            axes[1] = SEQ_AXIS
+        return NamedSharding(self.mesh, P(*axes))
+
     # -- rng ----------------------------------------------------------------
     def next_rng(self) -> jax.Array:
         with self._lock:
