@@ -26,7 +26,14 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
-def _pad_str(border_mode: str) -> str:
+def _pad_str(border_mode, ndim: int = 2):
+    """'same'/'valid', or explicit caffe-style padding: an int (symmetric all
+    spatial dims) or a per-dim tuple — returned as the [(lo, hi)] list
+    lax.conv_general_dilated takes."""
+    if isinstance(border_mode, int):
+        return [(border_mode, border_mode)] * ndim
+    if isinstance(border_mode, (tuple, list)):
+        return [(int(p), int(p)) for p in border_mode]
     if border_mode in ("same", "SAME"):
         return "SAME"
     if border_mode in ("valid", "VALID"):
@@ -107,7 +114,7 @@ class _ConvND(Layer):
                           -127, 127).astype(jnp.int8)
             acc = jax.lax.conv_general_dilated(
                 xq, params["W_q"], window_strides=self.subsample,
-                padding=_pad_str(self.border_mode), rhs_dilation=self.dilation,
+                padding=_pad_str(self.border_mode, self.ndim), rhs_dilation=self.dilation,
                 dimension_numbers=self._dn(), feature_group_count=self.groups,
                 preferred_element_type=jnp.int32)
             y = acc.astype(jnp.float32) * (s_x * params["s_w"])
@@ -116,7 +123,7 @@ class _ConvND(Layer):
             return self._from_tf(self.activation(y.astype(dtypes.param_dtype())))
         xw, W = dtypes.cast_compute(x, params["W"])
         y = jax.lax.conv_general_dilated(
-            xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode),
+            xw, W, window_strides=self.subsample, padding=_pad_str(self.border_mode, self.ndim),
             rhs_dilation=self.dilation, dimension_numbers=self._dn(),
             feature_group_count=self.groups,
             preferred_element_type=dtypes.conv_out_dtype())

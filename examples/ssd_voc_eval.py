@@ -32,7 +32,9 @@ VOC_CLASSES = ["aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car",
 
 
 def load_voc_subset(data_dir: str, image_size: int, limit: int):
-    """Real VOC: Annotations/*.xml + JPEGImages/*.jpg."""
+    """Real VOC: Annotations/*.xml + JPEGImages/*.jpg.  parse_voc_annotation
+    returns (boxes normalized, labels, difficult); the image filename is the
+    annotation's basename (VOC layout)."""
     import cv2
     from analytics_zoo_tpu.models.objectdetection import parse_voc_annotation
 
@@ -42,19 +44,17 @@ def load_voc_subset(data_dir: str, image_size: int, limit: int):
         return None
     images, gts = [], []
     for xml in xmls[:limit]:
-        ann = parse_voc_annotation(xml, class_to_id=cls_to_id)
-        img_path = os.path.join(data_dir, "JPEGImages", ann["filename"])
+        boxes, labels, difficult = parse_voc_annotation(
+            xml, class_to_id=cls_to_id)
+        stem = os.path.splitext(os.path.basename(xml))[0]
+        img_path = os.path.join(data_dir, "JPEGImages", stem + ".jpg")
         if not os.path.exists(img_path):
             continue
         img = cv2.imread(img_path)
-        h, w = img.shape[:2]
         img = cv2.cvtColor(cv2.resize(img, (image_size, image_size)),
                            cv2.COLOR_BGR2RGB).astype(np.float32) / 255.0
-        boxes = ann["boxes"].astype(np.float32)
-        boxes[:, [0, 2]] /= w          # normalize to [0,1]
-        boxes[:, [1, 3]] /= h
         images.append(img)
-        gts.append((boxes, ann["labels"]))
+        gts.append((boxes, labels, difficult))
     if not images:
         return None
     return np.stack(images), gts
@@ -90,15 +90,21 @@ def main():
     ap.add_argument("--limit", type=int, default=50)
     ap.add_argument("--image-size", type=int, default=96)
     ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--arch", choices=("compact", "vgg16"), default="compact",
+                    help="vgg16 = the REAL SSD-VGG16-300 (round 5); forces "
+                         "image size 300")
     args = ap.parse_args()
 
     import functools
 
     from analytics_zoo_tpu.estimator.estimator import Estimator
     from analytics_zoo_tpu.models.objectdetection import (PascalVocEvaluator,
-                                                          SSD, multibox_loss)
+                                                          SSD, SSDVGG,
+                                                          multibox_loss)
     from analytics_zoo_tpu.nn.optimizers import Adam
 
+    if args.arch == "vgg16":
+        args.image_size = 300
     real = load_voc_subset(args.data, args.image_size, args.limit) \
         if args.data else None
     if real is not None:
@@ -110,7 +116,10 @@ def main():
         n_classes = 3
         source = "synthetic rectangles fixture (zero-egress fallback)"
 
-    ssd = SSD(class_num=n_classes + 1, image_size=args.image_size)
+    if args.arch == "vgg16":
+        ssd = SSDVGG(class_num=n_classes + 1, resolution=300)
+    else:
+        ssd = SSD(class_num=n_classes + 1, image_size=args.image_size)
     targets = ssd.encode_targets([g[0] for g in gts], [g[1] for g in gts])
     est = Estimator(ssd.model, optimizer=Adam(lr=2e-3),
                     loss=functools.partial(multibox_loss,

@@ -69,8 +69,10 @@ def test_evaluator_protocols():
 
 
 def test_config_registry_and_detector_roundtrip(tmp_path, ctx):
-    cfg = ObjectDetectionConfig.get("ssd-mobilenet-300x300")
+    cfg = ObjectDetectionConfig.get("ssd-compact-small-288x288")
     assert cfg["class_num"] == 21 and cfg["label_map"][0] == "__background__"
+    # published names resolve to the REAL architecture (round 5)
+    assert ObjectDetectionConfig.get("ssd-vgg16-300x300")["arch"] == "vgg16"
     with pytest.raises(KeyError, match="unknown"):
         ObjectDetectionConfig.get("yolo-9000")
 
