@@ -107,21 +107,51 @@ class LSTM(_RNNBase):
 
 
 class GRU(_RNNBase):
+    """GRU in both reset conventions.
+
+    reset_after=False (default): keras-1/BigDL semantics — the reset gate
+    multiplies h BEFORE the candidate matmul, one fused bias.
+    reset_after=True (tf.keras/CuDNN semantics, round 5): the reset gate
+    multiplies the candidate's RECURRENT projection after the matmul, with
+    separate input ("b") and recurrent ("br") biases — `(r*h)@U` and
+    `r*(h@U)` are different linear algebra, so tf reset_after weights only
+    import exactly into this mode (keras_import.py)."""
+
     n_gates = 3
+
+    def __init__(self, output_dim, reset_after: bool = False, **kwargs):
+        super().__init__(output_dim, **kwargs)
+        self.reset_after = bool(reset_after)
+
+    def build(self, rng, input_shape):
+        p = super().build(rng, input_shape)
+        if self.reset_after:
+            p["br"] = jnp.zeros((self.n_gates * self.output_dim,),
+                                dtypes.param_dtype())
+        return p
 
     def _step(self, params, h, x_t):
         H = self.output_dim
         xw, Wx, Wh = dtypes.cast_compute(x_t, params["Wx"], params["Wh"])
         hw = dtypes.cast_compute(h)
         xz = jnp.matmul(xw, Wx, preferred_element_type=jnp.float32) + params["b"]
-        hz = jnp.matmul(hw, Wh[:, :2 * H], preferred_element_type=jnp.float32)
-        z = self.inner_activation(xz[:, :H] + hz[:, :H])
-        r = self.inner_activation(xz[:, H:2 * H] + hz[:, H:2 * H])
-        # reset gate applied to h BEFORE the candidate matmul (keras-1/BigDL
-        # GRU semantics, reset_after=False; verified vs tf.keras oracle)
-        rh = dtypes.cast_compute(r * h)
-        hc = jnp.matmul(rh, Wh[:, 2 * H:], preferred_element_type=jnp.float32)
-        hh = self.activation(xz[:, 2 * H:] + hc)
+        if self.reset_after:
+            hz = jnp.matmul(hw, Wh, preferred_element_type=jnp.float32) \
+                + params["br"]
+            z = self.inner_activation(xz[:, :H] + hz[:, :H])
+            r = self.inner_activation(xz[:, H:2 * H] + hz[:, H:2 * H])
+            hh = self.activation(xz[:, 2 * H:] + r * hz[:, 2 * H:])
+        else:
+            hz = jnp.matmul(hw, Wh[:, :2 * H],
+                            preferred_element_type=jnp.float32)
+            z = self.inner_activation(xz[:, :H] + hz[:, :H])
+            r = self.inner_activation(xz[:, H:2 * H] + hz[:, H:2 * H])
+            # reset gate applied to h BEFORE the candidate matmul (keras-1/
+            # BigDL GRU semantics; verified vs tf.keras oracle)
+            rh = dtypes.cast_compute(r * h)
+            hc = jnp.matmul(rh, Wh[:, 2 * H:],
+                            preferred_element_type=jnp.float32)
+            hh = self.activation(xz[:, 2 * H:] + hc)
         h_new = z * h + (1 - z) * hh
         return h_new, h_new
 
