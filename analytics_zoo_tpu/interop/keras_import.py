@@ -38,6 +38,24 @@ def _act_name(act) -> Optional[str]:
     return None if name == "linear" else name
 
 
+def _require_unit_dilation(tl):
+    rate = getattr(tl, "dilation_rate", 1)
+    rates = rate if isinstance(rate, (tuple, list)) else (rate,)
+    if any(int(r) != 1 for r in rates):
+        raise NotImplementedError(
+            f"{type(tl).__name__} with dilation_rate={rate}: the native "
+            "depthwise/separable convs do not support dilation")
+
+
+def _rnn_weights(tl, n: int):
+    wts = tl.get_weights()
+    if len(wts) < n:
+        raise NotImplementedError(
+            f"{type(tl).__name__} with use_bias=False has no native "
+            "conversion (native RNN cells always carry a bias)")
+    return wts
+
+
 def _convert_layer(tl, **kw):
     """One tf.keras layer -> (native layer, weights dict | None,
     state dict | None).  Raises NotImplementedError for unsupported types."""
@@ -53,7 +71,8 @@ def _convert_layer(tl, **kw):
         layer = C.Convolution2D(
             tl.filters, tl.kernel_size, activation=_act_name(tl.activation),
             border_mode=tl.padding, subsample=tl.strides,
-            dilation=tl.dilation_rate, bias=tl.use_bias, **kw)
+            dilation=tl.dilation_rate, groups=getattr(tl, "groups", 1),
+            bias=tl.use_bias, **kw)
         weights = {"W": tl.kernel.numpy()}
         if tl.use_bias:
             weights["b"] = tl.bias.numpy()
@@ -61,7 +80,8 @@ def _convert_layer(tl, **kw):
         layer = C.Convolution1D(
             tl.filters, tl.kernel_size[0],
             activation=_act_name(tl.activation), border_mode=tl.padding,
-            subsample=tl.strides[0], bias=tl.use_bias, **kw)
+            subsample=tl.strides[0], dilation=tl.dilation_rate,
+            bias=tl.use_bias, **kw)
         weights = {"W": tl.kernel.numpy()}
         if tl.use_bias:
             weights["b"] = tl.bias.numpy()
@@ -75,6 +95,7 @@ def _convert_layer(tl, **kw):
         if tl.use_bias:
             weights["b"] = tl.get_weights()[1]
     elif cls == "DepthwiseConv2D":
+        _require_unit_dilation(tl)
         layer = C.DepthwiseConvolution2D(
             tl.kernel_size, depth_multiplier=tl.depth_multiplier,
             activation=_act_name(tl.activation), subsample=tl.strides,
@@ -87,6 +108,7 @@ def _convert_layer(tl, **kw):
         if tl.use_bias:
             weights["b"] = wts[1]
     elif cls == "SeparableConv2D":
+        _require_unit_dilation(tl)
         layer = C.SeparableConvolution2D(
             tl.filters, tl.kernel_size, depth_multiplier=tl.depth_multiplier,
             activation=_act_name(tl.activation), subsample=tl.strides,
@@ -101,6 +123,16 @@ def _convert_layer(tl, **kw):
         layer = K.Embedding(tl.input_dim, tl.output_dim, **kw)
         weights = {"E": tl.embeddings.numpy()}
     elif cls == "BatchNormalization":
+        ax = tl.axis if isinstance(tl.axis, int) else list(tl.axis)[0]
+        rank = None
+        try:
+            rank = len(tl.input.shape)
+        except Exception:
+            pass
+        if ax != -1 and (rank is None or ax != rank - 1):
+            raise NotImplementedError(
+                f"BatchNormalization axis={tl.axis}: only last-axis "
+                "(channels_last) normalisation has a native conversion")
         layer = K.BatchNormalization(epsilon=tl.epsilon,
                                      momentum=tl.momentum, **kw)
         weights = {"gamma": tl.gamma.numpy(), "beta": tl.beta.numpy()}
@@ -118,8 +150,10 @@ def _convert_layer(tl, **kw):
         layer = R.LSTM(tl.units, activation=_act_name(tl.activation) or "tanh",
                        inner_activation=_act_name(tl.recurrent_activation)
                        or "sigmoid",
-                       return_sequences=tl.return_sequences, **kw)
-        wk, wr, b = tl.get_weights()
+                       return_sequences=tl.return_sequences,
+                       go_backwards=bool(getattr(tl, "go_backwards", False)),
+                       **kw)
+        wk, wr, b = _rnn_weights(tl, 3)
         weights = {"Wx": wk, "Wh": wr, "b": b}
     elif cls == "GRU":
         reset_after = bool(getattr(tl, "reset_after", False))
@@ -127,8 +161,10 @@ def _convert_layer(tl, **kw):
                       activation=_act_name(tl.activation) or "tanh",
                       inner_activation=_act_name(tl.recurrent_activation)
                       or "sigmoid",
-                      return_sequences=tl.return_sequences, **kw)
-        wts = tl.get_weights()
+                      return_sequences=tl.return_sequences,
+                      go_backwards=bool(getattr(tl, "go_backwards", False)),
+                      **kw)
+        wts = _rnn_weights(tl, 3)
         if reset_after:
             # bias pair (2, 3H): input bias + recurrent bias, imported
             # EXACTLY into the native reset_after cell (round 5)

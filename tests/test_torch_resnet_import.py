@@ -1,0 +1,140 @@
+"""Published-weights ResNet import (round 5, VERDICT r4 next #2): a
+torchvision-layout state_dict imports into the native `resnet()` graph and
+matches the torch model's eval-mode forward to 1e-4 — torch-aligned padding
+(padding="torch"), BN eps 1e-5, identity-shortcut fallback for basic blocks.
+
+The torch reference below replicates torchvision's ResNet module naming
+(conv1/bn1/layer{1..4}.{b}.conv{i}/downsample/fc) so its state_dict has the
+exact published key schema.  Reference: ImageClassificationConfig.scala:1-190
+(the registry whose names must resolve to the published architectures).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from analytics_zoo_tpu.models.imageclassification import (  # noqa: E402
+    _RESNET_SPECS, ImageClassifier, load_torch_resnet, resnet)
+
+
+class _BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        return torch.relu(h + idn)
+
+
+class _Bottleneck(nn.Module):
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * 4
+        self.conv1 = nn.Conv2d(cin, width, 1, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = torch.relu(self.bn2(self.conv2(h)))
+        h = self.bn3(self.conv3(h))
+        return torch.relu(h + idn)
+
+
+class _TorchResNet(nn.Module):
+    """torchvision-named ResNet (conv1/bn1/layer1../fc)."""
+
+    def __init__(self, kind, blocks, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        cin, width = 64, 64
+        for li, n in enumerate(blocks):
+            mods = []
+            for b in range(n):
+                stride = 2 if (b == 0 and li > 0) else 1
+                if kind == "bottleneck":
+                    mods.append(_Bottleneck(cin, width, stride))
+                    cin = width * 4
+                else:
+                    mods.append(_BasicBlock(cin, width, stride))
+                    cin = width
+            setattr(self, f"layer{li + 1}", nn.Sequential(*mods))
+            width *= 2
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        h = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for li in range(1, 5):
+            h = getattr(self, f"layer{li}")(h)
+        h = h.mean(dim=(2, 3))
+        return self.fc(h)
+
+
+def _randomize_bn_stats(m, rng):
+    for mod in m.modules():
+        if isinstance(mod, nn.BatchNorm2d):
+            mod.running_mean.copy_(torch.tensor(
+                rng.normal(0, 0.5, mod.running_mean.shape), dtype=torch.float))
+            mod.running_var.copy_(torch.tensor(
+                rng.uniform(0.5, 2.0, mod.running_var.shape),
+                dtype=torch.float))
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_torch_resnet_import_matches_eval_forward(rng, depth):
+    kind, blocks = _RESNET_SPECS[depth]
+    tm = _TorchResNet(kind, blocks, num_classes=10).eval()
+    _randomize_bn_stats(tm, rng)
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+
+    native = resnet(depth, num_classes=10, input_shape=(64, 64, 3),
+                    padding="torch")
+    load_torch_resnet(native, sd, name=f"resnet{depth}", blocks=blocks)
+
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        logits = tm(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = native.predict(x, batch_size=2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_image_classifier_facade_torch_padding(rng):
+    """ImageClassifier(padding='torch').load_torch_state_dict end to end."""
+    kind, blocks = _RESNET_SPECS[18]
+    tm = _TorchResNet(kind, blocks, num_classes=7).eval()
+    _randomize_bn_stats(tm, rng)
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    clf = ImageClassifier("resnet18", num_classes=7,
+                          input_shape=(64, 64, 3), padding="torch")
+    clf.load_torch_state_dict(sd)
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        logits = tm(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+    want = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    got = clf.predict(x, batch_size=2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
