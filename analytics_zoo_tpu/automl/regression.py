@@ -262,14 +262,23 @@ _build_lstm_model = _build_trial_model
 
 
 class TimeSequencePredictor:
+    """distributed=True (round 5) dispatches trials over jax.distributed
+    processes (MultiProcessSearchEngine): each process must have been
+    bootstrapped with a coordinator (ZooConf.coordinator_address) and should
+    build its training context over jax.local_devices() so trials stay
+    process-local; see scripts/launch-multihost.sh and
+    tests/automl_mp_worker.py."""
+
     def __init__(self, dt_col: str = "datetime", target_col: str = "value",
                  extra_features_col: Optional[Sequence[str]] = None,
-                 future_seq_len: int = 1, recipe: Optional[Recipe] = None):
+                 future_seq_len: int = 1, recipe: Optional[Recipe] = None,
+                 distributed: bool = False):
         self.dt_col = dt_col
         self.target_col = target_col
         self.extra = extra_features_col
         self.horizon = int(future_seq_len)
         self.recipe = recipe or RandomRecipe()
+        self.distributed = bool(distributed)
 
     _DEFAULT_DT = ("HOUR", "DAYOFWEEK", "WEEKEND")
 
@@ -278,6 +287,19 @@ class TimeSequencePredictor:
         return tuple(sel) if sel else self._DEFAULT_DT
 
     def _train_one(self, cfg: Dict, input_df: pd.DataFrame):
+        # Per-trial deterministic init seeded from the config CONTENTS, via
+        # an EXPLICIT PRNGKey (never the shared global context): a trial's
+        # result must not depend on which process, thread, or position in
+        # the run order executed it — the multi-process round-robin
+        # dispatch, thread-pooled engines, and the sequential loop all
+        # produce identical metrics, and the user's session seed is left
+        # untouched.
+        import json as _json
+        import zlib
+
+        import jax as _jax
+        trial_seed = zlib.crc32(_json.dumps(
+            {k: repr(v) for k, v in sorted(cfg.items())}).encode())
         ft = TimeSequenceFeatureTransformer(self.dt_col, self.target_col,
                                             self.extra)
         lookback = int(cfg["lookback"])
@@ -287,6 +309,7 @@ class TimeSequencePredictor:
         cfg = dict(cfg, horizon=self.horizon)
         model = _build_trial_model(cfg, input_shape=x.shape[1:])
         model.compile(optimizer=Adam(lr=float(cfg["lr"])), loss="mse")
+        model.init_weights(_jax.random.PRNGKey(trial_seed))
         model.fit(x, y, batch_size=int(cfg["batch_size"]),
                   nb_epoch=int(cfg["epochs"]), verbose=False)
         return model, ft, cfg, x, y, lookback
@@ -298,6 +321,13 @@ class TimeSequencePredictor:
                                                self.extra)
         space = self.recipe.search_space(probe.get_feature_list())
         engine = self.recipe.engine()
+        if self.distributed:
+            import jax
+
+            from analytics_zoo_tpu.automl.search import \
+                MultiProcessSearchEngine
+            if jax.process_count() > 1:
+                engine = MultiProcessSearchEngine(engine)
 
         def train_fn(cfg: Dict) -> float:
             model, ft, cfg, x, y, lookback = self._train_one(cfg, input_df)
@@ -315,6 +345,7 @@ class TimeSequencePredictor:
             return mse
 
         engine.run(train_fn, space)
+        self._last_trials = engine.trials
         best = engine.get_best_trial()
         # retrain best on full data for the pipeline
         model, ft, cfg, _, _, _ = self._train_one(best.config, input_df)

@@ -151,9 +151,12 @@ class RandomSearchEngine(SearchEngine):
         self.seed = seed
         self.parallelism = parallelism
 
-    def run(self, train_fn, space):
+    def sample_all(self, space: Dict) -> List[Dict]:
         rng = np.random.default_rng(self.seed)
-        configs = [sample_config(space, rng) for _ in range(self.n_trials)]
+        return [sample_config(space, rng) for _ in range(self.n_trials)]
+
+    def run(self, train_fn, space):
+        configs = self.sample_all(space)
         if self.parallelism > 1:
             with ThreadPoolExecutor(self.parallelism) as pool:
                 metrics = list(pool.map(train_fn, configs))
@@ -221,6 +224,73 @@ class GridRandomSearchEngine(SearchEngine):
                 metrics = list(pool.map(train_fn, configs))
         else:
             metrics = [train_fn(c) for c in configs]
+        self.trials = [Trial(c, float(m)) for c, m in zip(configs, metrics)]
+        return self.trials
+
+
+class MultiProcessSearchEngine(SearchEngine):
+    """Round-robin trial dispatch over jax.distributed processes (round 5 —
+    the RayTuneSearchEngine.py:133-150 cluster-`tune.run` analog without
+    Ray).
+
+    Every process derives the SAME deterministic config list from the
+    wrapped engine's `sample_all(space)` (shared seed); process p runs
+    trials p, p+N, p+2N, ... on its LOCAL devices, and the per-trial metrics
+    are exchanged with ONE `process_allgather` at the end — the only
+    cross-process communication in the whole search.  `train_fn` must be
+    process-local: build its training context over `jax.local_devices()`
+    (e.g. `init_context(devices=jax.local_devices())`) so no trial issues a
+    cross-process collective; trials on different hosts then run genuinely
+    in parallel.  Single-process runs degrade to the wrapped engine's plain
+    loop (optionally thread-pooled via the inner engine's own parallelism).
+    """
+
+    def __init__(self, inner: SearchEngine, mode: Optional[str] = None):
+        if not hasattr(inner, "sample_all"):
+            raise TypeError(
+                f"{type(inner).__name__} cannot pre-enumerate its configs "
+                "(no sample_all); use RandomSearchEngine or "
+                "GridRandomSearchEngine as the inner engine")
+        super().__init__(mode or inner.mode)
+        self.inner = inner
+
+    def run(self, train_fn, space):
+        import logging
+
+        import jax
+
+        pc, pi = jax.process_count(), jax.process_index()
+        if pc > 1:
+            from analytics_zoo_tpu.common.context import get_context
+            if get_context().is_multi_host:
+                # a global-mesh context would make every trial a collective
+                # program — different configs on different processes then
+                # issue mismatched collectives and the pod deadlocks
+                raise RuntimeError(
+                    "MultiProcessSearchEngine needs a PROCESS-LOCAL "
+                    "training context: call "
+                    "init_context(devices=jax.local_devices()) before the "
+                    "search (the current context's mesh spans "
+                    f"{get_context().process_count} processes)")
+        configs = self.inner.sample_all(space)
+        n = len(configs)
+        worst = math.inf if self.mode == "min" else -math.inf
+        metrics = np.full((n,), np.nan, np.float64)
+        for i in range(pi, n, pc):
+            try:
+                metrics[i] = float(train_fn(configs[i]))
+            except Exception as e:  # noqa: BLE001 — a dead trial must not
+                # strand the other processes in the final allgather
+                logging.getLogger(__name__).warning(
+                    "trial %d failed (%s: %s); scored as %s",
+                    i, type(e).__name__, e, worst)
+                metrics[i] = worst
+        if pc > 1:
+            from jax.experimental import multihost_utils
+            gathered = np.asarray(
+                multihost_utils.process_allgather(metrics))   # (pc, n)
+            # trial i ran on process i % pc
+            metrics = gathered[np.arange(n) % pc, np.arange(n)]
         self.trials = [Trial(c, float(m)) for c, m in zip(configs, metrics)]
         return self.trials
 

@@ -154,7 +154,14 @@ class ZooContext:
     # -- multi-host topology --------------------------------------------------
     @property
     def process_count(self) -> int:
-        return jax.process_count()
+        """Processes participating in THIS context's mesh — not
+        jax.process_count(): a context built over jax.local_devices() in a
+        multi-process world (e.g. a process-local AutoML trial,
+        MultiProcessSearchEngine) is single-host from the Estimator's point
+        of view, and must not split batches or take collective paths
+        (round 5 fix — the old global count silently halved the feed batch
+        of process-local trials)."""
+        return len({d.process_index for d in self.devices})
 
     @property
     def process_index(self) -> int:

@@ -219,11 +219,11 @@ class AutoTSTrainer:
     def __init__(self, dt_col: str = "datetime", target_col: str = "value",
                  horizon: int = 1,
                  extra_features_col: Optional[Sequence[str]] = None,
-                 recipe: Optional[Recipe] = None):
+                 recipe: Optional[Recipe] = None, distributed: bool = False):
         self._predictor = TimeSequencePredictor(
             dt_col=dt_col, target_col=target_col,
             extra_features_col=extra_features_col, future_seq_len=horizon,
-            recipe=recipe)
+            recipe=recipe, distributed=distributed)
 
     def fit(self, train_df: pd.DataFrame,
             validation_df: Optional[pd.DataFrame] = None) -> "TSPipeline":
