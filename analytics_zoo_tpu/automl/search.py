@@ -260,6 +260,12 @@ class MultiProcessSearchEngine(SearchEngine):
         import jax
 
         pc, pi = jax.process_count(), jax.process_index()
+        if pc == 1:
+            # single process: the wrapped engine's own loop (including its
+            # thread-pool parallelism) is strictly better than our
+            # sequential shard-of-everything
+            self.trials = self.inner.run(train_fn, space)
+            return self.trials
         if pc > 1:
             from analytics_zoo_tpu.common.context import get_context
             if get_context().is_multi_host:

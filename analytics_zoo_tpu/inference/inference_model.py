@@ -148,15 +148,52 @@ class InferenceModel:
         return self
 
     # -- predict --------------------------------------------------------------
-    def do_predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+    def _jitted_with_scales(self):
+        """Lazily-built dequantizing predict: the int8/uint8 batch is
+        TRANSFERRED in its compact dtype and multiplied by the per-row scale
+        on device (round 5 serving wire path) — 4x less host->device
+        traffic than shipping f32."""
+        if getattr(self, "_jitted_scaled", None) is None \
+                or getattr(self, "_jitted_scaled_base", None) \
+                is not self._jitted:
+            import jax.numpy as jnp
+            base = self._jitted
+            if hasattr(base, "lower"):        # a real jitted program
+
+                def fn(p, s, x, sc):
+                    xf = x.astype(jnp.float32) \
+                        * sc.reshape(sc.shape + (1,) * (x.ndim - 1))
+                    return base(p, s, xf)
+                self._jitted_scaled = jax.jit(fn)
+            else:
+                # un-jittable bridge path (e.g. TFNet lambda): dequantize on
+                # host — correctness over the transfer win
+                def fn(p, s, x, sc):
+                    xf = np.asarray(x, np.float32) * np.asarray(
+                        sc, np.float32).reshape(
+                            sc.shape + (1,) * (np.ndim(x) - 1))
+                    return base(p, s, xf)
+                self._jitted_scaled = fn
+            self._jitted_scaled_base = base
+        return self._jitted_scaled
+
+    def do_predict(self, x, batch_size: Optional[int] = None,
+                   scales: Optional[np.ndarray] = None) -> np.ndarray:
         """Batched forward with power-of-two bucket padding: at most
         log2(max_batch) compiled programs ever exist per input signature.
         Up to `supported_concurrent_num` batches stay in flight on the
-        device before their (blocking) host readback."""
+        device before their (blocking) host readback.
+
+        `scales` (round 5): per-row dequantization factors for a compact
+        int8/uint8 `x` — the rows reach the device in their wire dtype and
+        are dequantized there (single-input models only)."""
         if self._jitted is None:
             raise RuntimeError("load a model first")
         multi = isinstance(x, (list, tuple))
+        if scales is not None and multi:
+            raise ValueError("scales= supports single-input models only")
         xs = [np.asarray(a) for a in (x if multi else [x])]
+        sc = None if scales is None else np.asarray(scales, np.float32)
         n = xs[0].shape[0]
         step = batch_size or self.max_batch
         outs = []
@@ -177,9 +214,16 @@ class InferenceModel:
                         [c, np.zeros((bucket - take,) + c.shape[1:],
                                      c.dtype)])
                         for c in chunk]
-                arg = chunk if multi else chunk[0]
-                pending.append(
-                    (self._jitted(self._params, self._state, arg), take))
+                if sc is not None:
+                    schunk = np.concatenate(
+                        [sc[i:i + take],
+                         np.ones((bucket - take,), np.float32)])
+                    pending.append((self._jitted_with_scales()(
+                        self._params, self._state, chunk[0], schunk), take))
+                else:
+                    arg = chunk if multi else chunk[0]
+                    pending.append(
+                        (self._jitted(self._params, self._state, arg), take))
                 if len(pending) >= self.concurrent_num:
                     drain_one()
                 i += take

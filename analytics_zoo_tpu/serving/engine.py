@@ -17,7 +17,7 @@ import base64
 import json
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -25,13 +25,33 @@ from analytics_zoo_tpu.inference.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.queues import BaseQueue
 
 
-def default_preprocess(record: Dict) -> np.ndarray:
-    """base64 bytes -> decoded image CHW float (PreProcessing.scala:1-53) or raw
-    tensor passthrough for `data` records."""
+class QuantizedTensor(NamedTuple):
+    """A tensor kept in its compact integer dtype until it is ON the
+    accelerator (round 5): do_predict transfers the int8/uint8 bytes and
+    dequantizes (x * scale) inside the jitted program — 4x less
+    host->device traffic than f32, which is the binding constraint when the
+    device link (e.g. this environment's axon relay) is the bottleneck."""
+
+    data: np.ndarray      # int8 / uint8
+    scale: float
+
+
+def default_preprocess(record: Dict):
+    """base64 bytes -> decoded image float (PreProcessing.scala:1-53), a
+    QuantizedTensor for int8-wire / uint8-image records, or raw tensor
+    passthrough for `data` records."""
     if "image" in record:
         import cv2
         buf = np.frombuffer(base64.b64decode(record["image"]), np.uint8)
-        img = cv2.imdecode(buf, cv2.IMREAD_COLOR).astype(np.float32)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if record.get("u8"):
+            if "resize" in record:
+                h, w = record["resize"]
+                img = cv2.resize(img, (w, h))
+            return QuantizedTensor(np.asarray(img, np.uint8), 1.0)
+        # float path: convert BEFORE resizing (float interpolation), keeping
+        # pre-round-5 numerics byte-identical
+        img = img.astype(np.float32)
         if "resize" in record:
             h, w = record["resize"]
             img = cv2.resize(img, (w, h))
@@ -43,10 +63,12 @@ def default_preprocess(record: Dict) -> np.ndarray:
         # are read-only)
         arr = np.frombuffer(base64.b64decode(record["b64"]),
                             np.dtype(record.get("dtype", "<f4")))
-        arr = arr.astype(np.float32)
         if "shape" in record:
             arr = arr.reshape([int(s) for s in record["shape"]])
-        return arr
+        if "scale" in record:       # int8 wire: stay int8 until on device
+            return QuantizedTensor(arr.astype(np.int8),
+                                   float(record["scale"]))
+        return arr.astype(np.float32)
     if "data" in record:
         arr = np.asarray(record["data"], np.float32)
         if "shape" in record:
@@ -131,12 +153,22 @@ class ClusterServing:
         if not batch:
             return None
         ids = [rid for rid, _ in batch]
-        tensors = np.stack([self.preprocess(rec) for _, rec in batch])
-        return ids, tensors
+        items = [self.preprocess(rec) for _, rec in batch]
+        if all(isinstance(it, QuantizedTensor) for it in items):
+            # compact-dtype batch: ship the int8/uint8 bytes to the device,
+            # dequantize there (per-row scales)
+            tensors = np.stack([it.data for it in items])
+            scales = np.asarray([it.scale for it in items], np.float32)
+            return ids, tensors, scales
+        # mixed float/quantized batches dequantize the stragglers on host
+        tensors = np.stack([
+            it.data.astype(np.float32) * it.scale
+            if isinstance(it, QuantizedTensor) else it for it in items])
+        return ids, tensors, None
 
-    def _predict_and_write(self, ids, tensors) -> int:
+    def _predict_and_write(self, ids, tensors, scales=None) -> int:
         t0 = time.time()
-        probs = self.model.do_predict(tensors)
+        probs = self.model.do_predict(tensors, scales=scales)
         for rid, row in zip(ids, probs):
             self._put_result(rid,
                              {"value": self.postprocess(np.asarray(row))})
@@ -190,10 +222,10 @@ class ClusterServing:
         import queue as _q
         while not self._stop.is_set():
             try:
-                ids, tensors = self._staged.get(timeout=0.1)
+                ids, tensors, scales = self._staged.get(timeout=0.1)
             except _q.Empty:
                 continue
-            self._predict_and_write(ids, tensors)
+            self._predict_and_write(ids, tensors, scales)
 
     def shutdown(self):
         self._stop.set()

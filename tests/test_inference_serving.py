@@ -113,3 +113,106 @@ def test_serving_image_records(ctx):
     rec = {"image": base64.b64encode(buf.tobytes()).decode(), "resize": [4, 4]}
     out = default_preprocess(rec)
     assert out.shape == (4, 4, 3)
+
+
+# -- round 5: compressed / quantized wire formats -----------------------------
+
+def test_int8_tensor_wire_roundtrip():
+    """enqueue_tensor(wire='int8') -> QuantizedTensor with per-element error
+    <= scale/2; the tensor stays int8 through preprocessing."""
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.engine import (QuantizedTensor,
+                                                  default_preprocess)
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    q = InProcQueue()
+    g = np.random.default_rng(0)
+    x = (g.normal(size=(8, 8, 3)) * 3).astype(np.float32)
+    InputQueue(q).enqueue_tensor("t0", x, wire="int8")
+    ((_, rec),) = q.read_batch(1, 0.1)
+    qt = default_preprocess(rec)
+    assert isinstance(qt, QuantizedTensor)
+    assert qt.data.dtype == np.int8 and qt.data.shape == x.shape
+    err = np.abs(qt.data.astype(np.float32) * qt.scale - x)
+    assert float(err.max()) <= qt.scale / 2 + 1e-7
+    # 4x fewer payload bytes than the f32 wire
+    assert qt.data.nbytes * 4 == x.astype(np.float32).nbytes
+
+
+def test_jpeg_image_wire_and_uint8_device():
+    """enqueue_image(fmt='.jpg') decodes through the standard image path;
+    device_uint8 yields a QuantizedTensor(uint8, 1.0)."""
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.engine import (QuantizedTensor,
+                                                  default_preprocess)
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    q = InProcQueue()
+    g = np.random.default_rng(1)
+    img = g.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    InputQueue(q).enqueue_image("a", img, fmt=".jpg", quality=95)
+    InputQueue(q).enqueue_image("b", img, fmt=".jpg", device_uint8=True)
+    (_, ra), (_, rb) = q.read_batch(2, 0.1)
+    da = default_preprocess(ra)
+    assert da.dtype == np.float32 and da.shape == (32, 32, 3)
+    db = default_preprocess(rb)
+    assert isinstance(db, QuantizedTensor) and db.data.dtype == np.uint8
+    # jpeg q95 is lossy but close
+    assert float(np.abs(da - db.data.astype(np.float32)).mean()) < 1e-3
+
+
+def test_do_predict_scales_matches_host_dequant(ctx):
+    """int8 batch + per-row scales through do_predict == host-side dequant
+    through the float path."""
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense, Flatten
+
+    model = Sequential()
+    model.add(Flatten(input_shape=(4, 3)))
+    model.add(Dense(5, activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+
+    g = np.random.default_rng(2)
+    q = g.integers(-127, 127, (6, 4, 3)).astype(np.int8)
+    scales = g.uniform(0.01, 0.1, (6,)).astype(np.float32)
+    got = im.do_predict(q, scales=scales)
+    want = im.do_predict(q.astype(np.float32)
+                         * scales[:, None, None])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_serves_int8_records_end_to_end(ctx):
+    """Full engine loop over int8-wire records: results match f32 records to
+    quantization tolerance."""
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense, Flatten
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    model = Sequential()
+    model.add(Flatten(input_shape=(4, 3)))
+    model.add(Dense(5, activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+
+    q = InProcQueue()
+    serving = ClusterServing(im, q, params=ServingParams(batch_size=4))
+    cin, cout = InputQueue(q), OutputQueue(q)
+    g = np.random.default_rng(3)
+    xs = [g.normal(size=(4, 3)).astype(np.float32) for _ in range(4)]
+    uris_q = [cin.enqueue_tensor(f"q{i}", x, wire="int8")
+              for i, x in enumerate(xs)]
+    while serving.serve_once():
+        pass
+    uris_f = [cin.enqueue_tensor(f"f{i}", x) for i, x in enumerate(xs)]
+    while serving.serve_once():
+        pass
+    for uq, uf in zip(uris_q, uris_f):
+        rq = cout.query(uq, timeout_s=5)["value"]
+        rf = cout.query(uf, timeout_s=5)["value"]
+        assert rq[0][0] == rf[0][0]          # same top-1 class
+        assert abs(rq[0][1] - rf[0][1]) < 0.02

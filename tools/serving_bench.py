@@ -32,6 +32,12 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--wire", choices=("f32", "int8", "jpeg-u8"),
+                    default="f32",
+                    help="record wire format: raw f32 tensors, int8-"
+                         "quantized tensors (dequantized ON DEVICE, 4x "
+                         "less transfer), or JPEG images decoded to uint8 "
+                         "kept uint8 onto the device")
     args = ap.parse_args()
 
     from analytics_zoo_tpu.common import dtypes
@@ -63,8 +69,17 @@ def main():
     # cold trickle would make the engine predict partial batches across many
     # power-of-2 buckets, each paying a fresh XLA compile (minutes via the
     # relay) that has nothing to do with serving throughput
-    uris = [client_in.enqueue_tensor(f"img-{i}", img)
-            for i in range(args.n)]
+    if args.wire == "int8":
+        uris = [client_in.enqueue_tensor(f"img-{i}", img, wire="int8")
+                for i in range(args.n)]
+    elif args.wire == "jpeg-u8":
+        u8 = (img * 255).astype(np.uint8)
+        uris = [client_in.enqueue_image(f"img-{i}", u8, fmt=".jpg",
+                                        device_uint8=True)
+                for i in range(args.n)]
+    else:
+        uris = [client_in.enqueue_tensor(f"img-{i}", img)
+                for i in range(args.n)]
     t0 = time.time()
     serving.start()
     results = {}
@@ -80,6 +95,7 @@ def main():
     tput = scalars.get("Serving Throughput", [])
     out = {
         "model": f"resnet{args.depth}-{args.image}px",
+        "wire": args.wire,
         "records": len(results),
         "batch_size": args.batch,
         "wall_records_per_sec": round(args.n / dt, 1),
