@@ -1,0 +1,359 @@
+"""BigDL serialized `.model` reader (round 5, VERDICT r4 next #9).
+
+Reference parity: `Net.load` / `Net.loadBigDL`
+(pipeline/api/Net.scala:103-277) load BigDL `ModuleSerializer` protobuf
+artifacts — the format the reference's ENTIRE published model zoo ships in.
+This module is a dependency-free wire-format codec for that protobuf
+(`bigdl.proto` BigDLModule), in the same style as interop/onnx_pb.py and
+interop/caffe_pb.py: a generic varint/field reader plus just enough schema.
+
+Schema (validated against the reference's committed artifacts,
+zoo/src/test/resources/models/bigdl/bigdl_lenet.model):
+
+  BigDLModule: 1 name, 2 subModules (repeated), 3 weight (BigDLTensor),
+    4 bias, 5 preModules (repeated string), 6 nextModules, 7 moduleType,
+    8 attr (map<string, AttrValue>), 9 version, 10 train, 11 namePostfix,
+    12 id, 16 parameters (repeated BigDLTensor)
+  BigDLTensor: 1 datatype, 2 size (packed varint), 3 stride, 4 offset
+    (1-BASED), 5 dimension, 6 nElements, 8 storage (TensorStorage), 9 id
+  TensorStorage: 1 datatype, 2 float_data (packed f32), 3 double_data,
+    6 int_data, 9 id
+  AttrValue: 1 dataType, 10 tensorValue, 14 nameAttrListValue; weights are
+    DEDUPED through attr["global_storage"]'s NameAttrList: storage id ->
+    AttrValue(tensorValue) whose TensorStorage carries the actual floats —
+    module-level tensors reference storages by id only.
+
+`load_bigdl(path)` returns the module tree with materialized numpy
+weights; `bigdl_to_native(path)` additionally converts a supported chain
+(Linear, SpatialConvolution, SpatialMaxPooling/AveragePooling, Tanh, ReLU,
+Sigmoid, Reshape, LogSoftMax, Dropout, View) into a native Sequential in
+"th" (NCHW) layout with the artifact's weights attached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# -- generic protobuf wire reader ---------------------------------------------
+
+
+def _varint(b: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        x = b[i]
+        i += 1
+        out |= (x & 0x7F) << shift
+        if not x & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(b: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    i = 0
+    n = len(b)
+    while i < n:
+        tag, i = _varint(b, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(b, i)
+        elif wt == 1:
+            v, i = b[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _varint(b, i)
+            v, i = b[i:i + ln], i + ln
+        elif wt == 5:
+            v, i = b[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} at byte {i}")
+        yield fn, wt, v
+
+
+def _packed_varints(b: bytes) -> List[int]:
+    out, i = [], 0
+    while i < len(b):
+        v, i = _varint(b, i)
+        out.append(v)
+    return out
+
+
+# -- schema -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BigDLTensor:
+    size: List[int]
+    stride: List[int]
+    offset: int = 1                 # 1-based (BigDL Tensor convention)
+    storage_id: Optional[int] = None
+    data: Optional[np.ndarray] = None   # present when storage is inline
+
+    def materialize(self, storages: Dict[int, np.ndarray]) -> np.ndarray:
+        flat = self.data if self.data is not None \
+            else storages[self.storage_id]
+        n = int(np.prod(self.size)) if self.size else 1
+        start = max(self.offset - 1, 0)
+        return np.asarray(flat[start:start + n], np.float32) \
+            .reshape(self.size)
+
+
+@dataclasses.dataclass
+class BigDLModule:
+    name: str = ""
+    module_type: str = ""
+    sub_modules: List["BigDLModule"] = dataclasses.field(default_factory=list)
+    weight: Optional[np.ndarray] = None
+    bias: Optional[np.ndarray] = None
+    pre_modules: List[str] = dataclasses.field(default_factory=list)
+    next_modules: List[str] = dataclasses.field(default_factory=list)
+    version: str = ""
+
+    @property
+    def op(self) -> str:
+        return self.module_type.rsplit(".", 1)[-1]
+
+    def walk(self):
+        yield self
+        for s in self.sub_modules:
+            yield from s.walk()
+
+
+def _parse_storage(b: bytes) -> Tuple[Optional[int], Optional[np.ndarray]]:
+    sid = data = None
+    for fn, wt, v in _fields(b):
+        if fn == 2 and wt == 2:     # packed float_data
+            data = np.frombuffer(v, "<f4")
+        elif fn == 3 and wt == 2:   # packed double_data
+            data = np.frombuffer(v, "<f8").astype(np.float32)
+        elif fn == 9 and wt == 0:
+            sid = v
+    return sid, data
+
+
+def _parse_tensor(b: bytes) -> Tuple[BigDLTensor, Optional[Tuple[int, np.ndarray]]]:
+    t = BigDLTensor(size=[], stride=[])
+    inline = None
+    for fn, wt, v in _fields(b):
+        if fn == 2:
+            t.size = _packed_varints(v) if wt == 2 else t.size + [v]
+        elif fn == 3:
+            t.stride = _packed_varints(v) if wt == 2 else t.stride + [v]
+        elif fn == 4 and wt == 0:
+            t.offset = v
+        elif fn == 8 and wt == 2:
+            sid, data = _parse_storage(v)
+            t.storage_id = sid
+            if data is not None:
+                t.data = data
+                if sid is not None:
+                    inline = (sid, data)
+    return t, inline
+
+
+def _parse_attr_tensors(b: bytes, storages: Dict[int, np.ndarray]):
+    """Collect TensorStorages out of an AttrValue (field 10 tensorValue or
+    field 14 nameAttrList of nested AttrValues — the global_storage dedup
+    table)."""
+    for fn, wt, v in _fields(b):
+        if fn == 10 and wt == 2:                  # tensorValue
+            _, inline = _parse_tensor(v)
+            if inline:
+                storages[inline[0]] = inline[1]
+        elif fn == 14 and wt == 2:                # nameAttrList
+            for fn2, wt2, v2 in _fields(v):
+                if fn2 == 2 and wt2 == 2:         # map entry
+                    for fn3, wt3, v3 in _fields(v2):
+                        if fn3 == 2 and wt3 == 2:  # entry value: AttrValue
+                            _parse_attr_tensors(v3, storages)
+
+
+def _parse_module(b: bytes, storages: Dict[int, np.ndarray]) -> BigDLModule:
+    m = BigDLModule()
+    raw_tensors: List[Tuple[str, BigDLTensor]] = []
+    for fn, wt, v in _fields(b):
+        if fn == 1:
+            m.name = v.decode()
+        elif fn == 2:
+            m.sub_modules.append(_parse_module(v, storages))
+        elif fn == 3:
+            t, inline = _parse_tensor(v)
+            if inline:
+                storages[inline[0]] = inline[1]
+            raw_tensors.append(("weight", t))
+        elif fn == 4:
+            t, inline = _parse_tensor(v)
+            if inline:
+                storages[inline[0]] = inline[1]
+            raw_tensors.append(("bias", t))
+        elif fn == 5:
+            m.pre_modules.append(v.decode())
+        elif fn == 6:
+            m.next_modules.append(v.decode())
+        elif fn == 7:
+            m.module_type = v.decode()
+        elif fn == 8:
+            # attr map entry: harvest any tensor storages (global_storage)
+            for fn2, wt2, v2 in _fields(v):
+                if fn2 == 2 and wt2 == 2:
+                    _parse_attr_tensors(v2, storages)
+        elif fn == 9 and wt == 2:
+            m.version = v.decode()
+        elif fn == 16:
+            t, inline = _parse_tensor(v)
+            if inline:
+                storages[inline[0]] = inline[1]
+            raw_tensors.append((f"param{len(raw_tensors)}", t))
+    m._raw_tensors = raw_tensors
+    return m
+
+
+def load_bigdl(path: str) -> BigDLModule:
+    """Parse a BigDL .model file into a module tree with materialized numpy
+    weight/bias arrays."""
+    with open(path, "rb") as f:
+        data = f.read()
+    storages: Dict[int, np.ndarray] = {}
+    root = _parse_module(data, storages)
+
+    def materialize(m: BigDLModule):
+        named = {}
+        for kind, t in getattr(m, "_raw_tensors", []):
+            try:
+                named[kind] = t.materialize(storages)
+            except KeyError:
+                pass                  # storage id not present: skip
+        m.weight = named.get("weight")
+        m.bias = named.get("bias")
+        if m.weight is None:          # newer format: parameters list
+            params = [v for k, v in named.items() if k.startswith("param")]
+            if params:
+                m.weight = params[0]
+                if len(params) > 1:
+                    m.bias = params[1]
+        for s in m.sub_modules:
+            materialize(s)
+
+    materialize(root)
+    return root
+
+
+# -- native conversion --------------------------------------------------------
+
+def _chain_order(root: BigDLModule) -> List[BigDLModule]:
+    """Topological order of a single-chain graph, derived from preModules
+    edges (StaticGraph stores subModules in reverse execution order, and
+    the serialized nextModules field mirrors preModules in the committed
+    artifacts — successors must be reconstructed from the pre edges)."""
+    mods = {m.name: m for m in root.sub_modules}
+    succ: Dict[str, str] = {}
+    for m in root.sub_modules:
+        for p in m.pre_modules:
+            if p in succ:
+                raise NotImplementedError(
+                    "only single-chain BigDL graphs convert to native "
+                    f"Sequential; {p} has multiple successors")
+            succ[p] = m.name
+    start = [m for m in root.sub_modules if not m.pre_modules]
+    if len(start) != 1:
+        raise NotImplementedError(
+            "only single-chain BigDL graphs convert to native Sequential; "
+            f"found {len(start)} entry nodes")
+    order, cur = [], start[0]
+    seen = set()
+    while cur is not None and cur.name not in seen:
+        order.append(cur)
+        seen.add(cur.name)
+        nxt = succ.get(cur.name)
+        cur = mods[nxt] if nxt else None
+    if len(order) != len(mods):
+        raise NotImplementedError("graph is not a single chain")
+    return order
+
+
+def bigdl_to_native(path: str, input_shape: Tuple[int, ...]):
+    """Convert a supported BigDL artifact into a native Sequential in "th"
+    (NCHW) layout with the artifact's weights.  `input_shape` is the
+    (C, H, W) / (features,) shape the artifact's first REAL layer expects
+    (BigDL modules carry no input shape)."""
+    from analytics_zoo_tpu.nn.layers import conv as C
+    from analytics_zoo_tpu.nn.layers import core as K
+    from analytics_zoo_tpu.nn.layers import pooling as P
+    from analytics_zoo_tpu.nn.models import Sequential
+
+    root = load_bigdl(path)
+    chain = (_chain_order(root) if root.sub_modules
+             else [root])
+    model = Sequential(name="bigdl_import")
+    weights_map = {}
+    first = dict(input_shape=tuple(input_shape))
+    for m in chain:
+        op = m.op
+        kw = {"name": "bd_" + m.name, **first}
+        first = {}
+        if op == "Linear":
+            out_dim, in_dim = m.weight.shape
+            layer = K.Dense(out_dim, bias=m.bias is not None, **kw)
+            w = {"W": m.weight.T}
+            if m.bias is not None:
+                w["b"] = m.bias
+            weights_map[layer.name] = w
+        elif op == "SpatialConvolution":
+            # BigDL weight (group, out/g, in/g, kH, kW) -> HWIO
+            wt = m.weight
+            if wt.ndim == 5:
+                g, og, ig, kh, kw_ = wt.shape
+                if g != 1:
+                    raise NotImplementedError("grouped SpatialConvolution")
+                wt = wt.reshape(og, ig, kh, kw_)
+            og, ig, kh, kw_ = wt.shape
+            layer = C.Convolution2D(og, (kh, kw_), border_mode="valid",
+                                    bias=m.bias is not None,
+                                    dim_ordering="th", **kw)
+            w = {"W": wt.transpose(2, 3, 1, 0)}
+            if m.bias is not None:
+                w["b"] = m.bias
+            weights_map[layer.name] = w
+        elif op in ("SpatialMaxPooling", "SpatialAveragePooling"):
+            cls = (P.MaxPooling2D if op == "SpatialMaxPooling"
+                   else P.AveragePooling2D)
+            layer = cls(2, 2, dim_ordering="th", **kw)
+        elif op in ("Tanh", "ReLU", "Sigmoid"):
+            layer = K.Activation(op.lower(), **kw)
+        elif op == "LogSoftMax":
+            layer = K.Lambda(_log_softmax, **kw)
+        elif op in ("Reshape", "View"):
+            if not model.layers_list:
+                # a leading Reshape shapes the raw input (e.g. 784 ->
+                # (1,28,28)); the caller's input_shape already provides the
+                # shaped input, so it is an identity here
+                first = kw.pop("input_shape", None)
+                first = {} if first is None else {"input_shape": first}
+                continue
+            layer = K.Flatten(**kw)   # interior Reshape flattens for Linear
+        elif op == "Dropout":
+            layer = K.Dropout(0.5, **kw)
+        elif op == "Identity" or op == "Input":
+            continue
+        else:
+            raise NotImplementedError(
+                f"BigDL module {op} ({m.module_type}) has no native "
+                "conversion yet")
+        model.add(layer)
+
+    import jax
+    import jax.numpy as jnp
+    params, state = model.init(jax.random.PRNGKey(0), tuple(input_shape))
+    for lname, w in weights_map.items():
+        for k_, v in w.items():
+            params[lname][k_] = jnp.asarray(np.asarray(v, np.float32))
+    model._params, model._state = params, state
+    return model
+
+
+def _log_softmax(x):
+    import jax
+    return jax.nn.log_softmax(x, axis=-1)

@@ -1,0 +1,64 @@
+"""BigDL serialized `.model` reader (round 5, VERDICT r4 next #9):
+dependency-free protobuf codec validated against the reference's COMMITTED
+artifact (zoo/src/test/resources/models/bigdl/bigdl_lenet.model) — the
+published-zoo format Net.loadBigDL consumed (Net.scala:157-277).
+
+Skipped when the reference checkout isn't present.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+LENET = ("/root/reference/zoo/src/test/resources/models/bigdl/"
+         "bigdl_lenet.model")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(LENET),
+                                reason="reference artifact not available")
+
+
+def test_parse_module_tree_and_weights():
+    from analytics_zoo_tpu.interop.bigdl_loader import load_bigdl
+
+    root = load_bigdl(LENET)
+    assert root.module_type.endswith("StaticGraph")
+    mods = {m.name: m for m in root.sub_modules}
+    assert set(mods) == {"reshape1", "conv1_5x5", "tanh1", "pool1", "tanh2",
+                         "conv2_5x5", "pool3", "reshape2", "fc1", "tanh3",
+                         "fc2", "logSoftMax"}
+    # weights materialize through the deduped global_storage table with the
+    # documented shapes (BigDL conv (group, out/g, in/g, kH, kW))
+    assert mods["conv1_5x5"].weight.shape == (1, 6, 1, 5, 5)
+    assert mods["conv2_5x5"].weight.shape == (1, 12, 6, 5, 5)
+    assert mods["fc1"].weight.shape == (100, 192)
+    assert mods["fc2"].weight.shape == (5, 100)
+    assert mods["fc2"].bias.shape == (5,)
+    # real trained values, not zeros
+    assert float(np.abs(mods["fc1"].weight).mean()) > 1e-4
+
+
+def test_convert_to_native_and_forward():
+    from analytics_zoo_tpu.interop.bigdl_loader import (bigdl_to_native,
+                                                        load_bigdl)
+
+    model = bigdl_to_native(LENET, (1, 28, 28))
+    x = np.random.default_rng(0).normal(size=(2, 1, 28, 28)) \
+        .astype(np.float32)
+    y = model.predict(x, batch_size=2)
+    assert y.shape == (2, 5)
+    # LogSoftMax output: probabilities sum to 1
+    np.testing.assert_allclose(np.exp(y).sum(-1), 1.0, rtol=1e-5)
+    # the artifact's weights are attached (fc2 row 0 matches the parse)
+    root = load_bigdl(LENET)
+    fc2 = {m.name: m for m in root.sub_modules}["fc2"]
+    got = np.asarray(model.get_weights()["bd_fc2"]["W"])
+    np.testing.assert_allclose(got, fc2.weight.T, rtol=1e-6)
+
+
+def test_net_facade():
+    from analytics_zoo_tpu.nn.net import Net
+
+    model = Net.load_bigdl(LENET, (1, 28, 28))
+    assert model.predict(np.zeros((1, 1, 28, 28), np.float32),
+                         batch_size=1).shape == (1, 5)
