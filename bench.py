@@ -41,6 +41,7 @@ Reference harness analog: examples/vnni/bigdl/Perf.scala:26-66.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
@@ -70,6 +71,14 @@ def _steps_per_sec_two_point(run, trials, n_lo):
     """steps/sec from the (5n-n) time difference; run(n, seed) must vary the
     input data with seed so the relay cannot serve cached replies."""
     return _rate_two_point(run, 1.0, trials, n_lo)
+
+
+def _fresh(tree):
+    """Device-side copies for feeding a donating jit (donated buffers are
+    consumed per dispatch)."""
+    import jax
+    return jax.tree.map(lambda a: a.copy() if hasattr(a, "copy") else a,
+                        tree)
 
 
 def resnet50_model_flops(batch: int, num_classes: int = 1000) -> float:
@@ -113,7 +122,13 @@ def bench_resnet50(trials=3, with_ceiling=False):
             return p, o, s2
         return train_step
 
-    @jax.jit
+    # Donation (round 5): letting XLA reuse the params/opt-state buffers
+    # in place removes ~2 ms/step of layout copies at the loop carry
+    # (measured 47.35 -> 45.36 ms; the Estimator's train step already
+    # donates, the bench loop now matches).  Donated args are consumed, so
+    # each timing dispatch feeds fresh device copies — a per-dispatch cost
+    # the two-point method cancels.
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_loop(params, opt_state, state, n, seed):
         # One device-synthesized batch per call, derived from the seed so no
         # two timing dispatches are byte-identical (the relay must not serve
@@ -132,7 +147,8 @@ def bench_resnet50(trials=3, with_ceiling=False):
         return jax.tree.leaves(p)[0].sum()
 
     def run(n, seed=0):
-        float(train_loop(params, opt_state, state, n, seed))
+        float(train_loop(_fresh(params), _fresh(opt_state), _fresh(state),
+                         n, seed))
 
     steps_per_sec = _steps_per_sec_two_point(run, trials, n_lo=8)
 
@@ -310,7 +326,7 @@ def bench_bert(trials=3, batch=64, seq=128):
         opt = SGD(lr=0.01, momentum=0.9)
         opt_state = opt.init(params)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def loop(params, opt_state, n, seed):
             r1, r2 = jax.random.split(jax.random.PRNGKey(seed))
             ids = jax.random.randint(r1, (batch, seq), 0, V)
@@ -337,7 +353,7 @@ def bench_bert(trials=3, batch=64, seq=128):
             return jax.tree.leaves(p)[0].sum()
 
         def run(n, seed=0):
-            float(loop(params, opt_state, n, seed))
+            float(loop(_fresh(params), _fresh(opt_state), n, seed))
 
         rate = _steps_per_sec_two_point(run, trials, n_lo=4)
         flops = 3.0 * bert_model_flops(batch, seq)
@@ -403,7 +419,7 @@ def bench_ncf(trials=3):
 
     batch = 8192  # single-chip loop, as in bench_resnet50
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_loop(params, opt_state, state, n, seed):
         # device-synthesized ids, seed-varied per dispatch (no relay caching)
         ru, ri, rl = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -427,7 +443,8 @@ def bench_ncf(trials=3):
         return jax.tree.leaves(p)[0].sum()
 
     def run(n, seed=0):
-        float(train_loop(params, opt_state, state, n, seed))
+        float(train_loop(_fresh(params), _fresh(opt_state), _fresh(state),
+                         n, seed))
 
     steps_per_sec = _steps_per_sec_two_point(run, trials, n_lo=200)
     per_chip = batch * steps_per_sec

@@ -1,0 +1,160 @@
+"""Fused conv+BN-statistics Pallas probe (round 5, VERDICT r4 next #5).
+
+MFU_ANALYSIS.md §3 argues ResNet-50's ~11 GB/step of BatchNorm statistics
+traffic is irreducible because XLA computes BN sums in a SEPARATE pass that
+re-reads each conv's output from HBM.  This probe tests that claim on the
+bottleneck 1x1 conv shape (a 1x1 conv IS a matmul): can a Pallas kernel that
+computes the BN sums in the matmul's epilogue — while the output block is
+still in VMEM — remove the extra read pass?
+
+Shapes: x (B*56*56, 256) @ w (256, 64)  (ResNet-50 s1 bottleneck reduce, the
+(56,56,256) residual shape the VERDICT names).
+
+Measured configurations (two-point timing, LICM-proof: x is perturbed by the
+loop index):
+  * xla_matmul:        y = x @ w                        (the floor)
+  * xla_matmul_stats:  y = x @ w; sum/sumsq over rows   (XLA's separate pass)
+  * pallas_fused:      one kernel, stats accumulated in the epilogue
+
+Run: python tools/fused_bn_probe.py [--trials 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conv_ceiling import _rate_two_point  # noqa: E402
+
+B, HW, K, N = 128, 56 * 56, 256, 64
+M = B * HW
+
+
+def _fused_kernel(x_ref, w_ref, y_ref, s_ref, *, block_m: int):
+    """One M-block: y = x @ w, with per-channel sum and sum-of-squares
+    accumulated into s_ref (2, N) across the grid (same output block every
+    step — TPU grid steps run sequentially, so += accumulation is sound)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.bfloat16)
+    w = w_ref[...].astype(jnp.bfloat16)
+    y = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    s_ref[0, :] += y.sum(axis=0)
+    s_ref[1, :] += (y * y).sum(axis=0)
+
+
+def make_pallas_fused(block_m: int):
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def fn(x, w):
+        return pl.pallas_call(
+            functools.partial(_fused_kernel, block_m=block_m),
+            out_shape=[jax.ShapeDtypeStruct((M, N), x.dtype),
+                       jax.ShapeDtypeStruct((2, N), "float32")],
+            grid=(M // block_m,),
+            in_specs=[pl.BlockSpec((block_m, K), lambda i: (i, 0)),
+                      pl.BlockSpec((K, N), lambda i: (0, 0))],
+            out_specs=[pl.BlockSpec((block_m, N), lambda i: (i, 0)),
+                       pl.BlockSpec((2, N), lambda i: (0, 0))],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(x, w)
+    return fn
+
+
+def bench(mode, trials=3, block_m=2048):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (M, K), jnp.bfloat16)
+    w0 = jax.random.normal(jax.random.fold_in(key, 1), (K, N), jnp.bfloat16)
+    fused = make_pallas_fused(block_m) if mode == "pallas_fused" else None
+
+    def step(x, w):
+        """Returns (y, scalar-from-stats).  y MUST be materialized — the
+        loop carries it into the next iteration's input, modeling the real
+        BN situation where the conv output feeds the next layer (without
+        the carry, XLA fuses the reductions into the matmul epilogue and
+        never writes y at all, which is exactly the behavior a real ResNet
+        step cannot get because the next conv consumes y)."""
+        if mode == "xla_matmul":
+            y = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+            return y, jnp.float32(0.0)
+        if mode == "xla_matmul_stats":
+            y = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+            s = y.sum(axis=0)
+            ss = (y * y).sum(axis=0)
+            return y, s.sum() + ss.sum()
+        y, stats = fused(x, w)
+        return y.astype(jnp.float32), stats.sum()
+
+    @jax.jit
+    def loop(x, w, n, seed):
+        def body(i, carry):
+            acc, y_prev = carry
+            # x depends on the previous y: y must exist in HBM each iter
+            xi = x.at[:, :N].add(
+                (y_prev * 1e-7).astype(jnp.bfloat16)) \
+                + (seed * 1e-6 + i * 1e-9).astype(jnp.bfloat16)
+            y, s = step(xi, w)
+            return (acc + s + y[0, 0], y), None
+
+        def fbody(i, c):
+            return body(i, c)[0]
+        acc, y = jax.lax.fori_loop(
+            0, n, fbody, (jnp.float32(0.0), jnp.zeros((M, N), jnp.float32)))
+        return acc + y.sum()
+
+    def run(n, seed=0):
+        float(loop(x0, w0, n, jnp.float32(seed)))
+
+    # per-iter flops: 2*M*K*N matmul (stats flops negligible)
+    fl = 2.0 * M * K * N
+    rate = _rate_two_point(run, 1.0, trials, max(8, int(3e12 / fl)))
+    ms = 1000.0 / rate
+    return {"ms": round(ms, 4), "tflops": round(fl * rate / 1e12, 1),
+            # effective HBM bytes: x read (M*K*2) + y write (M*N*4) +
+            # [stats pass: y read again M*N*4]
+            "GBps_xy": round((M * K * 2 + M * N * 4) * rate / 1e9, 0)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--block-m", type=int, default=2048)
+    args = ap.parse_args()
+
+    out = {}
+    for mode in ("xla_matmul", "xla_matmul_stats", "pallas_fused"):
+        try:
+            out[mode] = bench(mode, args.trials, args.block_m)
+        except Exception as e:
+            out[mode] = f"error: {type(e).__name__}: {e}"[:160]
+        print(json.dumps({mode: out[mode]}), flush=True)
+    if isinstance(out.get("xla_matmul_stats"), dict) \
+            and isinstance(out.get("pallas_fused"), dict):
+        out["stats_pass_cost_ms"] = round(
+            out["xla_matmul_stats"]["ms"] - out["xla_matmul"]["ms"], 4)
+        out["fused_saving_ms"] = round(
+            out["xla_matmul_stats"]["ms"] - out["pallas_fused"]["ms"], 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
