@@ -274,6 +274,26 @@ def Cropping2D(cropping=((0, 0), (0, 0)), **kw):
     return _conv.Cropping2D(cropping, **kw)
 
 
+def Cropping1D(cropping=(1, 1), **kw):
+    return _conv.Cropping1D(cropping, **kw)
+
+
+def LocallyConnected1D(filters, kernel_size, strides=1, padding="valid",
+                       activation=None, use_bias=True,
+                       kernel_initializer="glorot_uniform", **kw):
+    if strides != 1 or padding != "valid":
+        raise NotImplementedError(
+            "LocallyConnected1D supports strides=1, padding='valid' "
+            "(the reference keras2 layer's defaults)")
+    return _conv.LocallyConnected1D(filters, kernel_size,
+                                    activation=activation, bias=use_bias,
+                                    init=kernel_initializer, **kw)
+
+
+def Softmax(**kw):
+    return _core.Activation("softmax", **kw)
+
+
 def LSTM(units, activation="tanh", recurrent_activation="hard_sigmoid",
          return_sequences=False, go_backwards=False, **kw):
     from analytics_zoo_tpu.nn.layers import recurrent as _rnn

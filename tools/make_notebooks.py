@@ -2,15 +2,37 @@
 the reference ships 20 notebook apps under /apps; these are the TPU-native
 equivalents of the strongest ones, built from the runnable examples).
 
-Run: python tools/make_notebooks.py   (writes apps/*.ipynb)
+Run: python tools/make_notebooks.py [--execute]   (writes apps/*.ipynb)
+
+--execute runs every generated notebook's code cells in order, in a fresh
+subprocess per notebook (8-device CPU mesh, like a kernel), and FAILS the
+generation if any cell raises — the committed notebooks are regenerated
+with this flag, so "executed end-to-end" is enforced, not claimed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXEC_STUB = r'''
+import json, sys, os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+nb = json.load(open(sys.argv[1]))
+os.chdir(os.path.dirname(os.path.abspath(sys.argv[1])))
+ns = {}
+for i, cell in enumerate(nb["cells"]):
+    if cell["cell_type"] != "code":
+        continue
+    exec(compile("".join(cell["source"]), f"cell{i}", "exec"), ns)
+print("NOTEBOOK OK:", sys.argv[1])
+'''
 
 
 def md(text):
@@ -296,14 +318,188 @@ print({k: round(float(v), 4) for k, v in res.items()})
 ]
 
 
+NOTEBOOKS["object-detection.ipynb"] = [
+    md("""# Object detection: SSD end to end
+
+The reference's `apps/object-detection` notebook rebuilt TPU-native: SSD
+graph + caffe-style prior matching + MultiBox loss (smooth-L1 + CE with 3:1
+hard negative mining) + decode/NMS + Pascal-VOC mAP protocols
+(`models/image/objectdetection`).
+
+This notebook trains the compact CI backbone on a planted-rectangles fixture
+(fast everywhere).  The REAL published architecture is one flag away:
+`SSDVGG(21, resolution=300)` is the exact VGG16-SSD-300 (8732 caffe priors,
+NormalizeScale, dilated fc6) — `examples/ssd_voc_eval.py --arch vgg16`
+trains it from scratch on this same fixture to **VOC07 mAP 0.954** on a TPU
+chip, and `load_torch_vgg16_backbone` imports published ImageNet weights."""),
+    BOOT,
+    md("## 1. Fixture with exact ground truth"),
+    code("""
+g = np.random.default_rng(0)
+n, S, n_classes = 48, 96, 3
+images = np.zeros((n, S, S, 3), np.float32)
+gts = []
+for i in range(n):
+    boxes, labels = [], []
+    for _ in range(int(g.integers(1, 3))):
+        cls = int(g.integers(1, n_classes + 1))
+        w, h = g.uniform(0.25, 0.5, 2)
+        x0, y0 = g.uniform(0.05, 0.9 - w), g.uniform(0.05, 0.9 - h)
+        images[i, int(y0*S):int((y0+h)*S), int(x0*S):int((x0+w)*S), cls-1] = g.uniform(0.7, 1.0)
+        boxes.append([x0, y0, x0 + w, y0 + h]); labels.append(cls)
+    gts.append((np.asarray(boxes, np.float32), np.asarray(labels, np.int64)))
+images += g.normal(0, 0.03, images.shape).astype(np.float32)
+images = images.clip(0, 1)
+"""),
+    md("## 2. SSD + encoded targets + MultiBox loss through the Estimator"),
+    code("""
+import functools
+from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.models.objectdetection import SSD, multibox_loss
+ssd = SSD(class_num=n_classes + 1, image_size=S)
+targets = ssd.encode_targets([gt[0] for gt in gts], [gt[1] for gt in gts])
+est = Estimator(ssd.model, optimizer="adam",
+                loss=functools.partial(multibox_loss, class_num=n_classes + 1))
+est.fit(images, targets, batch_size=16, epochs=10, verbose=False)
+ssd.model.set_weights(est.params, est.state)
+"""),
+    md("## 3. Detect + VOC mAP (07 and 12 protocols)"),
+    code("""
+from analytics_zoo_tpu.models.objectdetection import PascalVocEvaluator
+dets = ssd.detect(images, score_threshold=0.25)
+for use07 in (True, False):
+    ev = PascalVocEvaluator(num_classes=n_classes, use_07_metric=use07)
+    print("VOC07" if use07 else "VOC12", "mAP:",
+          round(ev.evaluate(dets, gts)["mAP"], 4))
+"""),
+]
+
+NOTEBOOKS["autots-forecasting.ipynb"] = [
+    md("""# AutoTS: automated time-series forecasting
+
+The reference's Zouwu/AutoTS story (`zouwu/autots`, RayTune-driven trial
+search) rebuilt TPU-native: `AutoTSTrainer` searches model configs
+(lookback, units, lr) with the native search engines, returns a deployable
+`TSPipeline`.
+
+Round-5 extra: `AutoTSTrainer(distributed=True)` dispatches trials
+round-robin over `jax.distributed` processes (each on its local devices,
+one allgather to merge) — the cluster `tune.run` analog without Ray."""),
+    BOOT,
+    md("## 1. A seasonal series as a DataFrame"),
+    code("""
+import pandas as pd
+g = np.random.default_rng(0)
+n = 600
+df = pd.DataFrame({
+    "datetime": pd.date_range("2021-01-01", periods=n, freq="h"),
+    "value": (np.sin(np.arange(n) / 12.0) + 0.3 * np.sin(np.arange(n) / 5.0)
+              + 0.05 * g.normal(size=n)).astype(np.float32)})
+train_df, val_df = df[:500], df[450:]
+"""),
+    md("## 2. Search and fit"),
+    code("""
+from analytics_zoo_tpu.automl.regression import Recipe
+from analytics_zoo_tpu.automl.search import Choice
+from analytics_zoo_tpu.zouwu.forecast import AutoTSTrainer
+
+class SmallSearch(Recipe):
+    n_trials = 4
+    def search_space(self, all_available_features=()):
+        return {"model": "LSTM", "lstm_units": Choice([8, 16]),
+                "lr": Choice([0.01, 0.003]), "lookback": Choice([12]),
+                "dropout": Choice([0.0]), "epochs": Choice([3]),
+                "batch_size": Choice([32])}
+
+trainer = AutoTSTrainer(dt_col="datetime", target_col="value", horizon=1,
+                        recipe=SmallSearch())
+pipeline = trainer.fit(train_df, val_df)
+"""),
+    md("## 3. Forecast with the fitted pipeline"),
+    code("""
+pred = pipeline.predict(val_df)
+actual = val_df["value"].to_numpy()[-len(pred):]
+mse = float(np.mean((pred[:, 0] - actual) ** 2))
+print("holdout MSE:", round(mse, 5))
+"""),
+]
+
+NOTEBOOKS["image-classification.ipynb"] = [
+    md("""# Image classification: the zoo facade
+
+The reference's `ImageClassifier` (config-by-name + matching preprocessing +
+predict over ImageSets, `models/image/imageclassification`) rebuilt
+TPU-native.  The facade builds the REAL ResNet-v1.5 graphs (18–152);
+round 5 added `padding="torch"` (exact torchvision geometry) and
+`load_torch_state_dict`, so published ImageNet weights import bit-faithfully
+— `tests/test_torch_resnet_import.py` proves torch-eval == native to 1e-4.
+
+This notebook trains a small ResNet on synthetic shapes and runs the
+ImageSet predict path."""),
+    BOOT,
+    md("## 1. A tiny labeled image problem"),
+    code("""
+g = np.random.default_rng(0)
+n, S, n_classes = 256, 32, 4
+images = g.normal(0, 0.1, (n, S, S, 3)).astype(np.float32)
+labels = g.integers(0, n_classes, n)
+for i, lab in enumerate(labels):     # class = which quadrant is bright
+    qy, qx = divmod(int(lab), 2)
+    images[i, qy*16:(qy+1)*16, qx*16:(qx+1)*16, :] += 0.8
+y = labels.astype(np.float32)[:, None]
+"""),
+    md("## 2. Build ResNet-18 (cifar stem) through the facade and train"),
+    code("""
+from analytics_zoo_tpu.models.imageclassification import ImageClassifier
+clf = ImageClassifier("resnet18", num_classes=n_classes,
+                      input_shape=(S, S, 3), stem="cifar")
+clf.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"])
+clf.fit(images[:224], y[:224], batch_size=32, nb_epoch=4, verbose=True)
+print(clf.evaluate(images[224:], y[224:], batch_size=32))
+"""),
+    md("## 3. Predict over an ImageSet (uint8 images, facade preprocessing)"),
+    code("""
+from analytics_zoo_tpu.feature.image import (ImageChannelNormalize,
+                                              ImageResize, ImageSet)
+from analytics_zoo_tpu.models.imageclassification import ImageClassificationConfig
+# register a preprocessing matching our tiny inputs: resize + rescale the
+# uint8 pixels back to the ~[0,1] training distribution
+ImageClassificationConfig.register(
+    "resnet18", ImageResize(S, S) >> ImageChannelNormalize(0, 0, 0, 255, 255, 255))
+clf.preprocessor = ImageClassificationConfig.preprocessing("resnet18")
+iset = ImageSet.from_arrays([(im * 255).clip(0, 255).astype(np.uint8)
+                             for im in images[:8]])
+idx, probs = clf.predict_image_set(iset, batch_size=8, top_k=2)
+agree = (idx[:, 0] == labels[:8]).mean()
+print("top-2 classes:", idx[:4].tolist(), " top-1 == label:", agree)
+assert agree >= 0.5, "facade predict path should track the trained labels"
+"""),
+]
+
+
 def main():
+    execute = "--execute" in sys.argv[1:]
     out_dir = os.path.join(ROOT, "apps")
     os.makedirs(out_dir, exist_ok=True)
+    stub = os.path.join(out_dir, "_exec_stub.py")
+    paths = []
     for name, cells in NOTEBOOKS.items():
         path = os.path.join(out_dir, name)
         with open(path, "w") as f:
             json.dump(notebook(cells), f, indent=1)
         print("wrote", path)
+        paths.append(path)
+    if execute:
+        with open(stub, "w") as f:
+            f.write(_EXEC_STUB)
+        try:
+            for path in paths:
+                r = subprocess.run([sys.executable, stub, path], timeout=900)
+                if r.returncode != 0:
+                    raise SystemExit(f"notebook FAILED: {path}")
+        finally:
+            os.remove(stub)
 
 
 if __name__ == "__main__":
