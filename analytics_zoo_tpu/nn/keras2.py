@@ -290,7 +290,11 @@ def LocallyConnected1D(filters, kernel_size, strides=1, padding="valid",
                                     init=kernel_initializer, **kw)
 
 
-def Softmax(**kw):
+def Softmax(axis=-1, **kw):
+    if axis != -1:
+        raise NotImplementedError(
+            "Softmax supports the last axis only (axis=-1); transpose the "
+            f"input instead of axis={axis!r}")
     return _core.Activation("softmax", **kw)
 
 

@@ -239,3 +239,47 @@ def test_pipeline_training_matches_sequential():
                                      pipelined=False),
         x, y, loss="sparse_categorical_crossentropy")
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_heterogeneous_pipeline_stages_match_sequential():
+    """pipeline_apply_stages (round 5): stages with DIFFERENT functions and
+    DIFFERENT param structures pipeline correctly, forward and backward."""
+    from analytics_zoo_tpu.parallel.pipeline import (
+        from_microbatches, pipeline_apply_stages, to_microbatches)
+    mesh = _mesh((2,), ("pipe",))
+    g = np.random.default_rng(9)
+    D = 8
+    p0 = {"W": jnp.asarray(g.normal(size=(D, D)) * 0.3, jnp.float32),
+          "b": jnp.asarray(g.normal(size=(D,)) * 0.1, jnp.float32)}
+    p1 = {"gate": {"A": jnp.asarray(g.normal(size=(D, D)) * 0.3,
+                                    jnp.float32)},
+          "scale": jnp.asarray(1.5, jnp.float32)}
+
+    def f0(p, x):
+        return jnp.tanh(x @ p["W"] + p["b"])
+
+    def f1(p, x):
+        return x * jax.nn.sigmoid(x @ p["gate"]["A"]) * p["scale"]
+
+    x = jnp.asarray(g.normal(size=(8, D)), jnp.float32)
+    xm = to_microbatches(x, 4)
+    y = from_microbatches(
+        pipeline_apply_stages([f0, f1], [p0, p1], xm, mesh))
+    expect = f1(p1, f0(p0, x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_pipe(params):
+        a, b = params
+        out = pipeline_apply_stages([f0, f1], [a, b], xm, mesh)
+        return jnp.sum(out ** 2)
+
+    def loss_seq(params):
+        a, b = params
+        return jnp.sum(f1(b, f0(a, x)) ** 2)
+
+    gp = jax.grad(loss_pipe)((p0, p1))
+    gs = jax.grad(loss_seq)((p0, p1))
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
