@@ -131,6 +131,29 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     return Model(input=inp, output=x, name=name)
 
 
+
+def _resnet_depth(model_name: str) -> int:
+    """Depth from a model name — handles both short names ("resnet50") and
+    the reference's published registry names
+    ("analytics-zoo_resnet-50_imagenet_0.1.0",
+    ImageClassificationConfig.scala:1-190).  Unknown names and ResNet
+    VARIANTS (wide/resnext — different architectures) raise a descriptive
+    error instead of silently building the wrong graph."""
+    import re
+    lower = model_name.lower()
+    if "resnext" in lower or "wide_resnet" in lower or "wide-resnet" in lower:
+        raise ValueError(
+            f"{model_name!r} is a ResNet VARIANT; only plain ResNet-v1.5 "
+            f"depths {sorted(_RESNET_SPECS)} are supported")
+    m = re.search(r"resnet[-_]?(\d+)", lower)
+    depth = int(m.group(1)) if m else None
+    if depth not in _RESNET_SPECS:
+        raise ValueError(
+            f"cannot resolve a supported ResNet depth from {model_name!r}; "
+            f"supported depths: {sorted(_RESNET_SPECS)}")
+    return depth
+
+
 class ImageClassificationConfig:
     """Per-model preprocessing registry (ImageClassificationConfig.scala:1-190)."""
 
@@ -240,7 +263,7 @@ class ImageClassifier(ZooModel):
         self.preprocessor = ImageClassificationConfig.preprocessing(model_name)
 
     def build_model(self) -> Model:
-        depth = int("".join(c for c in self.model_name if c.isdigit()) or 50)
+        depth = _resnet_depth(self.model_name)
         return resnet(depth, self.num_classes, self.input_shape,
                       stem=self.stem, padding=self.padding,
                       name=self.model_name)
@@ -258,7 +281,7 @@ class ImageClassifier(ZooModel):
                 "convs pad (0,1) where torch pads (1,1) — construct "
                 "ImageClassifier(..., padding='torch') for exact parity",
                 stacklevel=2)
-        depth = int("".join(c for c in self.model_name if c.isdigit()) or 50)
+        depth = _resnet_depth(self.model_name)
         load_torch_resnet(self.model, state_dict, name=self.model_name,
                           blocks=_RESNET_SPECS[depth][1], stem=self.stem,
                           bn_eps=1e-5 if self.padding == "torch" else 1e-3)
