@@ -105,9 +105,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
 
 
 def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
-               block_k: int, interpret: bool, emit_lse: bool = False):
+               block_k: int, interpret: bool, emit_lse: bool = False,
+               out_dtype=None):
     """Returns (out (B,H,T,D), lse (B,H,T) f32 | None).  lse is computed only
-    when emit_lse (the grad path) — the primal forward writes one output."""
+    when emit_lse (the grad path) — the primal forward writes one output.
+    out_dtype overrides the output dtype (default: q.dtype)."""
     B, H, T, D = q.shape
     # Pad each side of the sequence axis up to its own block grid: padded query
     # rows are sliced off the output; padded key rows are masked inside the
@@ -124,7 +126,8 @@ def _flash_fwd(q, k, v, causal: bool, scale: float, block_q: int,
     k3 = k.reshape(B * H, Tk_pad, D)
     v3 = v.reshape(B * H, Tk_pad, D)
     grid = (B * H, Tq_pad // block_q)
-    out_shape = [jax.ShapeDtypeStruct((B * H, Tq_pad, D), q.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tq_pad, D),
+                                      out_dtype or q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
     if emit_lse:
         out_shape.append(
@@ -235,7 +238,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal: bool, scale: float,
-               block_q: int, block_k: int, interpret: bool):
+               block_q: int, block_k: int, interpret: bool, g_lse=None):
+    """g_lse: optional cotangent of the per-row LSE output
+    (flash_attention_with_lse).  It enters the standard decomposition as a
+    delta shift: ds = p * (dp - delta + g_lse) — so the kernels are reused
+    unchanged with delta := rowsum(dO*O) - g_lse."""
     B, H, T, D = q.shape
     Tq_pad = -(-T // block_q) * block_q
     Tk_pad = -(-T // block_k) * block_k
@@ -243,6 +250,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, scale: float,
     kpad = [(0, 0), (0, 0), (0, Tk_pad - T), (0, 0)]
     # delta = rowsum(dO * O): cheap XLA elementwise, the only non-Pallas piece
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     if Tq_pad != T:
         q = jnp.pad(q, qpad)
         g = jnp.pad(g, qpad)
@@ -330,14 +339,53 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
-    """Pallas flash backward (dq kernel + dkv kernel); the bwd block sizes are
-    tuned independently of the forward's."""
+def _bwd_core(causal, scale, block_q, block_k, interpret, res, g_out,
+              g_lse=None):
+    """Shared Pallas backward (dq kernel + dkv kernel); the bwd block sizes
+    are tuned independently of the forward's.  g_lse, when given, is the
+    LSE-output cotangent (delta shift inside _flash_bwd)."""
     q, k, v, out, lse = res
     s, _, _, interp = _resolve(q, k, scale, block_q, block_k, interpret)
     bq = min(BWD_BLOCK_Q, q.shape[2])
     bk = min(BWD_BLOCK_K, k.shape[2])
-    return _flash_bwd(q, k, v, out, lse, g, causal, s, bq, bk, interp)
+    return _flash_bwd(q, k, v, out, lse, g_out, causal, s, bq, bk, interp,
+                      g_lse=g_lse)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+flash_attention.defvjp(_fwd_rule, _bwd_core)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 512, block_k: int = 1024,
+                             interpret: Optional[bool] = None,
+                             out_dtype=None):
+    """Like `flash_attention` but ALSO returns the per-row log-sum-exp
+    (B, H, T) f32 — the merge statistic that lets independently-computed
+    attention partials combine exactly (ring attention hops:
+    o = Σ_i o_i·exp(lse_i − logΣexp(lse)); parallel/ring_attention.py).
+    Fully differentiable in BOTH outputs: the lse cotangent enters the
+    backward as a delta shift (see _flash_bwd).  out_dtype (e.g. f32 for
+    bf16 inputs) keeps hop partials full-precision for exact accumulation."""
+    s, bq, bk, interp = _resolve(q, k, scale, block_q, block_k, interpret)
+    return _flash_fwd(q, k, v, causal, s, bq, bk, interp, emit_lse=True,
+                      out_dtype=out_dtype)
+
+
+def _lse_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+                  out_dtype):
+    s, bq, bk, interp = _resolve(q, k, scale, block_q, block_k, interpret)
+    out, lse = _flash_fwd(q, k, v, causal, s, bq, bk, interp, emit_lse=True,
+                          out_dtype=out_dtype)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _lse_bwd_rule(causal, scale, block_q, block_k, interpret, out_dtype,
+                  res, cts):
+    g_out, g_lse = cts
+    return _bwd_core(causal, scale, block_q, block_k, interpret, res, g_out,
+                     g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(_lse_fwd_rule, _lse_bwd_rule)

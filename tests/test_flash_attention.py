@@ -74,3 +74,45 @@ def test_flash_uneven_blocks():
     ref = _attention_xla(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_with_lse_matches_xla_forward_and_grad():
+    """flash_attention_with_lse (round 5): both outputs match an XLA
+    reference, INCLUDING gradients when the loss consumes the lse (its
+    cotangent enters the backward as a delta shift)."""
+    from analytics_zoo_tpu.ops.flash_attention import flash_attention_with_lse
+
+    g = np.random.default_rng(5)
+    B, H, T, D = 1, 2, 128, 32
+    q, k, v = (jnp.asarray(g.normal(size=(B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    ct_o = jnp.asarray(g.normal(size=(B, H, T, D)), jnp.float32)
+    ct_l = jnp.asarray(g.normal(size=(B, H, T)), jnp.float32)
+    scale = 1.0 / np.sqrt(D)
+
+    def ref(q, k, v):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd",
+                         jax.nn.softmax(logits, -1), v)
+        return out, lse
+
+    def loss_flash(q, k, v):
+        o, l = flash_attention_with_lse(q, k, v, False, None, 64, 64, True)
+        return jnp.sum(o * ct_o) + jnp.sum(l * ct_l)
+
+    def loss_ref(q, k, v):
+        o, l = ref(q, k, v)
+        return jnp.sum(o * ct_o) + jnp.sum(l * ct_l)
+
+    of, lf = flash_attention_with_lse(q, k, v, False, None, 64, 64, True)
+    orr, lr = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orr),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lr),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)

@@ -283,3 +283,36 @@ def test_heterogeneous_pipeline_stages_match_sequential():
     for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_impl_matches_full(causal):
+    """Ring attention with the Pallas flash hop body (round 5): hop partials
+    merged through their LSE statistics equal full attention, forward and
+    backward."""
+    mesh = _mesh((4,), ("seq",))
+    g = np.random.default_rng(11)
+    B, H, T, D = 1, 2, 128, 16
+    q, k, v = (jnp.asarray(g.normal(size=(B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    spec = NamedSharding(mesh, P(None, None, "seq", None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, causal=causal, impl="flash")
+    ref = _attention_xla(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+    ct = jnp.asarray(g.normal(size=(B, H, T, D)), jnp.float32)
+
+    def loss_ring(q_, k_, v_):
+        return jnp.sum(ring_attention(q_, k_, v_, mesh, causal=causal,
+                                      impl="flash") * ct)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(_attention_xla(q_, k_, v_, causal=causal) * ct)
+
+    gr = jax.grad(loss_ring, (0, 1, 2))(qs, ks, vs)
+    gx = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-3)
