@@ -19,11 +19,35 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_example(name, argv):
+    """Run an example's main(argv) in a FRESH subprocess (round 5): the
+    examples exercise long in-process train loops, and a native-level crash
+    (XLA CPU abort under host oversubscription was observed) must fail ONE
+    test, not kill the whole pytest interpreter.  The child returns main()'s
+    dict as a tagged JSON line."""
+    import json
+    import subprocess
+
     path = os.path.join(REPO, "examples", name)
-    spec = importlib.util.spec_from_file_location(f"example_{name}", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.main(argv)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import importlib.util, json, sys\n"
+        f"spec = importlib.util.spec_from_file_location('example', {path!r})\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        f"out = mod.main({argv!r})\n"
+        "print('EXAMPLE_JSON:' + json.dumps(out, default=float))\n")
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO, env=env, timeout=900)
+    assert r.returncode == 0, f"example {name} failed:\n{r.stderr[-3000:]}"
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("EXAMPLE_JSON:"):
+            return json.loads(line[len("EXAMPLE_JSON:"):])
+    raise AssertionError(f"example {name} produced no result line:\n"
+                         f"{r.stdout[-2000:]}")
 
 
 def test_ncf_example_quick():
