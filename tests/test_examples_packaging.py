@@ -6,7 +6,6 @@ end-to-end through the client queue surface; the Redis queue test is
 skip-guarded on a reachable server.
 """
 
-import importlib.util
 import os
 import sys
 import threading
@@ -30,7 +29,7 @@ def _run_example(name, argv):
     path = os.path.join(REPO, "examples", name)
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
-        "import importlib.util, json, sys\n"
+        "import importlib.util, json\n"
         f"spec = importlib.util.spec_from_file_location('example', {path!r})\n"
         "mod = importlib.util.module_from_spec(spec)\n"
         "spec.loader.exec_module(mod)\n"
@@ -42,7 +41,9 @@ def _run_example(name, argv):
         env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, cwd=REPO, env=env, timeout=900)
-    assert r.returncode == 0, f"example {name} failed:\n{r.stderr[-3000:]}"
+    assert r.returncode == 0, (f"example {name} failed:\n"
+                               f"stdout:\n{r.stdout[-1500:]}\n"
+                               f"stderr:\n{r.stderr[-2500:]}")
     for line in reversed(r.stdout.strip().splitlines()):
         if line.startswith("EXAMPLE_JSON:"):
             return json.loads(line[len("EXAMPLE_JSON:"):])
