@@ -120,6 +120,13 @@ class Trial:
     config: Dict
     metric: float
     extra: Optional[Dict] = None
+    # crash marker (ADVICE r5): a trial whose train_fn RAISED is scored ±inf
+    # so best-trial selection still works, but downstream consumers
+    # (predictor._last_trials, reports) can tell a crashed trial from a
+    # legitimately bad config.  `error` carries the exception text on the
+    # process that ran the trial (other processes only see the flag).
+    failed: bool = False
+    error: Optional[str] = None
 
 
 class SearchEngine:
@@ -255,8 +262,6 @@ class MultiProcessSearchEngine(SearchEngine):
         self.inner = inner
 
     def run(self, train_fn, space):
-        import logging
-
         import jax
 
         pc, pi = jax.process_count(), jax.process_index()
@@ -280,25 +285,44 @@ class MultiProcessSearchEngine(SearchEngine):
                     f"{get_context().process_count} processes)")
         configs = self.inner.sample_all(space)
         n = len(configs)
+        metrics, failed, errors = self._run_local(configs, train_fn, pi, pc)
+        if pc > 1:
+            from jax.experimental import multihost_utils
+            # still ONE allgather: metric and crash flag ride together
+            gathered = np.asarray(multihost_utils.process_allgather(
+                np.stack([metrics, failed])))                 # (pc, 2, n)
+            # trial i ran on process i % pc
+            owner = np.arange(n) % pc
+            metrics = gathered[owner, 0, np.arange(n)]
+            failed = gathered[owner, 1, np.arange(n)]
+        self.trials = [
+            Trial(c, float(m), failed=bool(f), error=errors.get(i))
+            for i, (c, m, f) in enumerate(zip(configs, metrics, failed))]
+        return self.trials
+
+    def _run_local(self, configs, train_fn, pi: int, pc: int):
+        """Run this process's slice of the config list.  A crashed trial is
+        scored as the worst possible metric AND flagged (ADVICE r5) so
+        consumers can tell it from a legitimately bad config; it must not
+        strand the other processes in the final allgather."""
+        import logging
+
+        n = len(configs)
         worst = math.inf if self.mode == "min" else -math.inf
         metrics = np.full((n,), np.nan, np.float64)
+        failed = np.zeros((n,), np.float64)
+        errors: Dict[int, str] = {}
         for i in range(pi, n, pc):
             try:
                 metrics[i] = float(train_fn(configs[i]))
-            except Exception as e:  # noqa: BLE001 — a dead trial must not
-                # strand the other processes in the final allgather
+            except Exception as e:  # noqa: BLE001
                 logging.getLogger(__name__).warning(
                     "trial %d failed (%s: %s); scored as %s",
                     i, type(e).__name__, e, worst)
                 metrics[i] = worst
-        if pc > 1:
-            from jax.experimental import multihost_utils
-            gathered = np.asarray(
-                multihost_utils.process_allgather(metrics))   # (pc, n)
-            # trial i ran on process i % pc
-            metrics = gathered[np.arange(n) % pc, np.arange(n)]
-        self.trials = [Trial(c, float(m)) for c, m in zip(configs, metrics)]
-        return self.trials
+                failed[i] = 1.0
+                errors[i] = f"{type(e).__name__}: {e}"
+        return metrics, failed, errors
 
 
 class BayesSearchEngine(SearchEngine):

@@ -51,6 +51,10 @@ class ZooConf:
     mesh_shape: Tuple[int, ...] = (-1,)
     # Training-loop behaviour
     failure_retry_times: int = 5          # bigdl.failure.retryTimes analog
+    # backoff base between checkpoint-restore retries (common/resilience.py
+    # RetryPolicy drives the schedule; a crashed device/runtime gets a
+    # breather instead of an immediate hot-loop restore)
+    failure_retry_backoff_s: float = 0.1
     checkpoint_keep: int = 3
     log_every_n_steps: int = 10
     # Data layer
@@ -190,17 +194,24 @@ class ZooContext:
     def replicated_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
-    def batch_sharding_for(self, shape) -> NamedSharding:
+    def batch_sharding_for(self, shape,
+                           token_len: Optional[int] = None) -> NamedSharding:
         """Sharding for one batch array: leading axis over `data`, and — when
         the mesh has a seq axis > 1 (sequence-parallel training) — the second
-        (token) axis over `seq`, provided it divides evenly.  Arrays whose
-        token dim doesn't divide (e.g. (B, 1) labels, (B,) weights) stay
+        (token) axis over `seq`, provided axis 1 IS the token axis:
+        ``token_len`` (the model input's axis-1 length, passed by the
+        Estimator feed) must match and divide evenly.  Divisibility alone is
+        not enough (ADVICE r5): a (B, C) one-hot label with C % n_seq == 0
+        must stay data-sharded, not silently resharded as if it carried
+        tokens.  Arrays whose axis 1 doesn't match (labels, weights) stay
         data-sharded only; ops/attention.py then rides the ring for the
         sharded activations."""
         rank = len(shape)
         axes = [DATA_AXIS] + [None] * (rank - 1)
         n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
-        if rank >= 2 and n_seq > 1 and shape[1] % n_seq == 0 and shape[1] > 1:
+        if (rank >= 2 and n_seq > 1 and token_len is not None
+                and shape[1] == token_len and shape[1] % n_seq == 0
+                and shape[1] > 1):
             axes[1] = SEQ_AXIS
         return NamedSharding(self.mesh, P(*axes))
 

@@ -1,0 +1,333 @@
+"""Failure machinery shared across layers (PR 1 tentpole).
+
+The reference leaned on Spark Structured Streaming restarts plus
+``bigdl.failure.retryTimes`` (SURVEY §2.8, §L3) for every failure path; the
+TPU-native engine has no Spark driver to resurrect dead workers, so the
+primitives live here as a plain library:
+
+- ``RetryPolicy``    — exponential backoff with deterministic jitter and an
+                       optional wall-clock deadline (serving result writes,
+                       trainer retry loop, client polling).
+- ``CircuitBreaker`` — trips OPEN after N consecutive failures, fails fast
+                       while open, HALF_OPEN probe after a cooldown
+                       (serving queue writes, RedisQueue reconnect).
+- ``SupervisedThread`` — daemon-worker wrapper that catches crashes, logs
+                       them, restarts with backoff up to a cap, and exposes
+                       ``health()`` (serving ``_pre_loop``/``_predict_loop``).
+- ``Deadline``       — tiny remaining-time helper (client ``get_result``,
+                       engine shutdown joins).
+
+Everything takes injectable ``clock``/``sleep`` so the fault-injection tests
+(`tests/test_resilience.py`, driven by `utils/chaos.FaultInjector`) run with
+no real waiting beyond a few milliseconds.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple, Type
+
+logger = logging.getLogger(__name__)
+
+
+class RetryExhausted(RuntimeError):
+    """Raised by RetryPolicy.call when retries/deadline run out; the original
+    exception rides along as ``__cause__``."""
+
+
+class Deadline:
+    """Remaining-wall-clock helper: ``Deadline(2.0)`` then ``remaining()``."""
+
+    def __init__(self, timeout_s: Optional[float],
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self.timeout_s = timeout_s
+
+    def remaining(self) -> float:
+        if self.timeout_s is None:
+            return float("inf")
+        return self.timeout_s - (self._clock() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+class RetryPolicy:
+    """Exponential backoff + deterministic jitter + optional deadline.
+
+    ``delay(attempt)`` is pure (same policy -> same schedule), so tests can
+    assert the exact backoff sequence.  Jitter is derived from the attempt
+    number, not a global RNG: retries stay reproducible under the chaos
+    harness.
+    """
+
+    def __init__(self, max_retries: int = 5, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, multiplier: float = 2.0,
+                 jitter: float = 0.0, deadline_s: Optional[float] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_retries = int(max_retries)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline_s = deadline_s
+        self._sleep = sleep
+        self._clock = clock
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (0-based)."""
+        d = min(self.base_delay_s * (self.multiplier ** attempt),
+                self.max_delay_s)
+        if self.jitter:
+            # deterministic per-attempt jitter in [0, jitter) * d — a cheap
+            # integer hash, NOT random.random(): reproducible schedules
+            frac = ((attempt * 2654435761) % 1000) / 1000.0
+            d *= 1.0 + self.jitter * frac
+        return d
+
+    def sleep(self, attempt: int) -> None:
+        self._sleep(self.delay(attempt))
+
+    def call(self, fn: Callable, *args,
+             retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             **kwargs):
+        """Run ``fn`` with up to ``max_retries`` retries.  Raises
+        ``RetryExhausted`` (chained to the last error) when attempts or the
+        deadline run out."""
+        deadline = Deadline(self.deadline_s, clock=self._clock)
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:
+                if attempt >= self.max_retries:
+                    raise RetryExhausted(
+                        f"{getattr(fn, '__name__', fn)!s} failed after "
+                        f"{attempt + 1} attempts") from e
+                d = self.delay(attempt)
+                if deadline.remaining() < d:
+                    raise RetryExhausted(
+                        f"{getattr(fn, '__name__', fn)!s} deadline "
+                        f"({self.deadline_s}s) exhausted after "
+                        f"{attempt + 1} attempts") from e
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self._sleep(d)
+                attempt += 1
+
+
+class CircuitBreakerOpen(RuntimeError):
+    """Fail-fast signal: the breaker is OPEN and the cooldown has not
+    elapsed — callers should shed load, not queue behind a dead backend."""
+
+
+class CircuitBreaker:
+    """Trip after ``failure_threshold`` CONSECUTIVE failures; while OPEN all
+    calls fail fast with ``CircuitBreakerOpen``; after ``cooldown_s`` one
+    probe call is let through (HALF_OPEN) — success closes the breaker,
+    failure re-opens it for another cooldown.  Thread-safe."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "breaker"):
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self.trip_count = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._state == self.OPEN and \
+                self._clock() - self._opened_at >= self.cooldown_s:
+            return self.HALF_OPEN
+        return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed (CLOSED, or the HALF_OPEN probe)."""
+        with self._lock:
+            s = self._state_locked()
+            if s == self.OPEN:
+                return False
+            if s == self.HALF_OPEN:
+                # claim the single probe slot: back to OPEN with a fresh
+                # window so concurrent callers keep failing fast
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                return True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive = 0
+            self._opened_at = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state != self.CLOSED or \
+                    self._consecutive >= self.failure_threshold:
+                if self._state == self.CLOSED:
+                    self.trip_count += 1
+                    logger.warning("circuit breaker %s tripped after %d "
+                                   "consecutive failures", self.name,
+                                   self._consecutive)
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+
+    def call(self, fn: Callable, *args, **kwargs):
+        if not self.allow():
+            raise CircuitBreakerOpen(
+                f"{self.name} open ({self._consecutive} consecutive "
+                "failures); cooling down")
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return out
+
+    def health(self) -> Dict:
+        with self._lock:
+            return {"state": self._state_locked(),
+                    "consecutive_failures": self._consecutive,
+                    "trip_count": self.trip_count}
+
+
+class SupervisedThread:
+    """Runs ``target()`` (a long-lived worker loop) on a daemon thread and
+    supervises it: an escaping exception is logged, the worker restarted
+    after an exponential backoff, up to ``max_restarts`` — then the worker is
+    marked FAILED instead of dying silently (the seed engine's two plain
+    daemon threads died on the first exception, leaving clients blocked
+    forever).
+
+    The worker should call ``heartbeat()`` whenever it makes progress so
+    ``health()`` can report staleness, and should return normally when the
+    shared ``stop_event`` is set.
+    """
+
+    STARTING, RUNNING, RESTARTING = "starting", "running", "restarting"
+    STOPPED, FAILED = "stopped", "failed"
+
+    def __init__(self, target: Callable[[], None], name: str = "worker",
+                 max_restarts: int = 5, backoff_s: float = 0.05,
+                 backoff_max_s: float = 1.0,
+                 healthy_after_s: float = 30.0,
+                 stop_event: Optional[threading.Event] = None,
+                 on_crash: Optional[Callable[[BaseException], None]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.target = target
+        self.name = name
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        # an incarnation that survived this long counts as recovered: the
+        # crash streak (and backoff) reset, so the cap bounds CONSECUTIVE
+        # crash-loops, not total faults over a weeks-long serving lifetime
+        self.healthy_after_s = float(healthy_after_s)
+        self.stop_event = stop_event or threading.Event()
+        self.on_crash = on_crash
+        self._clock = clock
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.state = self.STARTING
+        self.restart_count = 0          # lifetime total (health reporting)
+        self.crash_streak = 0           # consecutive; gates the cap
+        self.last_error: Optional[str] = None
+        self.last_progress: Optional[float] = None
+        self.started_at: Optional[float] = None
+
+    # -- worker-facing ------------------------------------------------------
+    def heartbeat(self) -> None:
+        self.last_progress = self._clock()
+
+    # -- supervisor ---------------------------------------------------------
+    def _run(self) -> None:
+        backoff = self.backoff_s
+        while not self.stop_event.is_set():
+            with self._lock:
+                self.state = self.RUNNING
+            incarnation_start = self._clock()
+            try:
+                self.target()
+                break                      # clean return: worker is done
+            except Exception as e:  # noqa: BLE001 — supervision boundary
+                recovered = (self._clock() - incarnation_start
+                             >= self.healthy_after_s)
+                with self._lock:
+                    self.restart_count += 1
+                    self.crash_streak = 1 if recovered \
+                        else self.crash_streak + 1
+                    self.last_error = f"{type(e).__name__}: {e}"
+                if recovered:
+                    backoff = self.backoff_s
+                logger.exception("supervised worker %r crashed "
+                                 "(streak %d/%d, lifetime %d)", self.name,
+                                 self.crash_streak, self.max_restarts,
+                                 self.restart_count)
+                if self.on_crash is not None:
+                    try:
+                        self.on_crash(e)
+                    except Exception:      # noqa: BLE001
+                        logger.exception("on_crash hook for %r failed",
+                                         self.name)
+                if self.crash_streak > self.max_restarts:
+                    with self._lock:
+                        self.state = self.FAILED
+                    logger.error("supervised worker %r exceeded restart cap "
+                                 "(%d consecutive crashes); giving up",
+                                 self.name, self.max_restarts)
+                    return
+                with self._lock:
+                    self.state = self.RESTARTING
+                self.stop_event.wait(backoff)
+                backoff = min(backoff * 2, self.backoff_max_s)
+        with self._lock:
+            if self.state != self.FAILED:
+                self.state = self.STOPPED
+
+    def start(self) -> "SupervisedThread":
+        self.started_at = self._clock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self.stop_event.set()
+        self.join(timeout)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def health(self) -> Dict:
+        with self._lock:
+            return {"name": self.name,
+                    "state": self.state,
+                    "alive": self.is_alive(),
+                    "restart_count": self.restart_count,
+                    "crash_streak": self.crash_streak,
+                    "last_error": self.last_error,
+                    "last_progress": self.last_progress}

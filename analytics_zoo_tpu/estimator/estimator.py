@@ -37,6 +37,7 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.common.resilience import RetryPolicy
 from analytics_zoo_tpu.common.triggers import EveryEpoch, TrainState, ZooTrigger
 from analytics_zoo_tpu.feature.dataset import ArrayFeatureSet, FeatureSet
 from analytics_zoo_tpu.nn import metrics as metrics_lib
@@ -281,8 +282,24 @@ class Estimator:
 
         Multi-host: each process feeds only its LOCAL rows; the global batch
         is assembled across processes (reference: each Spark executor's
-        partition feeds its local model replicas, wp-bigdl.md:113-160)."""
+        partition feeds its local model replicas, wp-bigdl.md:113-160).
+
+        The MODEL INPUT's axis-1 length is handed to `batch_sharding_for` as
+        the token length, so only arrays that actually carry the token axis
+        get seq-sharded (ADVICE r5: (B, C) labels whose C merely divides the
+        seq axis must stay data-sharded)."""
         multi = self.ctx.is_multi_host
+        # arrays[0] is the input x (possibly a pytree of inputs): its first
+        # rank>=2 leaf defines the token axis for this feed batch.  For
+        # multi-input models whose first leaf is not the token array this
+        # degrades to no seq-sharding (conservative; seq-parallel training
+        # currently feeds a single (B, T) token input)
+        token_len = None
+        if arrays and arrays[0] is not None:
+            for leaf in jax.tree.leaves(arrays[0]):
+                if np.ndim(leaf) >= 2:
+                    token_len = int(np.shape(leaf)[1])
+                    break
         out = []
         for a in arrays:
             if a is None:
@@ -291,13 +308,14 @@ class Estimator:
             if multi:
                 out.append(jax.tree.map(
                     lambda v: jax.make_array_from_process_local_data(
-                        self.ctx.batch_sharding_for(np.shape(v)),
+                        self.ctx.batch_sharding_for(np.shape(v), token_len),
                         np.asarray(v)), a))
             else:
                 out.append(jax.tree.map(
                     lambda v: jax.device_put(
                         jnp.asarray(v),
-                        self.ctx.batch_sharding_for(np.shape(v))), a))
+                        self.ctx.batch_sharding_for(np.shape(v), token_len)),
+                    a))
         return out
 
     def _shard_grouped(self, *arrays):
@@ -550,14 +568,21 @@ class Estimator:
                 raise
             except Exception as e:
                 # failure-retry with checkpoint restore
-                # (Topology.scala:1180-1262 semantics)
+                # (Topology.scala:1180-1262 semantics); the backoff between
+                # attempts comes from the shared RetryPolicy so a sick
+                # device/runtime gets a breather, not a hot-loop restore
                 if retries_left > 0 and self._ckpt_mgr is not None \
                         and self._ckpt_mgr.latest_step() is not None:
+                    conf = self.ctx.conf
+                    attempt = conf.failure_retry_times - retries_left
                     retries_left -= 1
                     logging.getLogger(__name__).warning(
                         "training step failed (%s: %s); restoring latest "
                         "checkpoint and retrying (%d retries left)",
                         type(e).__name__, e, retries_left)
+                    RetryPolicy(max_retries=conf.failure_retry_times,
+                                base_delay_s=conf.failure_retry_backoff_s
+                                ).sleep(attempt)
                     self._train_step = None
                     self._scan_step = None
                     self.maybe_restore_checkpoint()

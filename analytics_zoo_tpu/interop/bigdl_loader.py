@@ -111,6 +111,10 @@ class BigDLModule:
     pre_modules: List[str] = dataclasses.field(default_factory=list)
     next_modules: List[str] = dataclasses.field(default_factory=list)
     version: str = ""
+    # scalar entries of the serialized attr map (field 8): the constructor
+    # hyper-parameters ModuleSerializer wrote by reflection — kW/kH/dW/dH/
+    # padW/padH for pooling, kernelW/strideW/... for conv, initP for Dropout
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     @property
     def op(self) -> str:
@@ -152,6 +156,35 @@ def _parse_tensor(b: bytes) -> Tuple[BigDLTensor, Optional[Tuple[int, np.ndarray
                 if sid is not None:
                     inline = (sid, data)
     return t, inline
+
+
+def _signed(v: int, bits: int = 64) -> int:
+    """Protobuf int32/int64 varints are two's-complement 64-bit on the wire;
+    fold values above 2^63 back to their negative meaning."""
+    if v >= 1 << (bits - 1):
+        v -= 1 << bits
+    return v
+
+
+def _parse_attr_scalar(b: bytes):
+    """Scalar payload of an AttrValue (bigdl.proto oneof): int32 (3),
+    int64 (4), float (5), double (6), string (7), bool (8).  Returns None
+    for tensor/module/list-valued attrs — those aren't geometry scalars."""
+    import struct
+    for fn, wt, v in _fields(b):
+        if fn == 3 and wt == 0:
+            return _signed(v)
+        if fn == 4 and wt == 0:
+            return _signed(v)
+        if fn == 5 and wt == 5:
+            return float(struct.unpack("<f", v)[0])
+        if fn == 6 and wt == 1:
+            return float(struct.unpack("<d", v)[0])
+        if fn == 7 and wt == 2:
+            return v.decode()
+        if fn == 8 and wt == 0:
+            return bool(v)
+    return None
 
 
 def _parse_attr_tensors(b: bytes, storages: Dict[int, np.ndarray]):
@@ -197,9 +230,17 @@ def _parse_module(b: bytes, storages: Dict[int, np.ndarray]) -> BigDLModule:
             m.module_type = v.decode()
         elif fn == 8:
             # attr map entry: harvest any tensor storages (global_storage)
+            # AND keep scalar hyper-parameters (kW/dW/padW/..., geometry)
+            key = None
             for fn2, wt2, v2 in _fields(v):
-                if fn2 == 2 and wt2 == 2:
+                if fn2 == 1 and wt2 == 2:
+                    key = v2.decode()
+                elif fn2 == 2 and wt2 == 2:
                     _parse_attr_tensors(v2, storages)
+                    if key is not None:
+                        val = _parse_attr_scalar(v2)
+                        if val is not None:
+                            m.attrs[key] = val
         elif fn == 9 and wt == 2:
             m.version = v.decode()
         elif fn == 16:
@@ -242,6 +283,65 @@ def load_bigdl(path: str) -> BigDLModule:
 
 
 # -- native conversion --------------------------------------------------------
+
+def _attr(m: BigDLModule, *names):
+    """First present attr among alternate spellings (BigDL layer ctors are
+    inconsistent: pooling uses kW/dW, conv uses kernelW/strideW)."""
+    for n in names:
+        if n in m.attrs:
+            return m.attrs[n]
+    return None
+
+
+def _geometry(m: BigDLModule, spec: Dict[str, Tuple[str, ...]]) -> Dict[str, int]:
+    """Read required int geometry attrs; NotImplementedError (ADVICE r5)
+    when any is unreadable — converting with guessed defaults silently
+    produces a model that computes the wrong function."""
+    out = {}
+    for field, names in spec.items():
+        v = _attr(m, *names)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise NotImplementedError(
+                f"BigDL module {m.name} ({m.op}): geometry attr "
+                f"{'/'.join(names)} is unreadable (attrs: "
+                f"{sorted(m.attrs)}); refusing to convert with guessed "
+                "defaults")
+        out[field] = int(v)
+    return out
+
+
+def _check_same_pad(m: BigDLModule, ph: int, pw: int) -> bool:
+    """BigDL pad -1 means SAME — but only when BOTH axes say so; a mixed
+    -1/explicit pad has no native equivalent and guessing would silently
+    change the function."""
+    if (ph == -1) != (pw == -1):
+        raise NotImplementedError(
+            f"BigDL module {m.name} ({m.op}): mixed SAME(-1)/explicit "
+            f"padding (padH={ph}, padW={pw}) has no native conversion")
+    return ph == -1
+
+
+def _pool_padding(m: BigDLModule, geom: Dict[str, int]):
+    """(border_mode, padding) for the native pooling layer.  BigDL pad -1
+    means SAME; positive pads are explicit symmetric (caffe-style)."""
+    ph, pw = geom["padH"], geom["padW"]
+    if _check_same_pad(m, ph, pw):
+        return "same", None
+    if ph == 0 and pw == 0:
+        return "valid", None
+    return "valid", ((ph, ph), (pw, pw))
+
+
+def _conv_border(m: BigDLModule, geom: Dict[str, int]):
+    """border_mode for the native conv layer: 'valid', 'same' (pad -1), or
+    the explicit per-spatial-dim (padH, padW) tuple conv._pad_str accepts."""
+    ph, pw = geom["padH"], geom["padW"]
+    if _check_same_pad(m, ph, pw):
+        return "same"
+    if ph == 0 and pw == 0:
+        return "valid"
+    return (ph, pw)
+
 
 def _chain_order(root: BigDLModule) -> List[BigDLModule]:
     """Topological order of a single-chain graph, derived from preModules
@@ -310,7 +410,16 @@ def bigdl_to_native(path: str, input_shape: Tuple[int, ...]):
                     raise NotImplementedError("grouped SpatialConvolution")
                 wt = wt.reshape(og, ig, kh, kw_)
             og, ig, kh, kw_ = wt.shape
-            layer = C.Convolution2D(og, (kh, kw_), border_mode="valid",
+            # geometry from the serialized attr map (ADVICE r5): stride and
+            # padding were previously hardcoded to 1/valid, silently
+            # converting any non-LeNet artifact into the wrong function
+            geom = _geometry(m, {
+                "strideH": ("strideH", "dH"), "strideW": ("strideW", "dW"),
+                "padH": ("padH",), "padW": ("padW",)})
+            layer = C.Convolution2D(og, (kh, kw_),
+                                    border_mode=_conv_border(m, geom),
+                                    subsample=(geom["strideH"],
+                                               geom["strideW"]),
                                     bias=m.bias is not None,
                                     dim_ordering="th", **kw)
             w = {"W": wt.transpose(2, 3, 1, 0)}
@@ -320,7 +429,19 @@ def bigdl_to_native(path: str, input_shape: Tuple[int, ...]):
         elif op in ("SpatialMaxPooling", "SpatialAveragePooling"):
             cls = (P.MaxPooling2D if op == "SpatialMaxPooling"
                    else P.AveragePooling2D)
-            layer = cls(2, 2, dim_ordering="th", **kw)
+            geom = _geometry(m, {
+                "kH": ("kH", "kernelH"), "kW": ("kW", "kernelW"),
+                "dH": ("dH", "strideH"), "dW": ("dW", "strideW"),
+                "padH": ("padH",), "padW": ("padW",)})
+            if _attr(m, "ceilMode", "ceil_mode"):
+                raise NotImplementedError(
+                    f"BigDL module {m.name}: ceil-mode pooling has no "
+                    "native conversion yet")
+            border, padding = _pool_padding(m, geom)
+            layer = cls(pool_size=(geom["kH"], geom["kW"]),
+                        strides=(geom["dH"], geom["dW"]),
+                        border_mode=border, padding=padding,
+                        dim_ordering="th", **kw)
         elif op in ("Tanh", "ReLU", "Sigmoid"):
             layer = K.Activation(op.lower(), **kw)
         elif op == "LogSoftMax":
@@ -335,7 +456,9 @@ def bigdl_to_native(path: str, input_shape: Tuple[int, ...]):
                 continue
             layer = K.Flatten(**kw)   # interior Reshape flattens for Linear
         elif op == "Dropout":
-            layer = K.Dropout(0.5, **kw)
+            p_attr = _attr(m, "initP", "p")
+            layer = K.Dropout(float(p_attr) if p_attr is not None else 0.5,
+                              **kw)
         elif op == "Identity" or op == "Input":
             continue
         else:

@@ -9,10 +9,11 @@ from __future__ import annotations
 
 import base64
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common.resilience import Deadline
 from analytics_zoo_tpu.serving.queues import BaseQueue
 
 
@@ -89,13 +90,27 @@ class OutputQueue:
     def __init__(self, queue: BaseQueue):
         self.queue = queue
 
-    def query(self, uri: str, timeout_s: float = 0.0) -> Optional[Dict]:
-        deadline = time.time() + timeout_s
+    def query(self, uri: str, timeout_s: float = 0.0,
+              poll_s: float = 0.01) -> Optional[Dict]:
+        """Poll for the record's result until `timeout_s`.  A quarantined
+        record resolves to an ``{"error": ...}`` dict (engine dead-letter
+        path) — callers should check `is_error` rather than blocking on a
+        value that will never arrive."""
+        deadline = Deadline(timeout_s)
         while True:
             res = self.queue.get_result(uri)
-            if res is not None or time.time() >= deadline:
+            if res is not None or deadline.expired():
                 return res
-            time.sleep(0.01)
+            time.sleep(min(poll_s, max(deadline.remaining(), 0.001)))
 
     def dequeue(self, uris) -> Dict[str, Dict]:
         return {u: self.queue.get_result(u) for u in uris}
+
+    @staticmethod
+    def is_error(result: Optional[Dict]) -> bool:
+        """True when a result is a dead-letter error marker."""
+        return isinstance(result, dict) and "error" in result
+
+    def dead_letters(self) -> List[Dict]:
+        """Quarantined records (uri + error + offending record when small)."""
+        return self.queue.dead_letters()

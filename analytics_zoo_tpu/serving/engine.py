@@ -9,20 +9,36 @@ the result table with back-pressure, XTRIM memory guard, and throughput scalars
 TPU-native: the "broadcast model" is just the jitted predict function; batching pads to
 power-of-two buckets (InferenceModel) so the compile cache stays tiny; the micro-batch
 loop is a plain thread, not a Spark Structured Streaming job.
+
+Resilience (PR 1): the reference delegated failure recovery to Spark
+Structured Streaming restarts; here the two worker loops run under
+`SupervisedThread` (crash -> log -> backoff -> restart, capped), one
+malformed record quarantines ONLY itself to the queue's dead-letter channel
+(the client sees an `{"error": ...}` result instead of hanging), a predict
+crash bisects the batch to isolate the poison input, and result writes go
+through a `RetryPolicy` + `CircuitBreaker` instead of the old ad-hoc loop.
+`ClusterServing.health()` reports worker/breaker/dead-letter state.
 """
 
 from __future__ import annotations
 
 import base64
-import json
+import logging
 import threading
 import time
+from queue import Full as _FULL
 from typing import Callable, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                 CircuitBreakerOpen,
+                                                 RetryPolicy,
+                                                 SupervisedThread)
 from analytics_zoo_tpu.inference.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.queues import BaseQueue
+
+logger = logging.getLogger(__name__)
 
 
 class QuantizedTensor(NamedTuple):
@@ -65,9 +81,14 @@ def default_preprocess(record: Dict):
                             np.dtype(record.get("dtype", "<f4")))
         if "shape" in record:
             arr = arr.reshape([int(s) for s in record["shape"]])
-        if "scale" in record:       # int8 wire: stay int8 until on device
-            return QuantizedTensor(arr.astype(np.int8),
-                                   float(record["scale"]))
+        if "scale" in record:
+            # int8 wire: stay int8 until on device.  Gated on the declared
+            # dtype (ADVICE r5): a float record carrying a stray `scale`
+            # must be dequantized on host, not truncated by astype(int8).
+            if record.get("dtype") == "<i1":
+                return QuantizedTensor(arr.astype(np.int8),
+                                       float(record["scale"]))
+            return arr.astype(np.float32) * float(record["scale"])
         return arr.astype(np.float32)
     if "data" in record:
         arr = np.asarray(record["data"], np.float32)
@@ -90,7 +111,11 @@ class ServingParams:
                  poll_timeout_s: float = 0.05, stream_max_len: int = 100000,
                  filter_threshold: Optional[float] = None,
                  write_retries: int = 5, write_backoff_s: float = 0.05,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 max_worker_restarts: int = 5,
+                 worker_backoff_s: float = 0.05,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 0.5):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -102,16 +127,37 @@ class ServingParams:
         # staged micro-batches between the host preprocess thread and the
         # device predict thread; bounds memory AND provides backpressure
         self.pipeline_depth = pipeline_depth
+        # worker supervision + queue-write circuit breaker (PR 1 resilience)
+        self.max_worker_restarts = max_worker_restarts
+        self.worker_backoff_s = worker_backoff_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+
+    @classmethod
+    def from_dict(cls, p: Dict) -> "ServingParams":
+        """The one params-dict parser (config.yaml `params:` section) —
+        manager.serving_params and from_yaml both delegate here so no
+        surface silently drops keys."""
+        return cls(
+            batch_size=int(p.get("batch_size", 4)),
+            top_n=int(p.get("top_n", 5)),
+            poll_timeout_s=float(p.get("poll_timeout_s", 0.05)),
+            stream_max_len=int(p.get("stream_max_len", 100000)),
+            filter_threshold=p.get("filter_threshold"),
+            write_retries=int(p.get("write_retries", 5)),
+            write_backoff_s=float(p.get("write_backoff_s", 0.05)),
+            pipeline_depth=int(p.get("pipeline_depth", 2)),
+            max_worker_restarts=int(p.get("max_worker_restarts", 5)),
+            worker_backoff_s=float(p.get("worker_backoff_s", 0.05)),
+            breaker_threshold=int(p.get("breaker_threshold", 5)),
+            breaker_cooldown_s=float(p.get("breaker_cooldown_s", 0.5)))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
         import yaml
         with open(path) as f:
             cfg = yaml.safe_load(f) or {}
-        params = cfg.get("params", {})
-        return ServingParams(
-            batch_size=int(params.get("batch_size", 4)),
-            top_n=int(params.get("top_n", 5)))
+        return ServingParams.from_dict(cfg.get("params", {}))
 
 
 class ClusterServing:
@@ -129,6 +175,22 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.total_records = 0
+        self.dead_lettered = 0
+        p = self.params
+        self._write_retry = RetryPolicy(max_retries=p.write_retries,
+                                        base_delay_s=p.write_backoff_s)
+        self._breaker = CircuitBreaker(failure_threshold=p.breaker_threshold,
+                                       cooldown_s=p.breaker_cooldown_s,
+                                       name="result-write")
+        # separate breaker for dead-letter writes: sharing the result-write
+        # breaker would let a succeeding put_error reset the put_result
+        # failure streak (and vice versa) — with the store fully down, this
+        # one trips too and bounds the per-record cost of quarantining
+        self._dead_breaker = CircuitBreaker(
+            failure_threshold=p.breaker_threshold,
+            cooldown_s=p.breaker_cooldown_s, name="dead-letter-write")
+        self._pre_sup: Optional[SupervisedThread] = None
+        self._predict_sup: Optional[SupervisedThread] = None
         self._tb = None
         if tensorboard_dir:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
@@ -136,24 +198,34 @@ class ClusterServing:
 
     # -- result write with backpressure (ClusterServing.scala:276-307) -------
     def _put_result(self, rid, value):
-        backoff = self.params.write_backoff_s
-        for attempt in range(self.params.write_retries + 1):
-            try:
-                self.queue.put_result(rid, value)
-                return
-            except Exception:
-                if attempt == self.params.write_retries:
-                    raise
-                time.sleep(backoff)
-                backoff *= 2           # blocking retry: upstream reads stall
+        """Retry with backoff (blocking: upstream reads stall), behind a
+        circuit breaker — a dead result store fails fast instead of making
+        every batch grind through the full retry schedule."""
+        self._breaker.call(self._write_retry.call,
+                           self.queue.put_result, rid, value)
 
-    def _read_and_preprocess(self):
-        batch = self.queue.read_batch(self.params.batch_size,
-                                      self.params.poll_timeout_s)
-        if not batch:
-            return None
-        ids = [rid for rid, _ in batch]
-        items = [self.preprocess(rec) for _, rec in batch]
+    def _quarantine(self, rid, stage: str, exc: BaseException,
+                    record: Optional[Dict] = None):
+        """Per-record fault isolation: the poisoned record gets an error
+        RESULT (client unblocks and sees the failure) plus a dead-letter
+        entry; the rest of its micro-batch proceeds untouched."""
+        self.dead_lettered += 1
+        msg = f"{stage}: {type(exc).__name__}: {exc}"
+        logger.warning("serving: quarantining record %r (%s)", rid, msg)
+        try:
+            self._dead_breaker.call(self.queue.put_error, rid, msg,
+                                    record=record)
+        except CircuitBreakerOpen:
+            # store is down: shed quietly instead of blocking per record on
+            # the dead backend (the counter above still records the loss)
+            logger.warning("serving: dead-letter write for %r skipped "
+                           "(breaker open)", rid)
+        except Exception:  # noqa: BLE001 — best-effort: queue may be down
+            logger.exception("serving: dead-letter write for %r failed", rid)
+
+    def _stack_group(self, ids, items):
+        """Stack one same-shape group into a staged (ids, tensors, scales)
+        micro-batch."""
         if all(isinstance(it, QuantizedTensor) for it in items):
             # compact-dtype batch: ship the int8/uint8 bytes to the device,
             # dequantize there (per-row scales)
@@ -166,13 +238,74 @@ class ClusterServing:
             if isinstance(it, QuantizedTensor) else it for it in items])
         return ids, tensors, None
 
+    def _read_and_preprocess(self):
+        """Read one micro-batch and preprocess it record-by-record, returning
+        a LIST of staged (ids, tensors, scales) groups — one per input shape.
+        A malformed record (bad base64, undecodable image, byte/shape
+        mismatch) quarantines alone; records with a different-but-valid shape
+        form their own group (multi-shape clients are legitimate — the pow-2
+        bucketing in InferenceModel compiles per signature anyway) instead of
+        poisoning np.stack or being rejected for losing a batch vote."""
+        batch = self.queue.read_batch(self.params.batch_size,
+                                      self.params.poll_timeout_s)
+        if not batch:
+            return None
+        groups: Dict[tuple, List] = {}
+        for rid, rec in batch:
+            try:
+                item = self.preprocess(rec)
+            except Exception as e:  # noqa: BLE001 — malformed record
+                self._quarantine(rid, "preprocess", e, record=rec)
+                continue
+            shape = np.shape(item.data if isinstance(item, QuantizedTensor)
+                             else item)
+            groups.setdefault(shape, []).append((rid, item))
+        if not groups:
+            return None
+        return [self._stack_group([rid for rid, _ in pairs],
+                                  [it for _, it in pairs])
+                for pairs in groups.values()]
+
+    def _predict_isolated(self, ids, tensors, scales):
+        """Predict with graceful degradation: on failure, bisect the batch to
+        isolate the poison input — sane rows still get answers, only the
+        culprit is dead-lettered (log2(n) extra predict calls, worst case)."""
+        try:
+            return [(ids, self.model.do_predict(tensors, scales=scales))]
+        except Exception as e:  # noqa: BLE001 — device/input failure
+            if len(ids) == 1:
+                self._quarantine(ids[0], "predict", e)
+                return []
+            mid = len(ids) // 2
+            lo = self._predict_isolated(
+                ids[:mid], tensors[:mid],
+                None if scales is None else scales[:mid])
+            hi = self._predict_isolated(
+                ids[mid:], tensors[mid:],
+                None if scales is None else scales[mid:])
+            return lo + hi
+
     def _predict_and_write(self, ids, tensors, scales=None) -> int:
         t0 = time.time()
-        probs = self.model.do_predict(tensors, scales=scales)
-        for rid, row in zip(ids, probs):
-            self._put_result(rid,
-                             {"value": self.postprocess(np.asarray(row))})
-        n = len(ids)
+        n = 0
+        for chunk_ids, probs in self._predict_isolated(ids, tensors, scales):
+            for rid, row in zip(chunk_ids, probs):
+                try:
+                    value = {"value": self.postprocess(np.asarray(row))}
+                except Exception as e:  # noqa: BLE001 — per-record isolation
+                    self._quarantine(rid, "postprocess", e)
+                    continue
+                try:
+                    self._put_result(rid, value)
+                except Exception as e:  # noqa: BLE001 — write path down
+                    # deliberate shed-don't-block tradeoff: when the result
+                    # store is down past the retry budget the computed value
+                    # is dead-lettered (client sees the error and can
+                    # re-enqueue) instead of stalling the predict worker
+                    # behind an unbounded blocking retry
+                    self._quarantine(rid, "put_result", e)
+                    continue
+                n += 1
         self.total_records += n
         dt = max(time.time() - t0, 1e-9)
         if self._tb is not None:
@@ -186,51 +319,94 @@ class ClusterServing:
     # -- one micro-batch (synchronous path, used by tests/clients) -----------
     def serve_once(self) -> int:
         staged = self._read_and_preprocess()
-        if staged is None:
+        if not staged:
             return 0
-        return self._predict_and_write(*staged)
+        return sum(self._predict_and_write(*group) for group in staged)
 
     # -- lifecycle (cluster-serving-start/stop scripts parity) ----------------
     def start(self):
         """Pipelined loop: a host thread reads+preprocesses micro-batches into
         a bounded buffer while the predict thread runs the device — host
         preprocessing overlaps device compute (round-2 weak #5); the bounded
-        buffer gives natural backpressure when predict falls behind."""
+        buffer gives natural backpressure when predict falls behind.
+
+        Both workers run SUPERVISED (PR 1): an escaping exception no longer
+        kills the loop silently — it is logged, the worker restarts with
+        backoff up to `params.max_worker_restarts`, and `health()` reports
+        state/restarts/last error."""
         import queue as _q
+        p = self.params
         self._stop.clear()
-        self._staged = _q.Queue(maxsize=self.params.pipeline_depth)
-        self._pre_thread = threading.Thread(target=self._pre_loop, daemon=True)
-        self._thread = threading.Thread(target=self._predict_loop, daemon=True)
-        self._pre_thread.start()
-        self._thread.start()
+        self._staged = _q.Queue(maxsize=p.pipeline_depth)
+        self._pre_sup = SupervisedThread(
+            self._pre_loop, name="serving-preprocess",
+            max_restarts=p.max_worker_restarts,
+            backoff_s=p.worker_backoff_s, stop_event=self._stop)
+        self._predict_sup = SupervisedThread(
+            self._predict_loop, name="serving-predict",
+            max_restarts=p.max_worker_restarts,
+            backoff_s=p.worker_backoff_s, stop_event=self._stop)
+        self._pre_sup.start()
+        self._predict_sup.start()
+        # compat aliases: the raw threads, for callers that poked at them
+        self._pre_thread = self._pre_sup._thread
+        self._thread = self._predict_sup._thread
         return self
 
     def _pre_loop(self):
+        sup = self._pre_sup
         while not self._stop.is_set():
+            if sup is not None:
+                sup.heartbeat()
             staged = self._read_and_preprocess()
-            if staged is None:
+            if not staged:
                 time.sleep(0.005)
                 continue
-            while not self._stop.is_set():
-                try:
-                    self._staged.put(staged, timeout=0.1)
-                    break
-                except Exception:
-                    continue           # buffer full: backpressure
+            for group in staged:
+                while not self._stop.is_set():
+                    try:
+                        self._staged.put(group, timeout=0.1)
+                        break
+                    except _FULL:
+                        continue       # buffer full: backpressure
 
     def _predict_loop(self):
         import queue as _q
+        sup = self._predict_sup
         while not self._stop.is_set():
+            if sup is not None:
+                sup.heartbeat()
             try:
                 ids, tensors, scales = self._staged.get(timeout=0.1)
             except _q.Empty:
                 continue
             self._predict_and_write(ids, tensors, scales)
 
+    def health(self) -> Dict:
+        """Serving health surface (manager `status` / ops): worker states,
+        restart counts, breaker state, record/dead-letter counters."""
+        workers = {}
+        for sup in (self._pre_sup, self._predict_sup):
+            if sup is not None:
+                workers[sup.name] = sup.health()
+        running = bool(workers) and all(
+            w["state"] in (SupervisedThread.STARTING,
+                           SupervisedThread.RUNNING,
+                           SupervisedThread.RESTARTING)
+            for w in workers.values())
+        return {"running": running,
+                "total_records": self.total_records,
+                "dead_lettered": self.dead_lettered,
+                "breaker": self._breaker.health(),
+                "dead_letter_breaker": self._dead_breaker.health(),
+                "workers": workers}
+
     def shutdown(self):
+        # the compat aliases (_pre_thread/_thread) point at the SAME thread
+        # objects the supervisors own — joining the supervisors covers them
         self._stop.set()
-        for t in (getattr(self, "_pre_thread", None), self._thread):
-            if t is not None:
-                t.join(timeout=5)
+        for sup in (self._pre_sup, self._predict_sup):
+            if sup is not None:
+                sup.join(timeout=5)
         if self._tb is not None:
             self._tb.flush()

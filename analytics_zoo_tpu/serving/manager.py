@@ -23,10 +23,16 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       top_n: 5
       filter_threshold: null
       pipeline_depth: 2
+      max_worker_restarts: 5            # resilience (PR 1)
+      worker_backoff_s: 0.05
+      breaker_threshold: 5
+      breaker_cooldown_s: 0.5
 
 CLI (used by scripts/cluster-serving/*.sh):
     python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]
     python -m analytics_zoo_tpu.serving.manager stop|status|restart
+    python -m analytics_zoo_tpu.serving.manager health   # worker/breaker/
+        # dead-letter state from the daemon's <pidfile>.health.json snapshot
 """
 
 from __future__ import annotations
@@ -135,13 +141,8 @@ def build_queue(cfg: dict):
 
 
 def serving_params(cfg: dict) -> ServingParams:
-    p = cfg.get("params", {})
-    return ServingParams(
-        batch_size=int(p.get("batch_size", 4)),
-        top_n=int(p.get("top_n", 5)),
-        filter_threshold=p.get("filter_threshold"),
-        pipeline_depth=int(p.get("pipeline_depth", 2)),
-        stream_max_len=int(p.get("stream_max_len", 100000)))
+    # single shared parser (incl. the PR 1 resilience knobs)
+    return ServingParams.from_dict(cfg.get("params", {}))
 
 
 def serve_from_config(config_path: str,
@@ -153,24 +154,44 @@ def serve_from_config(config_path: str,
     return serving
 
 
+def _health_path(pidfile: str) -> str:
+    return pidfile + ".health.json"
+
+
+def _write_health(serving, path: str) -> None:
+    """Atomic health snapshot (ClusterServing.health()) next to the pidfile —
+    the `status`/`health` CLI actions read it from outside the daemon."""
+    tmp = path + ".tmp"
+    try:
+        snapshot = dict(serving.health(), ts=time.time())
+        with open(tmp, "w") as f:
+            json.dump(snapshot, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _run_foreground(config_path: str, pidfile: str):
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     serving = serve_from_config(config_path)
+    health_path = _health_path(pidfile)
 
     def _terminate(signum, frame):
         # ClusterServingManager.listenTermination analog: drain + exit
         serving.shutdown()
-        try:
-            os.unlink(pidfile)
-        except OSError:
-            pass
+        for p in (pidfile, health_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, _terminate)
     signal.signal(signal.SIGINT, _terminate)
     serving.start()
     while True:
+        _write_health(serving, health_path)
         time.sleep(1)
 
 
@@ -178,7 +199,7 @@ def main(argv=None):
     import argparse
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
-                    choices=["start", "stop", "status", "restart"])
+                    choices=["start", "stop", "status", "restart", "health"])
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
@@ -198,11 +219,43 @@ def main(argv=None):
         except OSError:
             return False
 
+    def read_health():
+        try:
+            with open(_health_path(args.pidfile)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     if args.action == "status":
         pid = read_pid()
         up = pid is not None and alive(pid)
-        print(json.dumps({"running": up, "pid": pid if up else None}))
+        out = {"running": up, "pid": pid if up else None}
+        health = read_health()
+        if health is not None:
+            out["health"] = health
+        print(json.dumps(out))
         return 0
+    if args.action == "health":
+        # worker-level health (supervision state, restart counts, dead-letter
+        # and breaker status) written by the serving daemon each second.
+        # Cross-checked against pid liveness: a SIGKILLed daemon leaves a
+        # stale snapshot behind and must not report healthy forever.
+        health = read_health()
+        if health is None:
+            print(json.dumps({"error": "no health snapshot (serving not "
+                                       "running, or not yet written)"}),
+                  file=sys.stderr)
+            return 1
+        pid = read_pid()
+        if pid is None or not alive(pid):
+            health["running"] = False
+            health["stale"] = True
+            print(json.dumps(health), file=sys.stderr)
+            return 1
+        print(json.dumps(health))
+        # a live daemon whose workers are FAILED is not healthy: exit
+        # nonzero so liveness probes that check the code catch it
+        return 0 if health.get("running") else 1
     if args.action in ("stop", "restart"):
         pid = read_pid()
         if pid is not None and alive(pid):

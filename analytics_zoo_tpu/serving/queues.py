@@ -7,7 +7,12 @@ Backends:
 - `FileQueue`    — spool-directory stream + result table (cross-process, no deps)
 - `RedisQueue`   — real Redis when the `redis` package + server are available
 
-All share the same four calls: xadd / read_batch / put_result / get_result.
+All share the same four calls: xadd / read_batch / put_result / get_result,
+plus the dead-letter side channel (PR 1 resilience): `put_error` quarantines a
+poisoned record — it writes an `{"error": ...}` result under the record's key
+(so a waiting client unblocks and SEES the failure instead of hanging) and
+appends `{"uri", "error", "record"?}` to a dead-letter stream that
+`dead_letters()` exposes for inspection/replay.
 """
 
 from __future__ import annotations
@@ -37,14 +42,37 @@ class BaseQueue:
     def result_count(self) -> int:
         raise NotImplementedError
 
+    # -- dead-letter side channel (PR 1 resilience) --------------------------
+    def put_error(self, key: str, error: str,
+                  record: Optional[Dict] = None) -> None:
+        """Quarantine one poisoned record: write an error RESULT the client
+        can see (same key it is polling) and append a dead-letter entry."""
+        raise NotImplementedError
+
+    def dead_letters(self) -> List[Dict]:
+        """All quarantined entries, oldest first."""
+        raise NotImplementedError
+
+    def dead_letter_count(self) -> int:
+        return len(self.dead_letters())
+
     def trim(self, max_len: int) -> None:
         """Memory guard (ClusterServing.scala:134-140 XTRIM analog)."""
+
+
+def _dead_letter_entry(key: str, error: str,
+                       record: Optional[Dict]) -> Dict:
+    entry = {"uri": key, "error": str(error)}
+    if record is not None:
+        entry["record"] = record
+    return entry
 
 
 class InProcQueue(BaseQueue):
     def __init__(self):
         self._stream = deque()
         self._results: Dict[str, Dict] = {}
+        self._dead: List[Dict] = []
         self._lock = threading.Lock()
 
     def xadd(self, record):
@@ -77,6 +105,15 @@ class InProcQueue(BaseQueue):
         with self._lock:
             return len(self._results)
 
+    def put_error(self, key, error, record=None):
+        with self._lock:
+            self._results[key] = {"error": str(error)}
+            self._dead.append(_dead_letter_entry(key, error, record))
+
+    def dead_letters(self):
+        with self._lock:
+            return list(self._dead)
+
     def trim(self, max_len):
         with self._lock:
             while len(self._stream) > max_len:
@@ -91,8 +128,10 @@ class FileQueue(BaseQueue):
         self.root = root
         self.stream_dir = os.path.join(root, "stream")
         self.result_dir = os.path.join(root, "results")
+        self.dead_dir = os.path.join(root, "dead-letter")
         os.makedirs(self.stream_dir, exist_ok=True)
         os.makedirs(self.result_dir, exist_ok=True)
+        os.makedirs(self.dead_dir, exist_ok=True)
 
     def xadd(self, record):
         rid = record.get("uri") or str(uuid.uuid4())
@@ -141,6 +180,25 @@ class FileQueue(BaseQueue):
     def result_count(self):
         return len(os.listdir(self.result_dir))
 
+    def put_error(self, key, error, record=None):
+        self.put_result(key, {"error": str(error)})
+        seq = f"{time.time_ns()}"
+        tmp = os.path.join(self.dead_dir, f".{seq}-{key}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(_dead_letter_entry(key, error, record), f)
+        os.rename(tmp, os.path.join(self.dead_dir, f"{seq}-{key}.json"))
+
+    def dead_letters(self):
+        out = []
+        for fname in sorted(f for f in os.listdir(self.dead_dir)
+                            if f.endswith(".json")):
+            try:
+                with open(os.path.join(self.dead_dir, fname)) as f:
+                    out.append(json.load(f))
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
+        return out
+
     def trim(self, max_len):
         files = sorted(f for f in os.listdir(self.stream_dir)
                        if f.endswith(".json"))
@@ -160,6 +218,7 @@ class RedisQueue(BaseQueue):
         self.r = redis.Redis(host=host, port=port)
         self.stream = stream
         self.table = result_table
+        self.dead_stream = stream + ":dead-letter"
         self._last_id = "0"
 
     def xadd(self, record):
@@ -188,8 +247,19 @@ class RedisQueue(BaseQueue):
     def result_count(self):
         return self.r.hlen(self.table)
 
+    def put_error(self, key, error, record=None):
+        self.r.hset(self.table, key, json.dumps({"error": str(error)}))
+        self.r.xadd(self.dead_stream,
+                    {"data": json.dumps(_dead_letter_entry(key, error,
+                                                           record))})
+
+    def dead_letters(self):
+        return [json.loads(fields[b"data"])
+                for _, fields in self.r.xrange(self.dead_stream)]
+
     def trim(self, max_len):
         self.r.xtrim(self.stream, maxlen=max_len)
+        self.r.xtrim(self.dead_stream, maxlen=max_len)
 
 
 def make_queue(kind: str = "inproc", **kwargs) -> BaseQueue:

@@ -133,6 +133,233 @@ def test_attention_bthd_matches_bhtd(rng):
                                    rtol=1e-5, atol=1e-5)
 
 
+# -- round-5 advisor findings (PR 1 satellites) --------------------------------
+
+def test_int8_wire_gate_on_dtype():
+    """engine.py (ADVICE r5): a '<f4' record carrying a stray `scale` must be
+    host-dequantized, not truncated via astype(int8); only '<i1' records take
+    the QuantizedTensor device-dequant path."""
+    import base64
+
+    from analytics_zoo_tpu.serving.engine import (QuantizedTensor,
+                                                  default_preprocess)
+
+    vals = np.asarray([0.5, -1.25, 3.75], "<f4")
+    rec_f4 = {"b64": base64.b64encode(vals.tobytes()).decode(),
+              "dtype": "<f4", "shape": [3], "scale": 2.0}
+    out = default_preprocess(rec_f4)
+    assert not isinstance(out, QuantizedTensor)
+    np.testing.assert_allclose(out, vals * 2.0, rtol=1e-6)
+
+    q = np.asarray([5, -7, 100], "<i1")
+    rec_i1 = {"b64": base64.b64encode(q.tobytes()).decode(),
+              "dtype": "<i1", "shape": [3], "scale": 0.1}
+    out = default_preprocess(rec_i1)
+    assert isinstance(out, QuantizedTensor)
+    assert out.data.dtype == np.int8 and out.scale == 0.1
+    np.testing.assert_array_equal(out.data, q)
+
+
+def test_failed_trials_are_tagged():
+    """automl/search.py (ADVICE r5): a crashed trial keeps the ±inf score for
+    best-trial selection but is flagged failed with the error string."""
+    from analytics_zoo_tpu.automl.search import (MultiProcessSearchEngine,
+                                                 RandomSearchEngine, Trial,
+                                                 Uniform)
+
+    eng = MultiProcessSearchEngine(RandomSearchEngine(n_trials=4, seed=0))
+    configs = eng.inner.sample_all({"lr": Uniform(0.1, 1.0)})
+
+    def train_fn(cfg):
+        if cfg["lr"] > 0.5:
+            raise RuntimeError("trial OOM")
+        return cfg["lr"]
+
+    metrics, failed, errors = eng._run_local(configs, train_fn, 0, 1)
+    crashed = [i for i, c in enumerate(configs) if c["lr"] > 0.5]
+    assert crashed, "seed produced no crashing configs"
+    for i in range(len(configs)):
+        if i in crashed:
+            assert failed[i] == 1.0 and metrics[i] == np.inf
+            assert "RuntimeError: trial OOM" in errors[i]
+        else:
+            assert failed[i] == 0.0 and np.isfinite(metrics[i])
+    trials = [Trial(c, float(m), failed=bool(f), error=errors.get(i))
+              for i, (c, m, f) in enumerate(zip(configs, metrics, failed))]
+    # best-trial selection still works and never picks a crashed trial
+    best = min(trials, key=lambda t: t.metric)
+    assert not best.failed and best.error is None
+    # plain Trials default to not-failed (back compat)
+    assert Trial({}, 0.0).failed is False
+
+
+def test_batch_sharding_seq_gate_on_token_len():
+    """context.py (ADVICE r5): axis 1 is seq-sharded only when it IS the
+    token axis (matches the model input's token length), not whenever it
+    happens to divide the seq mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from analytics_zoo_tpu.common.context import init_context
+
+    try:
+        c = init_context(mesh_axes=("data", "seq"), mesh_shape=(2, 4),
+                         seed=42)
+        tokens = c.batch_sharding_for((8, 16), token_len=16)
+        assert tokens.spec == P("data", "seq")
+        targets = c.batch_sharding_for((8, 16, 32), token_len=16)
+        assert targets.spec == P("data", "seq", None)
+        # (B, C) one-hot labels: C=4 divides n_seq=4 but is NOT the token axis
+        labels = c.batch_sharding_for((8, 4), token_len=16)
+        assert labels.spec == P("data", None)
+        # no token length known -> never seq-shard
+        unknown = c.batch_sharding_for((8, 16))
+        assert unknown.spec == P("data", None)
+    finally:
+        init_context(seed=42)               # restore the default test mesh
+
+
+# -- BigDL geometry (ADVICE r5 medium) -----------------------------------------
+
+def _pb_varint(v):
+    if v < 0:
+        v += 1 << 64
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _pb_field(fn, wt, payload):
+    tag = _pb_varint(fn << 3 | wt)
+    if wt == 2:
+        return tag + _pb_varint(len(payload)) + payload
+    return tag + payload
+
+
+def _pb_attr_entry(key, attr_payload):
+    return _pb_field(8, 2, _pb_field(1, 2, key.encode()) + _pb_field(
+        2, 2, attr_payload))
+
+
+def test_bigdl_attr_map_scalars_parse_from_wire():
+    """The protobuf codec reads scalar AttrValues (int32 incl. negatives,
+    double, bool) out of the module attr map."""
+    import struct
+
+    from analytics_zoo_tpu.interop.bigdl_loader import _parse_module
+
+    mod = (_pb_field(1, 2, b"pool1")
+           + _pb_field(7, 2, b"com.intel.analytics.bigdl.nn.SpatialMaxPooling")
+           + _pb_attr_entry("kW", _pb_field(3, 0, _pb_varint(3)))
+           + _pb_attr_entry("padW", _pb_field(3, 0, _pb_varint(-1)))
+           + _pb_attr_entry("initP", _pb_field(6, 1,
+                                               struct.pack("<d", 0.3)))
+           + _pb_attr_entry("ceilMode", _pb_field(8, 0, _pb_varint(1))))
+    m = _parse_module(mod, {})
+    assert m.name == "pool1" and m.op == "SpatialMaxPooling"
+    assert m.attrs["kW"] == 3
+    assert m.attrs["padW"] == -1
+    assert m.attrs["initP"] == pytest.approx(0.3)
+    assert m.attrs["ceilMode"] is True
+
+
+def _bigdl_module(name, module_type, pre=(), weight=None, bias=None,
+                  attrs=None):
+    from analytics_zoo_tpu.interop.bigdl_loader import BigDLModule
+    m = BigDLModule(name=name, module_type=module_type,
+                    pre_modules=list(pre))
+    m.weight, m.bias = weight, bias
+    m.attrs = dict(attrs or {})
+    return m
+
+
+def _bigdl_chain(pool_attrs):
+    """conv(3x3, stride 2, pad 1) -> pool -> reshape -> linear chain."""
+    from analytics_zoo_tpu.interop.bigdl_loader import BigDLModule
+    g = np.random.default_rng(0)
+    conv = _bigdl_module(
+        "conv", "com.intel.analytics.bigdl.nn.SpatialConvolution",
+        weight=g.normal(size=(2, 1, 3, 3)).astype(np.float32),
+        bias=np.zeros(2, np.float32),
+        attrs={"kernelW": 3, "kernelH": 3, "strideW": 2, "strideH": 2,
+               "padW": 1, "padH": 1})
+    pool = _bigdl_module(
+        "pool", "com.intel.analytics.bigdl.nn.SpatialMaxPooling",
+        pre=["conv"], attrs=pool_attrs)
+    resh = _bigdl_module("resh", "com.intel.analytics.bigdl.nn.Reshape",
+                         pre=["pool"])
+    fc = _bigdl_module(
+        "fc", "com.intel.analytics.bigdl.nn.Linear", pre=["resh"],
+        weight=g.normal(size=(5, 8)).astype(np.float32),
+        bias=np.zeros(5, np.float32))
+    root = BigDLModule(name="g", module_type="bigdl.nn.StaticGraph",
+                       sub_modules=[conv, pool, resh, fc])
+    return root
+
+
+def test_bigdl_geometry_from_attrs(monkeypatch, ctx):
+    """bigdl_to_native honors serialized conv stride/padding and pooling
+    kernel/stride (previously hardcoded 2x2/s2 and stride-1/valid)."""
+    from analytics_zoo_tpu.interop import bigdl_loader
+
+    root = _bigdl_chain({"kW": 2, "kH": 2, "dW": 2, "dH": 2,
+                         "padW": 0, "padH": 0})
+    monkeypatch.setattr(bigdl_loader, "load_bigdl", lambda path: root)
+    model = bigdl_loader.bigdl_to_native("synthetic.model", (1, 8, 8))
+
+    conv = model.layers_list[0]
+    assert conv.subsample == (2, 2)
+    assert conv.border_mode == (1, 1)       # explicit symmetric (padH, padW)
+    pool = model.layers_list[1]
+    assert pool.pool_size == (2, 2) and pool.strides == (2, 2)
+    # conv 8x8 k3 s2 p1 -> 4x4; pool 2x2 s2 -> 2x2; flatten -> 8 -> fc 5
+    y = model.predict(np.zeros((2, 1, 8, 8), np.float32), batch_size=2)
+    assert y.shape == (2, 5)
+
+
+def test_bigdl_non_default_pool_geometry(monkeypatch, ctx):
+    """A 3x3/s1 pooling converts with ITS geometry, not the old 2x2/s2."""
+    from analytics_zoo_tpu.interop import bigdl_loader
+
+    root = _bigdl_chain({"kW": 3, "kH": 3, "dW": 1, "dH": 1,
+                         "padW": 0, "padH": 0})
+    # fc input after conv(->4x4) + 3x3/s1 pool(->2x2) stays 2*2*2=8: same fc
+    monkeypatch.setattr(bigdl_loader, "load_bigdl", lambda path: root)
+    model = bigdl_loader.bigdl_to_native("synthetic.model", (1, 8, 8))
+    pool = model.layers_list[1]
+    assert pool.pool_size == (3, 3) and pool.strides == (1, 1)
+    y = model.predict(np.zeros((1, 1, 8, 8), np.float32), batch_size=1)
+    assert y.shape == (1, 5)
+
+
+def test_bigdl_unreadable_geometry_raises(monkeypatch, ctx):
+    """Missing geometry attrs must raise NotImplementedError instead of
+    silently converting to a model that computes the wrong function."""
+    from analytics_zoo_tpu.interop import bigdl_loader
+
+    root = _bigdl_chain({})                 # pooling attrs absent
+    monkeypatch.setattr(bigdl_loader, "load_bigdl", lambda path: root)
+    with pytest.raises(NotImplementedError, match="geometry"):
+        bigdl_loader.bigdl_to_native("synthetic.model", (1, 8, 8))
+
+    root = _bigdl_chain({"kW": 2, "kH": 2, "dW": 2, "dH": 2,
+                         "padW": 0, "padH": 0, "ceilMode": True})
+    monkeypatch.setattr(bigdl_loader, "load_bigdl", lambda path: root)
+    with pytest.raises(NotImplementedError, match="ceil"):
+        bigdl_loader.bigdl_to_native("synthetic.model", (1, 8, 8))
+
+    # mixed SAME(-1)/explicit padding must refuse, not silently go full-SAME
+    root = _bigdl_chain({"kW": 2, "kH": 2, "dW": 2, "dH": 2,
+                         "padW": 2, "padH": -1})
+    monkeypatch.setattr(bigdl_loader, "load_bigdl", lambda path: root)
+    with pytest.raises(NotImplementedError, match="mixed"):
+        bigdl_loader.bigdl_to_native("synthetic.model", (1, 8, 8))
+
+
 def test_keras2_covers_reference_layer_files():
     """Round 5 (VERDICT r4 missing #6): every layer file in the reference's
     keras2 package (pipeline/api/keras2/layers/*.scala, 20 files) has a

@@ -138,14 +138,18 @@ def test_result_write_retries_with_backoff(ctx):
     assert q.failures == 0                      # retried through the failures
     assert q.get_result("1") is not None or q.result_count() == 1
 
-    # exhausted retries surface the error
+    # exhausted retries no longer kill the worker (PR 1 resilience): the
+    # record is quarantined to the dead-letter channel with a visible error
+    # result instead of the exception escaping the serve loop
     q2 = Flaky()
     q2.failures = 99
     serving2 = ClusterServing(im, q2, params=ServingParams(
         batch_size=2, write_retries=2, write_backoff_s=0.001))
     q2.xadd({"uri": "b", "data": [1.0, 2.0, 3.0], "shape": [3]})
-    with pytest.raises(ConnectionError):
-        serving2.serve_once()
+    assert serving2.serve_once() == 0
+    dead = q2.dead_letters()
+    assert [d["uri"] for d in dead] == ["b"]
+    assert "error" in q2.get_result("b")
 
 
 def _redis_available():

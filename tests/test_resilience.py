@@ -1,0 +1,263 @@
+"""Resilience library (PR 1 tentpole): RetryPolicy backoff/deadline,
+CircuitBreaker trip/half-open/close, SupervisedThread crash-restart-cap, and
+the deterministic FaultInjector that drives all of it.  No test sleeps longer
+than ~0.2 s — clocks and sleeps are injectable."""
+
+import threading
+import time
+
+import pytest
+
+from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                 CircuitBreakerOpen,
+                                                 Deadline, RetryPolicy,
+                                                 RetryExhausted,
+                                                 SupervisedThread)
+from analytics_zoo_tpu.utils.chaos import FaultInjector, InjectedFault
+
+
+# -- RetryPolicy ---------------------------------------------------------------
+
+def test_retry_recovers_after_transient_failures():
+    inj = FaultInjector().fail("op", times=3)
+    sleeps = []
+    policy = RetryPolicy(max_retries=5, base_delay_s=0.01,
+                         sleep=sleeps.append)
+    calls = []
+
+    def op():
+        inj.maybe_fail("op")
+        calls.append(1)
+        return "ok"
+
+    assert policy.call(op) == "ok"
+    assert inj.count("op") == 4 and len(calls) == 1
+    # exact deterministic backoff schedule (no jitter)
+    assert sleeps == [0.01, 0.02, 0.04]
+
+
+def test_retry_exhaustion_chains_original_error():
+    inj = FaultInjector().fail("op", times=99)
+    policy = RetryPolicy(max_retries=2, base_delay_s=0.001)
+    with pytest.raises(RetryExhausted) as ei:
+        policy.call(lambda: inj.maybe_fail("op"))
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    assert inj.count("op") == 3            # initial try + 2 retries
+
+
+def test_retry_deadline_cuts_schedule_short():
+    inj = FaultInjector().fail("op", times=99)
+    t = [0.0]
+    sleeps = []
+
+    def fake_sleep(d):
+        sleeps.append(d)
+        t[0] += d
+
+    policy = RetryPolicy(max_retries=50, base_delay_s=0.1, multiplier=1.0,
+                         deadline_s=0.35, sleep=fake_sleep,
+                         clock=lambda: t[0])
+    with pytest.raises(RetryExhausted, match="deadline"):
+        policy.call(lambda: inj.maybe_fail("op"))
+    # 0.1-delay retries fit 3 times under a 0.35 s deadline
+    assert len(sleeps) == 3
+
+
+def test_retry_jitter_is_deterministic_and_bounded():
+    p = RetryPolicy(base_delay_s=0.1, jitter=0.5)
+    d0, d1 = p.delay(0), p.delay(1)
+    assert d0 == p.delay(0)                # same attempt -> same delay
+    assert 0.1 <= d0 <= 0.15 and 0.2 <= d1 <= 0.3
+
+
+def test_deadline_remaining():
+    t = [0.0]
+    d = Deadline(1.0, clock=lambda: t[0])
+    assert d.remaining() == 1.0 and not d.expired()
+    t[0] = 1.5
+    assert d.expired()
+    assert Deadline(None).remaining() == float("inf")
+
+
+# -- CircuitBreaker ------------------------------------------------------------
+
+def test_breaker_trips_fails_fast_and_half_opens():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    inj = FaultInjector().fail("write", times=3)
+
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            br.call(lambda: inj.maybe_fail("write"))
+    assert br.state == CircuitBreaker.OPEN and br.trip_count == 1
+
+    # OPEN: calls fail fast WITHOUT touching the backend
+    with pytest.raises(CircuitBreakerOpen):
+        br.call(lambda: inj.maybe_fail("write"))
+    assert inj.count("write") == 3
+
+    # cooldown elapses -> HALF_OPEN probe; success closes the breaker
+    t[0] = 1.5
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.call(lambda: "ok") == "ok"
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_reopens_when_probe_fails():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    t[0] = 1.1
+    with pytest.raises(ValueError):        # the half-open probe fails
+        br.call(lambda: (_ for _ in ()).throw(ValueError("y")))
+    assert br.state == CircuitBreaker.OPEN  # fresh cooldown window
+    with pytest.raises(CircuitBreakerOpen):
+        br.call(lambda: "never")
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=2)
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    br.call(lambda: "ok")                  # resets the streak
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.health()["consecutive_failures"] == 1
+
+
+# -- SupervisedThread ----------------------------------------------------------
+
+def test_supervised_thread_restarts_after_crash():
+    inj = FaultInjector().fail("worker", times=2)
+    stop = threading.Event()
+    done = threading.Event()
+
+    def worker():
+        inj.maybe_fail("worker")           # crashes the first 2 incarnations
+        done.set()
+        stop.wait(5)
+
+    sup = SupervisedThread(worker, name="w", max_restarts=5,
+                           backoff_s=0.005, stop_event=stop).start()
+    assert done.wait(5)
+    h = sup.health()
+    assert h["restart_count"] == 2
+    assert h["state"] == SupervisedThread.RUNNING and h["alive"]
+    assert "InjectedFault" in h["last_error"]
+    sup.stop(timeout=2)
+    assert sup.health()["state"] == SupervisedThread.STOPPED
+    assert not sup.is_alive()
+
+
+def test_supervised_thread_gives_up_at_restart_cap():
+    inj = FaultInjector().fail("worker", times=99)
+    crashes = []
+    sup = SupervisedThread(lambda: inj.maybe_fail("worker"), name="w",
+                           max_restarts=3, backoff_s=0.001,
+                           on_crash=crashes.append).start()
+    sup.join(timeout=5)
+    h = sup.health()
+    assert h["state"] == SupervisedThread.FAILED and not h["alive"]
+    assert h["restart_count"] == 4         # initial run + 3 restarts
+    assert len(crashes) == 4
+
+
+def test_supervised_thread_streak_resets_after_healthy_run():
+    """The restart cap bounds CONSECUTIVE crash-loops: an incarnation that
+    ran healthy for healthy_after_s resets the streak, so transient faults
+    spread over a long serving lifetime never exhaust the budget."""
+    t = [0.0]
+
+    def clock():
+        t[0] += 100.0                      # every incarnation looks long-lived
+        return t[0]
+
+    inj = FaultInjector().fail("worker", times=4)
+    stop = threading.Event()
+    done = threading.Event()
+
+    def worker():
+        inj.maybe_fail("worker")
+        done.set()
+        stop.wait(5)
+
+    sup = SupervisedThread(worker, name="w", max_restarts=1, backoff_s=0.001,
+                           healthy_after_s=30.0, stop_event=stop,
+                           clock=clock).start()
+    assert done.wait(5)                    # survived 4 faults with cap=1
+    h = sup.health()
+    assert h["restart_count"] == 4 and h["crash_streak"] == 1
+    assert h["state"] == SupervisedThread.RUNNING
+    sup.stop(timeout=2)
+
+
+def test_supervised_thread_heartbeat_and_clean_return():
+    clock = [100.0]
+    sup = SupervisedThread(lambda: None, name="w", clock=lambda: clock[0])
+
+    def worker():
+        sup.heartbeat()
+
+    sup.target = worker
+    sup.start()
+    sup.join(timeout=2)
+    h = sup.health()
+    assert h["state"] == SupervisedThread.STOPPED
+    assert h["last_progress"] == 100.0 and h["restart_count"] == 0
+
+
+# -- FaultInjector -------------------------------------------------------------
+
+def test_injector_schedules_by_index_and_predicate():
+    inj = FaultInjector()
+    inj.fail_at("pre", indices=[1, 3])
+    inj.fail_when("predict", lambda ctx: ctx.get("rid") == "poison")
+
+    outcomes = []
+    for i in range(5):
+        try:
+            inj.maybe_fail("pre")
+            outcomes.append("ok")
+        except InjectedFault:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "boom", "ok", "boom", "ok"]
+    assert inj.fired == ["pre#1", "pre#3"]
+
+    inj.maybe_fail("predict", rid="fine")
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("predict", rid="poison")
+
+
+def test_injector_wrap_and_custom_exception():
+    inj = FaultInjector().fail("q", times=1, exc=ConnectionError,
+                               message="redis down")
+    calls = []
+    wrapped = inj.wrap("q", lambda x: calls.append(x) or x)
+    with pytest.raises(ConnectionError, match="redis down"):
+        wrapped(1)
+    assert wrapped(2) == 2
+    assert calls == [2] and inj.count("q") == 2
+    inj.reset("q")
+    assert inj.count("q") == 0
+
+
+def test_injector_thread_safety_counts():
+    inj = FaultInjector()
+    n_threads, per = 8, 200
+
+    def hammer():
+        for _ in range(per):
+            inj.maybe_fail("site")
+
+    ts = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    t0 = time.time()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert inj.count("site") == n_threads * per
+    assert time.time() - t0 < 5

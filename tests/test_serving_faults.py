@@ -1,0 +1,296 @@
+"""Serving fault-tolerance (PR 1 tentpole): poison-record quarantine,
+dead-letter visibility from the client, supervised-worker restart, write
+circuit-breaking, and batch-bisect isolation — all driven deterministically
+by utils/chaos.FaultInjector.  No sleeps longer than ~0.2 s per wait step."""
+
+import base64
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.resilience import CircuitBreaker
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue
+from analytics_zoo_tpu.utils.chaos import FaultInjector
+
+DIM, NCLS = 3, 4
+
+
+def _serving(queue, **params):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    model = Sequential()
+    model.add(Dense(NCLS, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=4, poll_timeout_s=0.02, write_backoff_s=0.01,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue, params=ServingParams(**defaults))
+
+
+def _drain(out_q, rids, timeout_s=20.0):
+    got = {}
+    deadline = time.time() + timeout_s
+    while len(got) < len(rids) and time.time() < deadline:
+        for rid in rids:
+            if rid not in got:
+                r = out_q.query(rid)
+                if r is not None:
+                    got[rid] = r
+        time.sleep(0.01)
+    return got
+
+
+# -- acceptance scenario (ISSUE criteria) --------------------------------------
+
+@pytest.mark.parametrize("queue_kind", ["inproc", "file"])
+def test_poisoned_stream_completes_with_quarantine(queue_kind, tmp_path, ctx):
+    """A 20-record stream with 3 malformed records completes: 17 correct
+    results, 3 dead-lettered error results the client can retrieve, both
+    workers alive, and shutdown() joins cleanly."""
+    q = InProcQueue() if queue_kind == "inproc" \
+        else FileQueue(str(tmp_path / "q"))
+    serving = _serving(q)
+    cin, cout = InputQueue(q), OutputQueue(q)
+
+    g = np.random.default_rng(0)
+    rids, bad = [], []
+    for i in range(20):
+        rid = f"r{i}"
+        if i == 3:       # malformed base64 payload
+            q.xadd({"uri": rid, "b64": "!!!not-base64!!!", "dtype": "<f4",
+                    "shape": [DIM]})
+            bad.append(rid)
+        elif i == 9:     # declared shape disagrees with the byte count
+            q.xadd({"uri": rid,
+                    "b64": base64.b64encode(
+                        np.ones(DIM + 2, "<f4").tobytes()).decode(),
+                    "dtype": "<f4", "shape": [DIM]})
+            bad.append(rid)
+        elif i == 15:    # valid decode but wrong shape for the model: forms
+                         # its own shape group and is rejected by predict
+            q.xadd({"uri": rid,
+                    "b64": base64.b64encode(
+                        np.ones(DIM + 1, "<f4").tobytes()).decode(),
+                    "dtype": "<f4", "shape": [DIM + 1]})
+            bad.append(rid)
+        else:
+            cin.enqueue_tensor(rid, g.normal(size=(DIM,)).astype(np.float32))
+        rids.append(rid)
+
+    serving.start()
+    try:
+        got = _drain(cout, rids)
+        assert len(got) == 20, f"missing: {sorted(set(rids) - set(got))}"
+        good = [r for r in rids if r not in bad]
+        for rid in good:
+            assert not OutputQueue.is_error(got[rid])
+            assert len(got[rid]["value"]) == NCLS
+        for rid in bad:
+            assert OutputQueue.is_error(got[rid]), got[rid]
+        # dead letters visible from the client side
+        assert sorted(d["uri"] for d in cout.dead_letters()) == sorted(bad)
+        # both workers still alive and healthy
+        h = serving.health()
+        assert h["running"] is True
+        assert set(h["workers"]) == {"serving-preprocess", "serving-predict"}
+        for w in h["workers"].values():
+            assert w["alive"] and w["state"] == "running"
+        assert h["dead_lettered"] == 3 and h["total_records"] == 17
+    finally:
+        t0 = time.time()
+        serving.shutdown()
+        assert time.time() - t0 < 10
+    # clean join: no worker thread left running
+    assert not serving._pre_sup.is_alive()
+    assert not serving._predict_sup.is_alive()
+
+
+# -- per-path chaos ------------------------------------------------------------
+
+def test_preprocess_fault_injected_for_specific_record(ctx):
+    """FaultInjector raising inside user preprocess for record i quarantines
+    exactly that record."""
+    q = InProcQueue()
+    serving = _serving(q)
+    inj = FaultInjector().fail_when(
+        "preprocess", lambda ctx_: ctx_["args"][0].get("uri") == "r1")
+    from analytics_zoo_tpu.serving.engine import default_preprocess
+    serving.preprocess = inj.wrap("preprocess", default_preprocess)
+
+    cin = InputQueue(q)
+    for i in range(3):
+        cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+    while serving.serve_once():
+        pass
+    assert OutputQueue.is_error(q.get_result("r1"))
+    assert not OutputQueue.is_error(q.get_result("r0"))
+    assert not OutputQueue.is_error(q.get_result("r2"))
+    assert [d["uri"] for d in q.dead_letters()] == ["r1"]
+    assert "InjectedFault" in q.get_result("r1")["error"]
+
+
+def test_batch_bisect_isolates_poison_predict_input(ctx):
+    """A batch whose predict() crashes is bisected until the single poison
+    row is found: the other rows still get results, log2(n) extra calls."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=8)
+    inj = FaultInjector().fail_when(
+        "predict", lambda ctx_: bool((ctx_["args"][0] == 999.0).any()))
+    serving.model.do_predict = inj.wrap("predict", serving.model.do_predict)
+
+    cin = InputQueue(q)
+    rids = []
+    for i in range(8):
+        vec = np.full(DIM, 999.0 if i == 5 else float(i), np.float32)
+        rids.append(cin.enqueue_tensor(f"r{i}", vec))
+    while serving.serve_once():
+        pass
+    for i, rid in enumerate(rids):
+        res = q.get_result(rid)
+        assert res is not None
+        assert OutputQueue.is_error(res) == (i == 5)
+    assert [d["uri"] for d in q.dead_letters()] == ["r5"]
+    # bisect cost is logarithmic, not linear: full batch + 2 per level
+    assert inj.count("predict") <= 1 + 2 * 3
+
+
+def test_supervised_worker_restarts_after_queue_crash(ctx):
+    """A crash in the read path kills the preprocess worker; supervision
+    restarts it and serving keeps delivering results."""
+    q = InProcQueue()
+    serving = _serving(q)
+    inj = FaultInjector().fail("read_batch", times=2)
+    q.read_batch = inj.wrap("read_batch", q.read_batch)
+
+    serving.start()
+    try:
+        cin, cout = InputQueue(q), OutputQueue(q)
+        rid = cin.enqueue_tensor("r0", np.ones(DIM, np.float32))
+        res = cout.query(rid, timeout_s=15)
+        assert res is not None and not OutputQueue.is_error(res)
+        h = serving.health()
+        assert h["running"] is True
+        assert h["workers"]["serving-preprocess"]["restart_count"] == 2
+        assert "InjectedFault" in \
+            h["workers"]["serving-preprocess"]["last_error"]
+    finally:
+        serving.shutdown()
+
+
+def test_write_retry_then_circuit_breaker_sheds_load(ctx):
+    """Transient write failures are retried through; a hard outage trips the
+    breaker (fail-fast, records dead-lettered, worker alive) and the breaker
+    half-opens after the cooldown so service resumes."""
+    q = InProcQueue()
+    serving = _serving(q, write_retries=1, write_backoff_s=0.005)
+    # deterministic breaker: fake clock, no wall-time cooldown waits
+    clock = [0.0]
+    serving._breaker = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                                      clock=lambda: clock[0],
+                                      name="result-write")
+    inj = FaultInjector()
+    q.put_result = inj.wrap("put_result", q.put_result)
+    cin = InputQueue(q)
+
+    # transient: 1 failure, 1 retry -> success, breaker stays closed
+    inj.fail("put_result", times=1, exc=ConnectionError)
+    cin.enqueue_tensor("ok0", np.ones(DIM, np.float32))
+    assert serving.serve_once() == 1
+    assert serving._breaker.state == CircuitBreaker.CLOSED
+
+    # hard outage: every write fails -> retry exhausts -> records quarantined,
+    # 2 exhausted batches trip the breaker
+    inj.fail("put_result", times=99, exc=ConnectionError)
+    for i in range(3):
+        cin.enqueue_tensor(f"dead{i}", np.ones(DIM, np.float32))
+        serving.serve_once()
+    assert serving._breaker.state == CircuitBreaker.OPEN
+    dead = {d["uri"] for d in q.dead_letters()}
+    assert {"dead0", "dead1", "dead2"} <= dead
+    for i in range(3):
+        assert OutputQueue.is_error(q.get_result(f"dead{i}"))
+
+    # breaker open: writes fail fast (no retry traffic against the backend)
+    before = inj.count("put_result")
+    cin.enqueue_tensor("fast", np.ones(DIM, np.float32))
+    serving.serve_once()
+    assert inj.count("put_result") == before
+    assert OutputQueue.is_error(q.get_result("fast"))
+
+    # cooldown elapses -> half-open probe succeeds -> service resumes
+    inj.reset("put_result")
+    clock[0] += 11.0
+    cin.enqueue_tensor("ok1", np.ones(DIM, np.float32))
+    assert serving.serve_once() == 1
+    assert not OutputQueue.is_error(q.get_result("ok1"))
+    assert serving._breaker.state == CircuitBreaker.CLOSED
+    assert serving.health()["breaker"]["trip_count"] == 1
+
+
+def test_predict_worker_restart_under_pipeline(ctx):
+    """An injected predict crash inside the PIPELINED loop is survived: the
+    batch is bisect-quarantined (single-record batch -> dead-letter) and the
+    predict worker never needs restarting; a crash in postprocess is isolated
+    per record too."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=2)
+    inj = FaultInjector().fail_at("postprocess", indices=[0])
+    orig_post = serving.postprocess
+    serving.postprocess = inj.wrap("postprocess", orig_post)
+
+    serving.start()
+    try:
+        cin, cout = InputQueue(q), OutputQueue(q)
+        rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+                for i in range(4)]
+        got = _drain(cout, rids)
+        assert len(got) == 4
+        errs = [rid for rid in rids if OutputQueue.is_error(got[rid])]
+        assert len(errs) == 1              # exactly the injected record
+        assert serving.health()["running"] is True
+    finally:
+        serving.shutdown()
+
+
+def test_error_results_unblock_waiting_clients(ctx):
+    """The old engine hung clients forever on a poisoned record; now query()
+    resolves with the error payload well before its deadline."""
+    q = InProcQueue()
+    serving = _serving(q)
+    q.xadd({"uri": "bad", "image": "%%%"})   # undecodable base64 image
+    serving.start()
+    try:
+        t0 = time.time()
+        res = OutputQueue(q).query("bad", timeout_s=15)
+        assert time.time() - t0 < 10
+        assert OutputQueue.is_error(res)
+        assert "preprocess" in res["error"]
+    finally:
+        serving.shutdown()
+
+
+def test_manager_health_snapshot(tmp_path, ctx):
+    """serve_from_config + the manager's health-file writer: the snapshot
+    reflects ClusterServing.health() and the health CLI surfaces it."""
+    import json
+
+    from analytics_zoo_tpu.serving import manager
+
+    q = InProcQueue()
+    serving = _serving(q)
+    serving.start()
+    try:
+        path = str(tmp_path / "cs.pid.health.json")
+        manager._write_health(serving, path)
+        with open(path) as f:
+            h = json.load(f)
+        assert h["running"] is True and "workers" in h
+        assert manager._health_path(str(tmp_path / "cs.pid")) == path
+    finally:
+        serving.shutdown()
