@@ -55,6 +55,25 @@ class Deadline:
         return self.remaining() <= 0
 
 
+def wait_until(predicate: Callable[[], bool], timeout_s: Optional[float],
+               poll_s: float = 0.01,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic) -> bool:
+    """Poll ``predicate`` until it turns true or ``timeout_s`` elapses;
+    returns the final predicate value.  The graceful-drain wait
+    (engine ``shutdown(drain_s=...)``) and tests share this instead of
+    hand-rolled while/sleep loops; injectable clock/sleep keeps chaos tests
+    wall-clock-free."""
+    deadline = Deadline(timeout_s, clock=clock)
+    while True:
+        if predicate():
+            return True
+        remaining = deadline.remaining()
+        if remaining <= 0:
+            return bool(predicate())
+        sleep(min(poll_s, max(remaining, 0.0)))
+
+
 class RetryPolicy:
     """Exponential backoff + deterministic jitter + optional deadline.
 

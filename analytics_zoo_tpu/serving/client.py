@@ -1,8 +1,20 @@
-"""Serving client — InputQueue / OutputQueue.
+"""Serving client — InputQueue / OutputQueue / Client.
 
 Reference parity: pyzoo/zoo/serving/client.py:62-160 — `InputQueue.enqueue_image`
 (base64 → stream XADD) and `OutputQueue.query/dequeue` (result table reads), over any
 queue backend (in-proc, file spool, or Redis).
+
+Availability layer (PR 2): `timeout_s` at enqueue stamps ``deadline_ns`` on
+the record — the engine sheds it with a ``deadline-exceeded`` error result
+once the budget elapses (never wasting a predict slot on a dead request),
+and `Client.query` polls against the SAME budget, so an enqueue+query pair
+shares one end-to-end deadline.  ``deadline_ns`` is WALL-CLOCK epoch ns
+(`time.time_ns`): with producer and engine on different hosts the deadline
+is only as accurate as their clock sync (NTP drift stretches or shrinks
+budgets by the skew) — keep budgets comfortably above the expected skew, or
+run producer and engine on the same host for exact semantics.  `xadd` may raise `QueueFull`/`QueueClosed`
+(admission control / graceful drain) — a typed rejection at enqueue time
+instead of unbounded queue growth.
 """
 
 from __future__ import annotations
@@ -17,12 +29,19 @@ from analytics_zoo_tpu.common.resilience import Deadline
 from analytics_zoo_tpu.serving.queues import BaseQueue
 
 
+def _stamp_deadline(record: Dict, timeout_s: Optional[float]) -> Dict:
+    if timeout_s is not None:
+        record["deadline_ns"] = time.time_ns() + int(timeout_s * 1e9)
+    return record
+
+
 class InputQueue:
     def __init__(self, queue: BaseQueue):
         self.queue = queue
 
     def enqueue_image(self, uri: str, image, resize=None, fmt: str = ".png",
-                      quality: int = 95, device_uint8: bool = False) -> str:
+                      quality: int = 95, device_uint8: bool = False,
+                      timeout_s: Optional[float] = None) -> str:
         """image: path, encoded bytes, or HWC ndarray (encoded to `fmt`).
 
         fmt=".jpg" (round 5) ships compressed JPEG — the reference's actual
@@ -49,10 +68,11 @@ class InputQueue:
             record["resize"] = list(resize)
         if device_uint8:
             record["u8"] = 1
-        return self.queue.xadd(record)
+        return self.queue.xadd(_stamp_deadline(record, timeout_s))
 
     def enqueue_tensor(self, uri: str, tensor: np.ndarray,
-                       wire: str = "f32") -> str:
+                       wire: str = "f32",
+                       timeout_s: Optional[float] = None) -> str:
         """Raw little-endian bytes, base64-wrapped (the reference's
         b64-encoded tensor wire format, serving/http style) — a Python-list
         round trip here cost ~5 ms/record to encode and ~10x that to decode,
@@ -68,31 +88,32 @@ class InputQueue:
             a = np.asarray(tensor, np.float32)
             scale = float(np.max(np.abs(a)) / 127.0) or 1.0
             q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
-            return self.queue.xadd({
+            return self.queue.xadd(_stamp_deadline({
                 "uri": uri,
                 "b64": base64.b64encode(
                     np.ascontiguousarray(q).tobytes()).decode("ascii"),
                 "dtype": "<i1",
                 "scale": scale,
-                "shape": list(q.shape)})
+                "shape": list(q.shape)}, timeout_s))
         if wire != "f32":
             raise ValueError(f"unknown wire format {wire!r} "
                              "(expected 'f32' or 'int8')")
         arr = np.ascontiguousarray(np.asarray(tensor, "<f4"))
-        return self.queue.xadd({
+        return self.queue.xadd(_stamp_deadline({
             "uri": uri,
             "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
             "dtype": "<f4",
-            "shape": list(arr.shape)})
+            "shape": list(arr.shape)}, timeout_s))
 
 
 class OutputQueue:
     def __init__(self, queue: BaseQueue):
         self.queue = queue
 
-    def query(self, uri: str, timeout_s: float = 0.0,
+    def query(self, uri: str, timeout_s: Optional[float] = 0.0,
               poll_s: float = 0.01) -> Optional[Dict]:
-        """Poll for the record's result until `timeout_s`.  A quarantined
+        """Poll for the record's result until `timeout_s` (None = until a
+        result arrives).  A quarantined
         record resolves to an ``{"error": ...}`` dict (engine dead-letter
         path) — callers should check `is_error` rather than blocking on a
         value that will never arrive."""
@@ -111,6 +132,101 @@ class OutputQueue:
         """True when a result is a dead-letter error marker."""
         return isinstance(result, dict) and "error" in result
 
+    @staticmethod
+    def is_deadline_exceeded(result: Optional[Dict]) -> bool:
+        """True when a result is a deadline-shed marker (engine- or
+        client-side)."""
+        return (OutputQueue.is_error(result)
+                and str(result["error"]).startswith("deadline-exceeded"))
+
     def dead_letters(self) -> List[Dict]:
         """Quarantined records (uri + error + offending record when small)."""
         return self.queue.dead_letters()
+
+
+class Client:
+    """Enqueue + query with ONE end-to-end budget (PR 2 availability).
+
+    ``enqueue_tensor(uri, x, timeout_s=2.0)`` stamps ``deadline_ns`` on the
+    record; ``query(uri)`` then polls against the REMAINING budget of that
+    same deadline — and resolves to a local ``deadline-exceeded`` error when
+    it elapses, so a caller never hangs past its budget even if the engine
+    died before shedding the record."""
+
+    def __init__(self, queue: BaseQueue,
+                 default_timeout_s: Optional[float] = None):
+        self.input = InputQueue(queue)
+        self.output = OutputQueue(queue)
+        self.default_timeout_s = default_timeout_s
+        self._deadline_ns: Dict[str, int] = {}
+
+    _MAX_TRACKED = 1024
+
+    def _remember(self, uri: str, timeout_s: Optional[float]) -> None:
+        now = time.time_ns()
+        if len(self._deadline_ns) >= self._MAX_TRACKED:
+            # fire-and-forget producers never query(): prune expired budgets
+            # so the map stays bounded over a long-lived client
+            self._deadline_ns = {u: d for u, d in self._deadline_ns.items()
+                                 if d > now}
+            if len(self._deadline_ns) >= self._MAX_TRACKED:
+                # all still live (high rate x long budgets): evict the
+                # soonest-expiring half so the map — and the per-enqueue
+                # prune cost — stays hard-bounded; an evicted uri's query()
+                # degrades to a plain poll instead of a synthesized
+                # deadline-exceeded marker
+                keep = sorted(self._deadline_ns.items(),
+                              key=lambda kv: kv[1])[self._MAX_TRACKED // 2:]
+                self._deadline_ns = dict(keep)
+        if timeout_s is not None:
+            self._deadline_ns[uri] = now + int(timeout_s * 1e9)
+
+    def enqueue_tensor(self, uri: str, tensor, wire: str = "f32",
+                       timeout_s: Optional[float] = None) -> str:
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.default_timeout_s
+        rid = self.input.enqueue_tensor(uri, tensor, wire=wire,
+                                        timeout_s=timeout_s)
+        self._remember(rid, timeout_s)
+        return rid
+
+    def enqueue_image(self, uri: str, image, timeout_s: Optional[float] = None,
+                      **kwargs) -> str:
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.default_timeout_s
+        rid = self.input.enqueue_image(uri, image, timeout_s=timeout_s,
+                                       **kwargs)
+        self._remember(rid, timeout_s)
+        return rid
+
+    def query(self, uri: str, timeout_s: Optional[float] = None,
+              poll_s: float = 0.01) -> Optional[Dict]:
+        """Poll for `uri`'s result within the budget stamped at enqueue (or
+        an explicit `timeout_s` override; with neither, wait until a result
+        arrives).  Resolves to a `deadline-exceeded` error only once the
+        STAMPED budget has truly elapsed — a short explicit poll that comes
+        back empty mid-budget returns None, not a terminal error."""
+        stamped = self._deadline_ns.get(uri)
+        if timeout_s is None and stamped is not None:
+            timeout_s = max((stamped - time.time_ns()) / 1e9, 0.0)
+        elif timeout_s is None:
+            # uri not tracked (never stamped, or evicted from the bounded
+            # map): fall back to the client default rather than an
+            # unbounded wait
+            timeout_s = self.default_timeout_s
+        res = self.output.query(uri, timeout_s=timeout_s, poll_s=poll_s)
+        if res is not None:
+            self._deadline_ns.pop(uri, None)
+            return res
+        if stamped is not None and time.time_ns() >= stamped:
+            self._deadline_ns.pop(uri, None)
+            return {"error": "deadline-exceeded: client budget elapsed "
+                             "before a result arrived"}
+        return None
+
+    def predict(self, uri: str, tensor, wire: str = "f32",
+                timeout_s: Optional[float] = None) -> Optional[Dict]:
+        """One-shot enqueue+wait sharing a single end-to-end deadline
+        (no budget anywhere -> waits until the result arrives)."""
+        self.enqueue_tensor(uri, tensor, wire=wire, timeout_s=timeout_s)
+        return self.query(uri)

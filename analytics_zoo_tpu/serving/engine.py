@@ -34,7 +34,8 @@ import numpy as np
 from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                  CircuitBreakerOpen,
                                                  RetryPolicy,
-                                                 SupervisedThread)
+                                                 SupervisedThread,
+                                                 wait_until)
 from analytics_zoo_tpu.inference.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.queues import BaseQueue
 
@@ -115,7 +116,11 @@ class ServingParams:
                  max_worker_restarts: int = 5,
                  worker_backoff_s: float = 0.05,
                  breaker_threshold: int = 5,
-                 breaker_cooldown_s: float = 0.5):
+                 breaker_cooldown_s: float = 0.5,
+                 http_port: Optional[int] = None,
+                 http_host: str = "127.0.0.1",
+                 drain_s: Optional[float] = None,
+                 ready_queue_depth: Optional[int] = None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -132,6 +137,14 @@ class ServingParams:
         self.worker_backoff_s = worker_backoff_s
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown_s = breaker_cooldown_s
+        # availability layer (PR 2): HTTP probes (/healthz /readyz /metrics;
+        # None = off, 0 = ephemeral port), graceful-drain budget used by the
+        # manager's SIGTERM handler, and the /readyz queue-depth threshold
+        # (None falls back to the queue's own max_depth admission cap)
+        self.http_port = http_port
+        self.http_host = http_host
+        self.drain_s = drain_s
+        self.ready_queue_depth = ready_queue_depth
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -150,7 +163,14 @@ class ServingParams:
             max_worker_restarts=int(p.get("max_worker_restarts", 5)),
             worker_backoff_s=float(p.get("worker_backoff_s", 0.05)),
             breaker_threshold=int(p.get("breaker_threshold", 5)),
-            breaker_cooldown_s=float(p.get("breaker_cooldown_s", 0.5)))
+            breaker_cooldown_s=float(p.get("breaker_cooldown_s", 0.5)),
+            http_port=(None if p.get("http_port") is None
+                       else int(p["http_port"])),
+            http_host=str(p.get("http_host", "127.0.0.1")),
+            drain_s=(None if p.get("drain_s") is None
+                     else float(p["drain_s"])),
+            ready_queue_depth=(None if p.get("ready_queue_depth") is None
+                               else int(p["ready_queue_depth"])))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -173,9 +193,12 @@ class ClusterServing:
         self.postprocess = postprocess or (
             lambda p: default_postprocess(p, self.params.top_n))
         self._stop = threading.Event()
+        self._draining = threading.Event()   # graceful drain in progress
         self._thread: Optional[threading.Thread] = None
         self.total_records = 0
         self.dead_lettered = 0
+        self.shed = 0                        # deadline-exceeded rejections
+        self._http = None                    # HealthServer when http_port set
         p = self.params
         self._write_retry = RetryPolicy(max_retries=p.write_retries,
                                         base_delay_s=p.write_backoff_s)
@@ -223,20 +246,39 @@ class ClusterServing:
         except Exception:  # noqa: BLE001 — best-effort: queue may be down
             logger.exception("serving: dead-letter write for %r failed", rid)
 
-    def _stack_group(self, ids, items):
-        """Stack one same-shape group into a staged (ids, tensors, scales)
-        micro-batch."""
+    # -- end-to-end deadlines (PR 2 availability) ----------------------------
+    def _shed_expired(self, rid, rec: Optional[Dict],
+                      deadline_ns: Optional[int] = None) -> bool:
+        """True when the record's enqueue-stamped `deadline_ns` has passed:
+        the client gets a `deadline-exceeded` error result and the record
+        never occupies a predict slot."""
+        dl = deadline_ns if deadline_ns is not None \
+            else (rec or {}).get("deadline_ns")
+        if dl is None or time.time_ns() <= int(dl):
+            return False
+        self.shed += 1
+        logger.info("serving: shedding expired record %r", rid)
+        try:
+            self._put_result(rid, {"error": "deadline-exceeded: budget "
+                                            "elapsed before predict"})
+        except Exception:  # noqa: BLE001 — store down: client's own
+            pass           # deadline still unblocks it
+        return True
+
+    def _stack_group(self, ids, items, deadlines):
+        """Stack one same-shape group into a staged
+        (ids, tensors, scales, deadlines) micro-batch."""
         if all(isinstance(it, QuantizedTensor) for it in items):
             # compact-dtype batch: ship the int8/uint8 bytes to the device,
             # dequantize there (per-row scales)
             tensors = np.stack([it.data for it in items])
             scales = np.asarray([it.scale for it in items], np.float32)
-            return ids, tensors, scales
+            return ids, tensors, scales, deadlines
         # mixed float/quantized batches dequantize the stragglers on host
         tensors = np.stack([
             it.data.astype(np.float32) * it.scale
             if isinstance(it, QuantizedTensor) else it for it in items])
-        return ids, tensors, None
+        return ids, tensors, None, deadlines
 
     def _read_and_preprocess(self):
         """Read one micro-batch and preprocess it record-by-record, returning
@@ -249,9 +291,11 @@ class ClusterServing:
         batch = self.queue.read_batch(self.params.batch_size,
                                       self.params.poll_timeout_s)
         if not batch:
-            return None
+            return None       # stream empty (drain may exit on this)
         groups: Dict[tuple, List] = {}
         for rid, rec in batch:
+            if self._shed_expired(rid, rec):
+                continue
             try:
                 item = self.preprocess(rec)
             except Exception as e:  # noqa: BLE001 — malformed record
@@ -259,12 +303,16 @@ class ClusterServing:
                 continue
             shape = np.shape(item.data if isinstance(item, QuantizedTensor)
                              else item)
-            groups.setdefault(shape, []).append((rid, item))
+            groups.setdefault(shape, []).append(
+                (rid, item, rec.get("deadline_ns")))
         if not groups:
-            return None
-        return [self._stack_group([rid for rid, _ in pairs],
-                                  [it for _, it in pairs])
-                for pairs in groups.values()]
+            # records WERE read but all shed/quarantined: distinct from an
+            # empty stream so a draining _pre_loop keeps reading the backlog
+            return []
+        return [self._stack_group([rid for rid, _, _ in triples],
+                                  [it for _, it, _ in triples],
+                                  [dl for _, _, dl in triples])
+                for triples in groups.values()]
 
     def _predict_isolated(self, ids, tensors, scales):
         """Predict with graceful degradation: on failure, bisect the batch to
@@ -285,7 +333,21 @@ class ClusterServing:
                 None if scales is None else scales[mid:])
             return lo + hi
 
-    def _predict_and_write(self, ids, tensors, scales=None) -> int:
+    def _predict_and_write(self, ids, tensors, scales=None,
+                           deadlines=None) -> int:
+        # second deadline gate: a record can expire while staged behind a
+        # slow predict — shed it here so the batch never wastes device time
+        # on rows nobody is waiting for
+        if deadlines is not None and any(d is not None for d in deadlines):
+            keep = [i for i, (rid, dl) in enumerate(zip(ids, deadlines))
+                    if not self._shed_expired(rid, None, deadline_ns=dl)]
+            if not keep:
+                return 0
+            if len(keep) < len(ids):
+                ids = [ids[i] for i in keep]
+                tensors = tensors[keep]
+                if scales is not None:
+                    scales = scales[keep]
         t0 = time.time()
         n = 0
         for chunk_ids, probs in self._predict_isolated(ids, tensors, scales):
@@ -337,6 +399,19 @@ class ClusterServing:
         import queue as _q
         p = self.params
         self._stop.clear()
+        self._draining.clear()
+        try:
+            # a prior drained shutdown closed admission; serving again means
+            # taking traffic again
+            self.queue.open_admission()
+        except Exception:  # noqa: BLE001 — backend down: workers will report
+            pass
+        # bind the probe server FIRST: a port conflict must fail start()
+        # before any worker thread begins consuming the queue
+        if p.http_port is not None and self._http is None:
+            from analytics_zoo_tpu.serving.http import HealthServer
+            self._http = HealthServer(self, host=p.http_host,
+                                      port=p.http_port).start()
         self._staged = _q.Queue(maxsize=p.pipeline_depth)
         self._pre_sup = SupervisedThread(
             self._pre_loop, name="serving-preprocess",
@@ -360,6 +435,18 @@ class ClusterServing:
                 sup.heartbeat()
             staged = self._read_and_preprocess()
             if not staged:
+                # None = stream empty; [] = batch read but fully shed/
+                # quarantined — only the former may end a drain, and only
+                # when the backend is actually reachable: an outage ALSO
+                # reads as an empty batch, but its backlog is still out
+                # there, so keep polling until it heals or the drain budget
+                # hard-stops us
+                if staged is None and self._draining.is_set():
+                    try:
+                        if self.queue.read_path_healthy():
+                            return     # drain: stream empty, clean exit
+                    except Exception:  # noqa: BLE001 — state unknown
+                        pass
                 time.sleep(0.005)
                 continue
             for group in staged:
@@ -377,14 +464,24 @@ class ClusterServing:
             if sup is not None:
                 sup.heartbeat()
             try:
-                ids, tensors, scales = self._staged.get(timeout=0.1)
+                group = self._staged.get(timeout=0.1)
             except _q.Empty:
+                # drain exit: ONLY once the pre worker is dead AND the buffer
+                # is (still) empty — is_alive first, so a group staged just
+                # before the pre worker exited is seen by the empty() check
+                if self._draining.is_set() and self._pre_sup is not None \
+                        and not self._pre_sup.is_alive() \
+                        and self._staged.empty():
+                    return             # drain: upstream done + buffer empty
                 continue
-            self._predict_and_write(ids, tensors, scales)
+            self._predict_and_write(*group)
 
     def health(self) -> Dict:
-        """Serving health surface (manager `status` / ops): worker states,
-        restart counts, breaker state, record/dead-letter counters."""
+        """Serving health surface (manager `status` / ops, `/healthz`):
+        worker states, restart counts, breaker state, record/dead-letter/
+        shed counters, queue health, and the readiness verdict — the one
+        document every surface (health.json snapshot, health CLI, HTTP
+        probes) serves."""
         workers = {}
         for sup in (self._pre_sup, self._predict_sup):
             if sup is not None:
@@ -394,19 +491,90 @@ class ClusterServing:
                            SupervisedThread.RUNNING,
                            SupervisedThread.RESTARTING)
             for w in workers.values())
-        return {"running": running,
-                "total_records": self.total_records,
-                "dead_lettered": self.dead_lettered,
-                "breaker": self._breaker.health(),
-                "dead_letter_breaker": self._dead_breaker.health(),
-                "workers": workers}
+        try:
+            queue_health = self.queue.health()
+        except Exception as e:  # noqa: BLE001 — backend down ≠ probe down
+            queue_health = {"backend": type(self.queue).__name__,
+                            "reachable": False,
+                            "error": f"{type(e).__name__}: {e}"}
+        h = {"running": running,
+             "draining": self._draining.is_set(),
+             "total_records": self.total_records,
+             "dead_lettered": self.dead_lettered,
+             "shed": self.shed,
+             "breaker": self._breaker.health(),
+             "dead_letter_breaker": self._dead_breaker.health(),
+             "workers": workers,
+             "queue": queue_health}
+        h["ready"] = self._readiness(h)
+        return h
 
-    def shutdown(self):
+    def _readiness(self, h: Dict) -> Dict:
+        """/readyz verdict derived from an already-computed health doc."""
+        reasons = []
+        if h["draining"]:
+            reasons.append("draining")
+        if not h["running"]:
+            reasons.append("workers-not-running")
+        if h["breaker"]["state"] == CircuitBreaker.OPEN:
+            reasons.append("result-write-breaker-open")
+        q = h["queue"]
+        if not q.get("reachable", True):
+            reasons.append("backend-unreachable")
+        rb = q.get("read_breaker")
+        if rb is not None and rb["state"] == CircuitBreaker.OPEN:
+            reasons.append("read-breaker-open")
+        cap = self.params.ready_queue_depth
+        if cap is None:
+            cap = q.get("max_depth")
+        depth = q.get("depth", -1)
+        if cap is not None and depth >= 0 and depth >= cap:
+            reasons.append(f"queue-depth {depth} >= {cap}")
+        return {"ready": not reasons, "reasons": reasons}
+
+    def ready(self) -> Dict:
+        """Readiness probe document (`/readyz`)."""
+        return self.health()["ready"]
+
+    def metrics(self) -> Dict:
+        """Flat JSON counters (`/metrics`)."""
+        h = self.health()
+        return {"served": h["total_records"],
+                "quarantined": h["dead_lettered"],
+                "shed": h["shed"],
+                "restarts": sum(w["restart_count"]
+                                for w in h["workers"].values()),
+                "queue_depth": h["queue"].get("depth", -1),
+                "dead_letters": h["queue"].get("dead_letters", -1),
+                "breaker_trips": h["breaker"]["trip_count"]}
+
+    def shutdown(self, drain_s: Optional[float] = None):
+        """Stop serving.  With ``drain_s`` (graceful drain, PR 2): close
+        admission on the queue, flip `/readyz` to ``draining`` so probes
+        stop routing traffic, let the workers finish the stream backlog and
+        flush every in-flight result, then join — falling back to a hard
+        stop when the budget runs out.  Without it: immediate stop (the
+        PR 1 behaviour)."""
+        if drain_s is None:
+            drain_s = 0.0
+        started = self._pre_sup is not None or self._predict_sup is not None
+        if drain_s > 0 and started:
+            self._draining.set()
+            try:
+                self.queue.close_admission()
+            except Exception:  # noqa: BLE001 — backend down: drain anyway
+                pass
+            wait_until(lambda: not any(
+                s is not None and s.is_alive()
+                for s in (self._pre_sup, self._predict_sup)), drain_s)
         # the compat aliases (_pre_thread/_thread) point at the SAME thread
         # objects the supervisors own — joining the supervisors covers them
         self._stop.set()
         for sup in (self._pre_sup, self._predict_sup):
             if sup is not None:
                 sup.join(timeout=5)
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
         if self._tb is not None:
             self._tb.flush()

@@ -1,0 +1,99 @@
+"""Serving availability probes — stdlib HTTP endpoint (PR 2 tentpole).
+
+The reference's Cluster Serving relied on the Spark UI + lifecycle scripts
+for operational visibility; a TPU-native deployment sits behind a k8s-style
+orchestrator that speaks HTTP probes.  `HealthServer` exposes the engine's
+existing health surface on three routes:
+
+- ``GET /healthz``  — liveness: 200 while the engine's workers are running
+  (or restarting under supervision), 503 once a worker is FAILED past its
+  restart cap or the engine stopped.  Body: the full
+  ``ClusterServing.health()`` document — the SAME document the manager
+  snapshots to ``<pidfile>.health.json``.
+- ``GET /readyz``   — readiness: 200 only when the engine can take traffic
+  (workers alive, breakers not open, queue depth under the admission
+  threshold, backend reachable, not draining).  503 with
+  ``{"ready": false, "reasons": [...]}`` otherwise — ``"draining"`` during
+  graceful shutdown so load balancers stop routing before the process exits.
+- ``GET /metrics``  — JSON counters: ``served``, ``quarantined``, ``shed``
+  (deadline-exceeded), ``restarts``, ``queue_depth``, ``dead_letters``.
+
+Zero dependencies: `ThreadingHTTPServer` on a daemon thread, started by
+``ClusterServing.start()`` when ``ServingParams.http_port`` is set (0 picks
+an ephemeral port, exposed as ``HealthServer.port``) and stopped by
+``shutdown()`` after the drain completes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class HealthServer:
+    """Liveness/readiness/metrics probes over a serving engine."""
+
+    def __init__(self, serving, host: str = "127.0.0.1", port: int = 0):
+        self.serving = serving
+        self.host = host
+        self.port = port                    # actual port after start()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HealthServer":
+        serving = self.serving
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
+                logger.debug("probe: " + fmt, *args)
+
+            def _reply(self, status: int, doc) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                try:
+                    if self.path == "/healthz":
+                        h = serving.health()
+                        self._reply(200 if h.get("running") else 503, h)
+                    elif self.path == "/readyz":
+                        r = serving.ready()
+                        self._reply(200 if r.get("ready") else 503, r)
+                    elif self.path == "/metrics":
+                        self._reply(200, serving.metrics())
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except Exception as e:  # noqa: BLE001 — probe must answer
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="serving-probes", daemon=True)
+        self._thread.start()
+        logger.info("serving probes on http://%s:%d/{healthz,readyz,metrics}",
+                    self.host, self.port)
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

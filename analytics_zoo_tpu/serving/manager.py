@@ -18,6 +18,7 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       redis_host: localhost
       redis_port: 6379
       stream: image_stream
+      max_depth: null                 # admission cap: xadd raises QueueFull
     params:
       batch_size: 4
       top_n: 5
@@ -27,12 +28,18 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       worker_backoff_s: 0.05
       breaker_threshold: 5
       breaker_cooldown_s: 0.5
+      http_port: null                   # availability (PR 2): /healthz,
+      http_host: 127.0.0.1              # /readyz, /metrics probe endpoint
+      drain_s: null                     # graceful-drain budget on SIGTERM
+      ready_queue_depth: null           # /readyz depth threshold
 
 CLI (used by scripts/cluster-serving/*.sh):
     python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]
     python -m analytics_zoo_tpu.serving.manager stop|status|restart
     python -m analytics_zoo_tpu.serving.manager health   # worker/breaker/
         # dead-letter state from the daemon's <pidfile>.health.json snapshot
+    python -m analytics_zoo_tpu.serving.manager replay [--filter SUBSTR]
+        # re-enqueue quarantined records after a fix (dead-letter replay)
 """
 
 from __future__ import annotations
@@ -128,16 +135,20 @@ def load_model(cfg: dict) -> InferenceModel:
 def build_queue(cfg: dict):
     dcfg = cfg.get("data", {})
     src = str(dcfg.get("src", "redis"))
+    max_depth = dcfg.get("max_depth")
+    if max_depth is not None:
+        max_depth = int(max_depth)
     if src.startswith("file:"):
         from analytics_zoo_tpu.serving.queues import FileQueue
-        return FileQueue(src.split(":", 1)[1])
+        return FileQueue(src.split(":", 1)[1], max_depth=max_depth)
     if src == "inproc":
         from analytics_zoo_tpu.serving.queues import InProcQueue
-        return InProcQueue()
+        return InProcQueue(max_depth=max_depth)
     from analytics_zoo_tpu.serving.queues import RedisQueue
     return RedisQueue(host=dcfg.get("redis_host", "localhost"),
                       port=int(dcfg.get("redis_port", 6379)),
-                      stream=dcfg.get("stream", "image_stream"))
+                      stream=dcfg.get("stream", "image_stream"),
+                      max_depth=max_depth)
 
 
 def serving_params(cfg: dict) -> ServingParams:
@@ -178,8 +189,10 @@ def _run_foreground(config_path: str, pidfile: str):
     health_path = _health_path(pidfile)
 
     def _terminate(signum, frame):
-        # ClusterServingManager.listenTermination analog: drain + exit
-        serving.shutdown()
+        # ClusterServingManager.listenTermination analog: graceful drain
+        # (admission closed, /readyz flips to draining, in-flight results
+        # flushed within params.drain_s) + exit
+        serving.shutdown(drain_s=serving.params.drain_s)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -199,10 +212,14 @@ def main(argv=None):
     import argparse
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
-                    choices=["start", "stop", "status", "restart", "health"])
+                    choices=["start", "stop", "status", "restart", "health",
+                             "replay"])
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
+    ap.add_argument("--filter", default=None, metavar="SUBSTR",
+                    help="replay only dead letters whose uri or error "
+                         "contains SUBSTR")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -226,6 +243,24 @@ def main(argv=None):
         except (OSError, ValueError):
             return None
 
+    if args.action == "replay":
+        # dead-letter replay (ROADMAP open item): re-enqueue quarantined
+        # records after a fix — works against the live daemon's backend
+        # (file/redis are cross-process), no model load needed
+        queue = build_queue(load_config(args.config))
+        sub = args.filter
+        filt = None if sub is None else (
+            lambda e: sub in str(e.get("uri", ""))
+            or sub in str(e.get("error", "")))
+        out = queue.replay_dead_letters(filter=filt)
+        # admission_open=false explains a 0-replayed run: a drained queue
+        # rejects re-enqueues until serving starts again (which reopens it)
+        print(json.dumps({"replayed": len(out["replayed"]),
+                          "skipped": len(out["skipped"]),
+                          "uris": out["replayed"],
+                          "admission_open": bool(
+                              queue.health().get("admission_open", True))}))
+        return 0
     if args.action == "status":
         pid = read_pid()
         up = pid is not None and alive(pid)

@@ -13,6 +13,17 @@ poisoned record — it writes an `{"error": ...}` result under the record's key
 (so a waiting client unblocks and SEES the failure instead of hanging) and
 appends `{"uri", "error", "record"?}` to a dead-letter stream that
 `dead_letters()` exposes for inspection/replay.
+
+Availability layer (PR 2):
+- admission control — `max_depth` caps the stream; `xadd` raises `QueueFull`
+  instead of growing unboundedly, and `close_admission()` (graceful drain)
+  raises `QueueClosed` for new records.
+- `depth()` / `health()` feed the engine's `/readyz` probe.
+- `replay_dead_letters()` re-enqueues quarantined records after a fix and
+  clears them (and their stale error results) from the dead-letter store.
+- RedisQueue reads (`read_batch`/`get_result`) go through RetryPolicy + a
+  read-side CircuitBreaker: an outage degrades to empty batches (readiness
+  flips) instead of crash-looping the supervised preprocess worker.
 """
 
 from __future__ import annotations
@@ -23,10 +34,28 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the stream is at `max_depth`.  Enqueue callers
+    should back off / shed, not retry in a tight loop."""
+
+
+class QueueClosed(QueueFull):
+    """Admission rejected: the queue is draining (graceful shutdown)."""
 
 
 class BaseQueue:
+    # admission control (PR 2): None = unbounded; `xadd` implementations call
+    # `_check_admission()` before accepting a record.  The cap is exact for
+    # InProcQueue (checked inside the append's lock) and BEST-EFFORT for the
+    # cross-process backends: k concurrent producer processes can overshoot
+    # by up to k-1 records per admission cycle (check-then-write without a
+    # cross-process lock) — the cap bounds growth, it is not a hard ceiling
+    max_depth: Optional[int] = None
+    admission_open: bool = True
+
     def xadd(self, record: Dict) -> str:
         raise NotImplementedError
 
@@ -42,6 +71,74 @@ class BaseQueue:
     def result_count(self) -> int:
         raise NotImplementedError
 
+    def delete_result(self, key: str) -> None:
+        """Drop a stale result (replay path: the old error marker must not
+        shadow the re-enqueued record's fresh result)."""
+        raise NotImplementedError
+
+    # -- admission control (PR 2 availability) -------------------------------
+    def depth(self) -> int:
+        """Records waiting in the stream (readiness + admission signal)."""
+        raise NotImplementedError
+
+    def close_admission(self) -> None:
+        """Graceful drain: reject new records with `QueueClosed` while the
+        engine flushes in-flight work."""
+        self.admission_open = False
+
+    def open_admission(self) -> None:
+        self.admission_open = True
+
+    def _admission_closed_externally(self) -> bool:
+        """Cross-process admission signal: the drain runs in the serving
+        daemon, but producers hold their OWN queue handles — File/Redis
+        backends persist the closure (marker file / redis key) so every
+        handle rejects during a drain, not just the engine's."""
+        return False
+
+    def _check_admission(self) -> None:
+        if not self.admission_open or self._admission_closed_externally():
+            raise QueueClosed("queue draining: admission closed")
+        if self.max_depth is not None:
+            depth = self.depth()       # once: rejection happens mid-flood,
+            if depth >= self.max_depth:  # don't double the backend load
+                raise QueueFull(
+                    f"queue depth {depth} >= max_depth {self.max_depth}")
+
+    def reachable(self) -> bool:
+        """Backend liveness (readiness probe); in-process backends are always
+        reachable, RedisQueue pings the server."""
+        return True
+
+    def read_path_healthy(self) -> bool:
+        """True when an EMPTY read_batch really means the stream is empty —
+        the graceful-drain exit gate.  RedisQueue reports False while its
+        read breaker is not closed (an outage also reads as an empty batch,
+        but the backlog is still out there)."""
+        return True
+
+    def health(self) -> Dict:
+        """Queue-side readiness document consumed by the engine's
+        `/readyz` probe and the manager health snapshot."""
+        try:
+            depth = self.depth()
+        except Exception:  # noqa: BLE001 — backend down
+            depth = -1
+        try:
+            dead = self.dead_letter_count()
+        except Exception:  # noqa: BLE001
+            dead = -1
+        try:
+            closed_ext = self._admission_closed_externally()
+        except Exception:  # noqa: BLE001 — backend down
+            closed_ext = False
+        return {"backend": type(self).__name__,
+                "depth": depth,
+                "max_depth": self.max_depth,
+                "admission_open": self.admission_open and not closed_ext,
+                "reachable": self.reachable(),
+                "dead_letters": dead}
+
     # -- dead-letter side channel (PR 1 resilience) --------------------------
     def put_error(self, key: str, error: str,
                   record: Optional[Dict] = None) -> None:
@@ -56,6 +153,76 @@ class BaseQueue:
     def dead_letter_count(self) -> int:
         return len(self.dead_letters())
 
+    # -- dead-letter replay (PR 2 availability / ROADMAP open item) ----------
+    def replay_dead_letters(
+            self, filter: Optional[Callable[[Dict], bool]] = None) -> Dict:
+        """Re-enqueue quarantined records after a fix: for each dead-letter
+        entry (optionally narrowed by ``filter(entry) -> bool``) that still
+        carries its original ``record``, drop the stale error result, xadd the
+        record back onto the stream, and clear the entry from the dead-letter
+        store.  Entries without a record payload (e.g. predict-stage
+        quarantines) cannot be replayed and are left in place.
+
+        Returns ``{"replayed": [uris], "skipped": [uris]}``.  Stops early on
+        `QueueFull` so replay respects admission control."""
+        replayed: List[str] = []
+        skipped: List[str] = []
+        for token, entry in self._dead_letter_items():
+            if filter is not None and not filter(entry):
+                continue
+            record = entry.get("record")
+            if not isinstance(record, dict) or \
+                    not ({"image", "b64", "data"} & set(record)):
+                # no payload, or not a real record (e.g. a malformed-entry
+                # quarantine keeping only {'raw': ...}): re-enqueueing it
+                # would just churn it straight back into quarantine
+                skipped.append(entry.get("uri", "?"))
+                continue
+            if "deadline_ns" in record:
+                # the original budget is long gone: shipped verbatim the
+                # engine would shed the replayed record as deadline-exceeded
+                # the moment it is read — replay grants a fresh (unbounded)
+                # budget instead
+                record = {k: v for k, v in record.items()
+                          if k != "deadline_ns"}
+            uri = entry.get("uri") or record.get("uri")
+            try:
+                self._check_admission()
+            except QueueFull:
+                break                      # respect admission; retry later
+            # drop the stale error marker BEFORE re-enqueueing — the engine
+            # may answer the replayed record at any point after xadd, and a
+            # late delete would destroy the fresh result
+            if uri:
+                try:
+                    self.delete_result(uri)
+                except Exception:  # noqa: BLE001 — stale marker best-effort
+                    pass
+            try:
+                self.xadd(record)
+            except Exception:  # noqa: BLE001 — admission race OR backend
+                # died mid-replay: either way the marker was already
+                # deleted — restore it so a polling client still sees the
+                # quarantine error, then stop with the partial report
+                if uri:
+                    try:
+                        self.put_result(uri, {"error": entry.get(
+                            "error", "quarantined (replay pending)")})
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                break
+            self._remove_dead_letter(token)
+            replayed.append(uri or "?")
+        return {"replayed": replayed, "skipped": skipped}
+
+    def _dead_letter_items(self) -> List[Tuple[object, Dict]]:
+        """(opaque-token, entry) pairs; the token feeds
+        ``_remove_dead_letter``."""
+        raise NotImplementedError
+
+    def _remove_dead_letter(self, token) -> None:
+        raise NotImplementedError
+
     def trim(self, max_len: int) -> None:
         """Memory guard (ClusterServing.scala:134-140 XTRIM analog)."""
 
@@ -69,17 +236,30 @@ def _dead_letter_entry(key: str, error: str,
 
 
 class InProcQueue(BaseQueue):
-    def __init__(self):
+    def __init__(self, max_depth: Optional[int] = None):
         self._stream = deque()
         self._results: Dict[str, Dict] = {}
         self._dead: List[Dict] = []
         self._lock = threading.Lock()
+        self.max_depth = max_depth
 
     def xadd(self, record):
         rid = record.get("uri") or str(uuid.uuid4())
         with self._lock:
+            # admission check INSIDE the append's critical section so
+            # concurrent producers cannot both pass at depth == cap - 1
+            if not self.admission_open:
+                raise QueueClosed("queue draining: admission closed")
+            if self.max_depth is not None and \
+                    len(self._stream) >= self.max_depth:
+                raise QueueFull(f"queue depth {len(self._stream)} >= "
+                                f"max_depth {self.max_depth}")
             self._stream.append((rid, record))
         return rid
+
+    def depth(self):
+        with self._lock:
+            return len(self._stream)
 
     def read_batch(self, max_items, timeout_s=0.1):
         deadline = time.time() + timeout_s
@@ -105,6 +285,10 @@ class InProcQueue(BaseQueue):
         with self._lock:
             return len(self._results)
 
+    def delete_result(self, key):
+        with self._lock:
+            self._results.pop(key, None)
+
     def put_error(self, key, error, record=None):
         with self._lock:
             self._results[key] = {"error": str(error)}
@@ -113,6 +297,18 @@ class InProcQueue(BaseQueue):
     def dead_letters(self):
         with self._lock:
             return list(self._dead)
+
+    def dead_letter_count(self):
+        with self._lock:
+            return len(self._dead)
+
+    def _dead_letter_items(self):
+        with self._lock:
+            return [(id(e), e) for e in self._dead]
+
+    def _remove_dead_letter(self, token):
+        with self._lock:
+            self._dead = [e for e in self._dead if id(e) != token]
 
     def trim(self, max_len):
         with self._lock:
@@ -124,7 +320,7 @@ class FileQueue(BaseQueue):
     """Spool-dir stream: records are json files named <seq>-<id>.json in stream/,
     results live in results/<key>.json.  Safe for one consumer, many producers."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, max_depth: Optional[int] = None):
         self.root = root
         self.stream_dir = os.path.join(root, "stream")
         self.result_dir = os.path.join(root, "results")
@@ -132,8 +328,36 @@ class FileQueue(BaseQueue):
         os.makedirs(self.stream_dir, exist_ok=True)
         os.makedirs(self.result_dir, exist_ok=True)
         os.makedirs(self.dead_dir, exist_ok=True)
+        self.max_depth = max_depth
+
+    def depth(self):
+        return sum(1 for f in os.listdir(self.stream_dir)
+                   if f.endswith(".json"))
+
+    def reachable(self):
+        return os.path.isdir(self.stream_dir)
+
+    # cross-process drain: the closure is a marker file every handle sees
+    def _admission_marker(self):
+        return os.path.join(self.root, "admission-closed")
+
+    def close_admission(self):
+        super().close_admission()
+        with open(self._admission_marker(), "w"):
+            pass
+
+    def open_admission(self):
+        super().open_admission()
+        try:
+            os.remove(self._admission_marker())
+        except FileNotFoundError:
+            pass
+
+    def _admission_closed_externally(self):
+        return os.path.exists(self._admission_marker())
 
     def xadd(self, record):
+        self._check_admission()
         rid = record.get("uri") or str(uuid.uuid4())
         seq = f"{time.time_ns()}"
         tmp = os.path.join(self.stream_dir, f".{seq}-{rid}.tmp")
@@ -155,7 +379,24 @@ class FileQueue(BaseQueue):
                     with open(path) as f:
                         rec = json.load(f)
                     os.remove(path)
-                except (FileNotFoundError, json.JSONDecodeError):
+                except FileNotFoundError:
+                    continue               # raced another consumer
+                except json.JSONDecodeError as e:
+                    # corrupt spool file (crash mid-write outside the
+                    # tmp/rename path, disk error): left in place it would
+                    # be re-parsed every poll AND count against the
+                    # max_depth admission cap forever — quarantine it alone
+                    rid = fname.split("-", 1)[1][:-5] if "-" in fname \
+                        else fname
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
+                    try:
+                        self.put_error(
+                            rid, f"read_batch: malformed entry: {e}")
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
                     continue
                 rid = fname.split("-", 1)[1][:-5]
                 out.append((rid, rec))
@@ -178,7 +419,16 @@ class FileQueue(BaseQueue):
             return json.load(f)
 
     def result_count(self):
-        return len(os.listdir(self.result_dir))
+        # only committed results: put_result writes `.{key}.tmp` then renames,
+        # so in-flight tmp files must not inflate the count
+        return sum(1 for f in os.listdir(self.result_dir)
+                   if f.endswith(".json"))
+
+    def delete_result(self, key):
+        try:
+            os.remove(os.path.join(self.result_dir, f"{key}.json"))
+        except FileNotFoundError:
+            pass
 
     def put_error(self, key, error, record=None):
         self.put_result(key, {"error": str(error)})
@@ -189,15 +439,29 @@ class FileQueue(BaseQueue):
         os.rename(tmp, os.path.join(self.dead_dir, f"{seq}-{key}.json"))
 
     def dead_letters(self):
+        return [e for _, e in self._dead_letter_items()]
+
+    def dead_letter_count(self):
+        # probes call this every few seconds: count filenames, don't parse
+        return sum(1 for f in os.listdir(self.dead_dir)
+                   if f.endswith(".json"))
+
+    def _dead_letter_items(self):
         out = []
         for fname in sorted(f for f in os.listdir(self.dead_dir)
                             if f.endswith(".json")):
             try:
                 with open(os.path.join(self.dead_dir, fname)) as f:
-                    out.append(json.load(f))
+                    out.append((fname, json.load(f)))
             except (FileNotFoundError, json.JSONDecodeError):
                 continue
         return out
+
+    def _remove_dead_letter(self, token):
+        try:
+            os.remove(os.path.join(self.dead_dir, token))
+        except FileNotFoundError:
+            pass
 
     def trim(self, max_len):
         files = sorted(f for f in os.listdir(self.stream_dir)
@@ -210,42 +474,171 @@ class FileQueue(BaseQueue):
 
 
 class RedisQueue(BaseQueue):
-    """Real Redis streams (requires the `redis` package + a server)."""
+    """Real Redis streams (requires the `redis` package + a server).
+
+    Self-healing read path (PR 2): `read_batch`/`get_result` run through a
+    RetryPolicy + a read-side CircuitBreaker — an outage degrades to empty
+    batches / None results (the engine's `/readyz` flips not-ready via
+    `health()`) instead of crash-looping the supervised preprocess worker;
+    after `read_breaker_cooldown_s` a half-open probe reconnects
+    automatically.  A malformed stream entry dead-letters ALONE: the rest of
+    the batch (already consumed past `_last_id`) is still delivered."""
 
     def __init__(self, host="localhost", port=6379, stream="image_stream",
-                 result_table="result"):
-        import redis
-        self.r = redis.Redis(host=host, port=port)
+                 result_table="result", max_depth: Optional[int] = None,
+                 client=None, read_retries: int = 2,
+                 read_backoff_s: float = 0.05,
+                 read_breaker_threshold: int = 5,
+                 read_breaker_cooldown_s: float = 1.0):
+        if client is None:
+            import redis
+            client = redis.Redis(host=host, port=port)
+        self.r = client
         self.stream = stream
         self.table = result_table
         self.dead_stream = stream + ":dead-letter"
         self._last_id = "0"
+        self.max_depth = max_depth
+        from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
+                                                         RetryPolicy)
+        self._read_retry = RetryPolicy(max_retries=read_retries,
+                                       base_delay_s=read_backoff_s)
+        self._read_breaker = CircuitBreaker(
+            failure_threshold=read_breaker_threshold,
+            cooldown_s=read_breaker_cooldown_s, name="redis-read")
+        self._last_read_failed = False
+
+    @staticmethod
+    def _decode(v):
+        return v.decode() if isinstance(v, (bytes, bytearray)) else str(v)
+
+    def _guarded_read(self, fn, *args, **kwargs):
+        """One read against Redis with retry + breaker; raises
+        `_ReadUnavailable` (internal) when the backend is down."""
+        from analytics_zoo_tpu.common.resilience import (CircuitBreakerOpen,
+                                                         RetryExhausted)
+        try:
+            return self._read_breaker.call(self._read_retry.call, fn,
+                                           *args, **kwargs)
+        except (CircuitBreakerOpen, RetryExhausted) as e:
+            raise _ReadUnavailable(str(e)) from e
 
     def xadd(self, record):
+        self._check_admission()
         rid = record.get("uri") or str(uuid.uuid4())
         self.r.xadd(self.stream, {"data": json.dumps(record)})
         return rid
 
+    def depth(self):
+        try:
+            return int(self.r.xlen(self.stream))
+        except Exception:  # noqa: BLE001 — outage: admission stays open,
+            return 0       # the write itself will surface the error
+
+    def reachable(self):
+        try:
+            return bool(self.r.ping())
+        except Exception:  # noqa: BLE001
+            return False
+
+    # cross-process drain: the closure is a redis key every handle sees
+    # (one EXISTS round-trip per xadd — the write itself already pays one)
+    def _admission_key(self):
+        return self.stream + ":admission-closed"
+
+    def close_admission(self):
+        super().close_admission()
+        try:
+            self.r.set(self._admission_key(), "1")
+        except Exception:  # noqa: BLE001 — backend down: local flag holds
+            pass
+
+    def open_admission(self):
+        super().open_admission()
+        try:
+            self.r.delete(self._admission_key())
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _admission_closed_externally(self):
+        try:
+            return bool(self.r.exists(self._admission_key()))
+        except Exception:  # noqa: BLE001 — outage: the xadd will fail loudly
+            return False
+
+    def read_path_healthy(self):
+        # _last_read_failed covers the breaker's warm-up window: the very
+        # first failed read already means an empty batch is NOT "stream
+        # empty", before the failure streak reaches the trip threshold
+        from analytics_zoo_tpu.common.resilience import CircuitBreaker
+        return (not self._last_read_failed
+                and self._read_breaker.state == CircuitBreaker.CLOSED
+                and self.reachable())
+
+    def health(self):
+        h = super().health()
+        h["read_breaker"] = self._read_breaker.health()
+        return h
+
     def read_batch(self, max_items, timeout_s=0.1):
-        resp = self.r.xread({self.stream: self._last_id}, count=max_items,
-                            block=int(timeout_s * 1000))
+        try:
+            resp = self._guarded_read(
+                self.r.xread, {self.stream: self._last_id}, count=max_items,
+                block=int(timeout_s * 1000))
+        except _ReadUnavailable:
+            self._last_read_failed = True
+            return []                      # degrade: readiness reports it
+        self._last_read_failed = False
         out = []
+        consumed = []
         for _, entries in resp:
             for eid, fields in entries:
                 self._last_id = eid
-                rec = json.loads(fields[b"data"])
-                out.append((rec.get("uri", eid.decode()), rec))
+                consumed.append(eid)
+                try:
+                    rec = json.loads(fields[b"data"])
+                except (KeyError, ValueError, TypeError) as e:
+                    # one malformed entry must not drop the rest of the
+                    # batch (its ids are already past _last_id): quarantine
+                    # it alone and keep going
+                    key = self._decode(eid)
+                    try:
+                        self.put_error(
+                            key, f"read_batch: malformed entry: "
+                                 f"{type(e).__name__}: {e}",
+                            record={"raw": self._decode(
+                                fields.get(b"data", b""))})
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                    continue
+                out.append((rec.get("uri", self._decode(eid)), rec))
+        if consumed:
+            # delete-on-consume (single-consumer model, same semantics as
+            # the File/InProc backends): XLEN then measures BACKLOG, which
+            # is what the `max_depth` admission cap and `/readyz` depth
+            # threshold must see — otherwise served records would count
+            # against admission forever
+            try:
+                self.r.xdel(self.stream, *consumed)
+            except Exception:  # noqa: BLE001 — trim() still bounds memory
+                pass
         return out
 
     def put_result(self, key, value):
         self.r.hset(self.table, key, json.dumps(value))
 
     def get_result(self, key):
-        v = self.r.hget(self.table, key)
+        try:
+            v = self._guarded_read(self.r.hget, self.table, key)
+        except _ReadUnavailable:
+            return None                    # poller keeps waiting; readiness
         return json.loads(v) if v else None
 
     def result_count(self):
         return self.r.hlen(self.table)
+
+    def delete_result(self, key):
+        self.r.hdel(self.table, key)
 
     def put_error(self, key, error, record=None):
         self.r.hset(self.table, key, json.dumps({"error": str(error)}))
@@ -254,19 +647,42 @@ class RedisQueue(BaseQueue):
                                                            record))})
 
     def dead_letters(self):
-        return [json.loads(fields[b"data"])
-                for _, fields in self.r.xrange(self.dead_stream)]
+        return [e for _, e in self._dead_letter_items()]
+
+    def dead_letter_count(self):
+        # probes call this every few seconds: XLEN, not a full XRANGE+parse
+        try:
+            return int(self.r.xlen(self.dead_stream))
+        except Exception:  # noqa: BLE001 — outage
+            return -1
+
+    def _dead_letter_items(self):
+        out = []
+        for eid, fields in self.r.xrange(self.dead_stream):
+            try:
+                out.append((eid, json.loads(fields[b"data"])))
+            except (KeyError, ValueError, TypeError):
+                continue
+        return out
+
+    def _remove_dead_letter(self, token):
+        self.r.xdel(self.dead_stream, token)
 
     def trim(self, max_len):
         self.r.xtrim(self.stream, maxlen=max_len)
         self.r.xtrim(self.dead_stream, maxlen=max_len)
 
 
+class _ReadUnavailable(RuntimeError):
+    """Internal: the guarded Redis read path is down (retry exhausted or
+    breaker open) — callers degrade instead of crashing the worker."""
+
+
 def make_queue(kind: str = "inproc", **kwargs) -> BaseQueue:
     if kind == "inproc":
-        return InProcQueue()
+        return InProcQueue(max_depth=kwargs.get("max_depth"))
     if kind == "file":
-        return FileQueue(kwargs["root"])
+        return FileQueue(kwargs["root"], max_depth=kwargs.get("max_depth"))
     if kind == "redis":
         return RedisQueue(**kwargs)
     raise ValueError(f"unknown queue kind {kind!r}")

@@ -22,6 +22,7 @@ Usage (see tests/test_serving_faults.py):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Type
@@ -92,6 +93,35 @@ class FaultInjector:
             self._plans.setdefault(site, []).append(
                 _Plan(when=when, exc=exc, message=message))
         return self
+
+    @contextlib.contextmanager
+    def outage(self, *sites: str, exc: Type[BaseException] = InjectedFault,
+               message: str = ""):
+        """Hard outage window (PR 2 availability scenarios): EVERY call at
+        the given sites fails while the ``with`` block is active — "kill
+        Redis mid-stream" is ``with inj.outage("read_batch", "put_result",
+        "get_result"): ...``; on exit the backend "comes back" and half-open
+        breaker probes can heal."""
+        active = {"on": True}
+        added = []
+        with self._lock:
+            for site in sites:
+                plan = _Plan(when=lambda ctx, a=active: a["on"], exc=exc,
+                             message=message or f"outage at {site}")
+                self._plans.setdefault(site, []).append(plan)
+                added.append((site, plan))
+        try:
+            yield self
+        finally:
+            active["on"] = False
+            # remove (not just disarm) the plans: repeated outage windows
+            # must not accumulate dead predicates on the site lists
+            with self._lock:
+                for site, plan in added:
+                    try:
+                        self._plans.get(site, []).remove(plan)
+                    except ValueError:
+                        pass
 
     def reset(self, site: Optional[str] = None) -> None:
         with self._lock:

@@ -19,8 +19,44 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): fail the test with TimeoutError if it runs "
+        "longer — SIGALRM-based (no pytest-timeout in this image), so a "
+        "hung drain or stuck subprocess can't stall the tier-1 run past "
+        "its budget")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Per-test wall-clock cap for the subprocess-based chaos/preemption/
+    serving tests.  SIGALRM interrupts blocking syscalls (subprocess waits,
+    socket reads) on the main thread, which is exactly where pytest runs the
+    test body; platforms without SIGALRM just skip the guard."""
+    marker = item.get_closest_marker("timeout")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        return (yield)
+    seconds = float(marker.args[0]) if marker.args \
+        else float(marker.kwargs.get("seconds", 60))
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded its {seconds:g}s timeout mark")
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        return (yield)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
 
 
 @pytest.fixture(scope="session")

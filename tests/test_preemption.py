@@ -21,6 +21,10 @@ import pytest
 WORKER = os.path.join(os.path.dirname(__file__), "preemption_worker.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# subprocess-based kill/resume cycles: cap each test so a hung child can't
+# stall the tier-1 run past its budget (conftest SIGALRM guard)
+pytestmark = pytest.mark.timeout(300)
+
 
 def _spawn(ckpt_dir, *flags):
     env = dict(os.environ)

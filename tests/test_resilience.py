@@ -15,6 +15,10 @@ from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                  SupervisedThread)
 from analytics_zoo_tpu.utils.chaos import FaultInjector, InjectedFault
 
+# chaos-driven unit tests: generous per-test cap (conftest SIGALRM guard) so
+# a wedged supervised thread can't stall the tier-1 run
+pytestmark = pytest.mark.timeout(60)
+
 
 # -- RetryPolicy ---------------------------------------------------------------
 
@@ -77,6 +81,27 @@ def test_deadline_remaining():
     t[0] = 1.5
     assert d.expired()
     assert Deadline(None).remaining() == float("inf")
+
+
+def test_wait_until_polls_to_timeout():
+    from analytics_zoo_tpu.common.resilience import wait_until
+
+    t = [0.0]
+    slept = []
+
+    def fake_sleep(s):
+        slept.append(s)
+        t[0] += s
+
+    # flips true after 0.05s of fake time
+    assert wait_until(lambda: t[0] >= 0.05, timeout_s=1.0, poll_s=0.02,
+                      sleep=fake_sleep, clock=lambda: t[0]) is True
+    assert t[0] < 0.1 and slept
+    # never flips: returns False once the budget elapses, no real waiting
+    t[0] = 0.0
+    assert wait_until(lambda: False, timeout_s=0.1, poll_s=0.02,
+                      sleep=fake_sleep, clock=lambda: t[0]) is False
+    assert t[0] >= 0.1
 
 
 # -- CircuitBreaker ------------------------------------------------------------

@@ -1,0 +1,775 @@
+"""Serving availability layer (PR 2 tentpole): HTTP health probes, graceful
+drain, end-to-end deadlines, admission control, dead-letter replay, and the
+self-healing Redis read path — chaos-tested with utils/chaos.FaultInjector
+(backend killed mid-stream, enqueue flood past the depth cap, drain under
+load).  Redis scenarios run against an in-process FakeRedis so no server or
+`redis` package is needed."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.client import Client, InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import (FileQueue, InProcQueue,
+                                              QueueClosed, QueueFull,
+                                              RedisQueue)
+from analytics_zoo_tpu.utils.chaos import FaultInjector
+
+DIM, NCLS = 3, 4
+
+# availability tests drive worker threads, probe sockets, and injected
+# outages: cap each one so a hung drain can't stall tier-1 (conftest SIGALRM)
+pytestmark = pytest.mark.timeout(120)
+
+
+def _serving(queue, **params):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    model = Sequential()
+    model.add(Dense(NCLS, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=4, poll_timeout_s=0.02, write_backoff_s=0.01,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue, params=ServingParams(**defaults))
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _drain_results(out_q, rids, timeout_s=30.0):
+    got = {}
+    deadline = time.time() + timeout_s
+    while len(got) < len(rids) and time.time() < deadline:
+        for rid in rids:
+            if rid not in got:
+                r = out_q.query(rid)
+                if r is not None:
+                    got[rid] = r
+        time.sleep(0.01)
+    return got
+
+
+class FakeRedis:
+    """The slice of redis.Redis the RedisQueue uses, in-process: streams as
+    (id, {b"data": bytes}) lists, hashes as dicts.  Lets the chaos tests
+    exercise the REAL RedisQueue code path without a server."""
+
+    def __init__(self):
+        self.streams = {}
+        self.hashes = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _seq_of(eid):
+        if isinstance(eid, (bytes, bytearray)):
+            eid = eid.decode()
+        return int(str(eid).split("-")[0])
+
+    def xadd(self, stream, fields):
+        data = fields["data"]
+        if isinstance(data, str):
+            data = data.encode()
+        with self._lock:
+            self._seq += 1
+            eid = f"{self._seq}-0".encode()
+            self.streams.setdefault(stream, []).append((eid, {b"data": data}))
+        return eid
+
+    def xread(self, streams, count=None, block=0):
+        out = []
+        with self._lock:
+            for name, last in streams.items():
+                last_seq = self._seq_of(last)
+                entries = [(eid, dict(f))
+                           for eid, f in self.streams.get(name, [])
+                           if self._seq_of(eid) > last_seq]
+                if count:
+                    entries = entries[:count]
+                if entries:
+                    out.append((name.encode() if isinstance(name, str)
+                                else name, entries))
+        return out
+
+    def xlen(self, stream):
+        with self._lock:
+            return len(self.streams.get(stream, []))
+
+    def xrange(self, stream):
+        with self._lock:
+            return [(eid, dict(f))
+                    for eid, f in self.streams.get(stream, [])]
+
+    def xdel(self, stream, *eids):
+        with self._lock:
+            drop = set(eids)
+            self.streams[stream] = [
+                (eid, f) for eid, f in self.streams.get(stream, [])
+                if eid not in drop]
+
+    def xtrim(self, stream, maxlen=None):
+        with self._lock:
+            s = self.streams.get(stream, [])
+            if maxlen is not None and len(s) > maxlen:
+                self.streams[stream] = s[-maxlen:]
+
+    def hset(self, table, key, value):
+        with self._lock:
+            self.hashes.setdefault(table, {})[key] = value
+
+    def hget(self, table, key):
+        with self._lock:
+            v = self.hashes.get(table, {}).get(key)
+        return v.encode() if isinstance(v, str) else v
+
+    def hdel(self, table, *keys):
+        with self._lock:
+            for k in keys:
+                self.hashes.get(table, {}).pop(k, None)
+
+    def hlen(self, table):
+        with self._lock:
+            return len(self.hashes.get(table, {}))
+
+    def set(self, key, value):
+        with self._lock:
+            self.hashes.setdefault("__kv__", {})[key] = value
+
+    def delete(self, *keys):
+        with self._lock:
+            for k in keys:
+                self.hashes.get("__kv__", {}).pop(k, None)
+
+    def exists(self, key):
+        with self._lock:
+            return int(key in self.hashes.get("__kv__", {}))
+
+    def ping(self):
+        return True
+
+
+# -- admission control ---------------------------------------------------------
+
+def test_inproc_admission_cap_and_close():
+    q = InProcQueue(max_depth=3)
+    for i in range(3):
+        q.xadd({"uri": f"r{i}", "data": [1.0]})
+    with pytest.raises(QueueFull):
+        q.xadd({"uri": "overflow", "data": [1.0]})
+    assert q.depth() == 3
+    # consuming makes room again
+    q.read_batch(1, timeout_s=0.01)
+    q.xadd({"uri": "r3", "data": [1.0]})
+    # drain: admission closes with the more specific QueueClosed
+    q.close_admission()
+    with pytest.raises(QueueClosed):
+        q.xadd({"uri": "late", "data": [1.0]})
+    q.open_admission()
+    q.read_batch(10, timeout_s=0.01)
+    q.xadd({"uri": "r4", "data": [1.0]})
+
+
+def test_file_queue_admission_and_health(tmp_path):
+    q = FileQueue(str(tmp_path / "q"), max_depth=2)
+    q.xadd({"uri": "a", "data": [1.0]})
+    q.xadd({"uri": "b", "data": [1.0]})
+    with pytest.raises(QueueFull):
+        q.xadd({"uri": "c", "data": [1.0]})
+    h = q.health()
+    assert h["depth"] == 2 and h["max_depth"] == 2
+    assert h["reachable"] is True and h["admission_open"] is True
+
+
+def test_file_result_count_ignores_inflight_tmp(tmp_path):
+    """Satellite: `.{key}.tmp` files written by put_result before the rename
+    must not inflate result_count."""
+    q = FileQueue(str(tmp_path / "q"))
+    q.put_result("done", {"value": [1]})
+    (tmp_path / "q" / "results" / ".inflight.tmp").write_text("{}")
+    assert q.result_count() == 1
+
+
+def test_admission_closure_is_cross_process(tmp_path):
+    """The drain runs in the daemon, but producers hold their OWN queue
+    handles: File/Redis closures must reject every handle, not just the
+    engine's."""
+    root = str(tmp_path / "q")
+    server_side = FileQueue(root)
+    client_side = FileQueue(root)          # separate handle, same spool
+    server_side.close_admission()
+    with pytest.raises(QueueClosed):
+        client_side.xadd({"uri": "late", "data": [1.0]})
+    assert client_side.health()["admission_open"] is False
+    server_side.open_admission()
+    client_side.xadd({"uri": "ok", "data": [1.0]})
+
+    fake = FakeRedis()
+    server_r, client_r = RedisQueue(client=fake), RedisQueue(client=fake)
+    server_r.close_admission()
+    with pytest.raises(QueueClosed):
+        client_r.xadd({"uri": "late", "data": [1.0]})
+    server_r.open_admission()
+    client_r.xadd({"uri": "ok", "data": [1.0]})
+
+
+def test_inproc_admission_atomic_under_concurrency():
+    """Concurrent producers cannot overshoot max_depth: the check happens
+    inside the append's critical section."""
+    q = InProcQueue(max_depth=5)
+    rejected = []
+
+    def hammer(tid):
+        for i in range(50):
+            try:
+                q.xadd({"uri": f"t{tid}-{i}", "data": [1.0]})
+            except QueueFull:
+                rejected.append(1)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert q.depth() <= 5
+    assert len(rejected) == 200 - q.depth()
+
+
+# -- end-to-end deadlines ------------------------------------------------------
+
+def test_expired_record_is_shed_not_predicted(ctx):
+    q = InProcQueue()
+    serving = _serving(q)
+    cin = InputQueue(q)
+    cin.enqueue_tensor("late", np.ones(DIM, np.float32), timeout_s=-0.001)
+    cin.enqueue_tensor("ok", np.ones(DIM, np.float32), timeout_s=30.0)
+    while serving.serve_once():
+        pass
+    late = q.get_result("late")
+    assert OutputQueue.is_deadline_exceeded(late), late
+    assert not OutputQueue.is_error(q.get_result("ok"))
+    # shed, not quarantined: no predict slot wasted, no dead-letter entry
+    assert serving.shed == 1 and serving.dead_lettered == 0
+    assert q.dead_letters() == []
+    assert serving.metrics()["shed"] == 1
+
+
+def test_staged_expiry_checked_before_predict(ctx):
+    """A record that expires AFTER preprocess but before predict is shed at
+    the predict gate."""
+    q = InProcQueue()
+    serving = _serving(q)
+    cin = InputQueue(q)
+    cin.enqueue_tensor("r0", np.ones(DIM, np.float32), timeout_s=0.05)
+    groups = serving._read_and_preprocess()
+    assert groups and len(groups) == 1
+    time.sleep(0.08)                      # budget elapses while staged
+    assert serving._predict_and_write(*groups[0]) == 0
+    assert OutputQueue.is_deadline_exceeded(q.get_result("r0"))
+    assert serving.shed == 1
+
+
+def test_client_query_shares_enqueue_budget(ctx):
+    """Client.query polls against the deadline stamped at enqueue and never
+    hangs past it, even with no engine running."""
+    q = InProcQueue()
+    client = Client(q)
+    t0 = time.time()
+    client.enqueue_tensor("r0", np.ones(DIM, np.float32), timeout_s=0.2)
+    res = client.query("r0")
+    assert time.time() - t0 < 2.0
+    assert OutputQueue.is_deadline_exceeded(res)
+
+
+def test_client_predict_roundtrip(ctx):
+    q = InProcQueue()
+    serving = _serving(q)
+    serving.start()
+    try:
+        client = Client(q, default_timeout_s=20.0)
+        res = client.predict("r0", np.ones(DIM, np.float32))
+        assert res is not None and not OutputQueue.is_error(res)
+        assert len(res["value"]) == NCLS
+    finally:
+        serving.shutdown()
+
+
+# -- HTTP probes ---------------------------------------------------------------
+
+def test_probe_endpoints_serve_health_document(ctx):
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    serving.start()
+    try:
+        url = serving._http.url
+        code, live = _get(url + "/healthz")
+        assert code == 200
+        # the probe serves the SAME document as ClusterServing.health()
+        assert set(live) == set(serving.health())
+        assert live["running"] is True and live["draining"] is False
+
+        code, ready = _get(url + "/readyz")
+        assert code == 200 and ready == {"ready": True, "reasons": []}
+
+        code, metrics = _get(url + "/metrics")
+        assert code == 200
+        assert set(metrics) == {"served", "quarantined", "shed", "restarts",
+                                "queue_depth", "dead_letters",
+                                "breaker_trips"}
+
+        code, _ = _get(url + "/nope")
+        assert code == 404
+    finally:
+        serving.shutdown()
+    # server is down after shutdown
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url + "/healthz", timeout=1)
+
+
+def test_readyz_flags_queue_depth_overload(ctx):
+    q = InProcQueue(max_depth=4)
+    serving = _serving(q, http_port=0, ready_queue_depth=2)
+    for i in range(3):
+        q.xadd({"uri": f"r{i}", "data": list(np.ones(DIM))})
+    r = serving.ready()
+    assert r["ready"] is False
+    assert any("queue-depth" in reason for reason in r["reasons"])
+
+
+# -- graceful drain ------------------------------------------------------------
+
+def test_drain_under_load_flushes_inflight_results(ctx):
+    """shutdown(drain_s) under load: admission closes, /readyz reports
+    draining, every already-enqueued record still resolves to a result, and
+    the workers exit cleanly before the budget."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4)
+    orig_predict = serving.model.do_predict
+
+    def slow_predict(*a, **k):
+        time.sleep(0.05)                  # make the drain observable
+        return orig_predict(*a, **k)
+
+    serving.model.do_predict = slow_predict
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(24)]
+    serving.start()
+    time.sleep(0.1)                       # pipeline fills
+
+    t0 = time.time()
+    done = threading.Event()
+    seen_draining = []
+
+    def _shutdown():
+        serving.shutdown(drain_s=30.0)
+        done.set()
+
+    t = threading.Thread(target=_shutdown)
+    t.start()
+    while not done.is_set():
+        r = serving.ready()
+        if "draining" in r.get("reasons", []):
+            seen_draining.append(r)
+        time.sleep(0.005)
+    t.join()
+    assert time.time() - t0 < 30.0
+    assert seen_draining, "readiness never reported draining during drain"
+    # every in-flight record was flushed before exit
+    got = {rid: q.get_result(rid) for rid in rids}
+    missing = [rid for rid, r in got.items() if r is None]
+    assert not missing, f"drain dropped {missing}"
+    assert all(not OutputQueue.is_error(r) for r in got.values())
+    assert serving.total_records == 24
+    # admission stayed closed after the drain
+    with pytest.raises(QueueClosed):
+        cin.enqueue_tensor("late", np.ones(DIM, np.float32))
+    assert not serving._pre_sup.is_alive()
+    assert not serving._predict_sup.is_alive()
+    del cout
+
+
+def test_drain_survives_fully_shed_batch(ctx):
+    """A batch that is read but ENTIRELY shed/quarantined mid-drain must not
+    be mistaken for an empty stream: the rest of the backlog still flushes."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4)
+    cin = InputQueue(q)
+    # first batch: all expired -> fully shed; second batch: live records
+    for i in range(4):
+        cin.enqueue_tensor(f"dead{i}", np.ones(DIM, np.float32),
+                           timeout_s=-0.001)
+    live = [cin.enqueue_tensor(f"live{i}", np.ones(DIM, np.float32))
+            for i in range(4)]
+    serving.start()
+    serving.shutdown(drain_s=20.0)
+    for rid in live:
+        res = q.get_result(rid)
+        assert res is not None and not OutputQueue.is_error(res), rid
+    for i in range(4):
+        assert OutputQueue.is_deadline_exceeded(q.get_result(f"dead{i}"))
+
+
+def test_restart_after_drain_reopens_admission(ctx):
+    q = InProcQueue()
+    serving = _serving(q)
+    serving.start()
+    serving.shutdown(drain_s=5.0)
+    assert q.admission_open is False
+    serving.start()
+    try:
+        # serving again means taking traffic again
+        rid = InputQueue(q).enqueue_tensor("r0", np.ones(DIM, np.float32))
+        res = OutputQueue(q).query(rid, timeout_s=15)
+        assert res is not None and not OutputQueue.is_error(res)
+    finally:
+        serving.shutdown()
+
+
+def test_client_short_poll_mid_budget_is_not_terminal(ctx):
+    """An explicit short query() poll that comes back empty while the
+    stamped budget still has time left returns None, NOT deadline-exceeded —
+    and the budget map is cleaned up once the uri resolves."""
+    q = InProcQueue()
+    client = Client(q)
+    client.enqueue_tensor("r0", np.ones(DIM, np.float32), timeout_s=30.0)
+    assert client.query("r0", timeout_s=0.01) is None
+    assert "r0" in client._deadline_ns        # budget still live
+    q.put_result("r0", {"value": [1.0]})
+    assert client.query("r0") == {"value": [1.0]}
+    assert "r0" not in client._deadline_ns    # resolved: entry released
+
+
+def test_plain_shutdown_unchanged(ctx):
+    """No drain budget: shutdown() is the PR 1 immediate stop."""
+    q = InProcQueue()
+    serving = _serving(q)
+    serving.start()
+    t0 = time.time()
+    serving.shutdown()
+    assert time.time() - t0 < 10
+    assert q.admission_open is True       # no drain -> admission untouched
+
+
+# -- self-healing Redis read path ---------------------------------------------
+
+def test_redis_malformed_entry_dead_letters_alone():
+    """Satellite: one malformed stream entry must not drop the rest of the
+    already-consumed batch."""
+    fake = FakeRedis()
+    q = RedisQueue(client=fake)
+    q.xadd({"uri": "good1", "data": [1.0]})
+    fake.xadd(q.stream, {"data": b"{not valid json"})
+    q.xadd({"uri": "good2", "data": [2.0]})
+
+    batch = q.read_batch(10, timeout_s=0.01)
+    assert [rid for rid, _ in batch] == ["good1", "good2"]
+    dead = q.dead_letters()
+    assert len(dead) == 1 and "malformed" in dead[0]["error"]
+    # the bad entry's id resolves to an error result for any poller
+    assert OutputQueue.is_error(q.get_result(dead[0]["uri"]))
+    # stream fully consumed: nothing re-delivered
+    assert q.read_batch(10, timeout_s=0.01) == []
+
+
+def test_redis_read_outage_degrades_and_heals():
+    fake = FakeRedis()
+    q = RedisQueue(client=fake, read_retries=0, read_breaker_threshold=2,
+                   read_breaker_cooldown_s=0.05)
+    q.xadd({"uri": "r0", "data": [1.0]})
+    inj = FaultInjector()
+    fake.xread = inj.wrap("xread", fake.xread)
+    fake.hget = inj.wrap("hget", fake.hget)
+
+    with inj.outage("xread", "hget", exc=ConnectionError):
+        # reads degrade to empty/None instead of raising
+        for _ in range(3):
+            assert q.read_batch(4, timeout_s=0.01) == []
+        assert q.get_result("r0") is None
+        assert q.health()["read_breaker"]["state"] == "open"
+    # backend heals: after the cooldown the half-open probe reconnects
+    time.sleep(0.06)
+    batch = q.read_batch(4, timeout_s=0.01)
+    assert [rid for rid, _ in batch] == ["r0"]
+    assert q.health()["read_breaker"]["state"] == "closed"
+
+
+def test_drain_does_not_mistake_outage_for_empty_stream(ctx):
+    """During a read outage, an empty read_batch must NOT end the drain:
+    the backlog is still on the backend, so the drain holds its budget and
+    leaves the stream intact for the next incarnation."""
+    fake = FakeRedis()
+    q = RedisQueue(client=fake, read_retries=0, read_breaker_threshold=2,
+                   read_breaker_cooldown_s=0.05)
+    serving = _serving(q)
+    inj = FaultInjector()
+    fake.xread = inj.wrap("xread", fake.xread)
+    serving.start()
+    time.sleep(0.05)
+    with inj.outage("xread", exc=ConnectionError):
+        for i in range(4):
+            q.xadd({"uri": f"r{i}", "data": [1.0] * DIM})
+        t0 = time.time()
+        serving.shutdown(drain_s=0.5)
+        # the drain held the budget instead of declaring the stream empty
+        assert time.time() - t0 >= 0.45
+    # backlog intact: nothing was silently abandoned as "drained"
+    assert fake.xlen(q.stream) == 4
+
+
+def test_file_corrupt_stream_entry_quarantined(tmp_path):
+    """A corrupt spool file is dead-lettered and removed, not re-parsed on
+    every poll while wedging the admission cap."""
+    import os
+
+    q = FileQueue(str(tmp_path / "q"), max_depth=4)
+    q.xadd({"uri": "good", "data": [1.0]})
+    (tmp_path / "q" / "stream" / "0000000000-corrupt.json").write_text("{oops")
+    batch = q.read_batch(10, timeout_s=0.01)
+    assert [rid for rid, _ in batch] == ["good"]
+    assert q.depth() == 0                 # corrupt file no longer counted
+    dead = q.dead_letters()
+    assert len(dead) == 1 and "malformed" in dead[0]["error"]
+    assert not os.path.exists(
+        str(tmp_path / "q" / "stream" / "0000000000-corrupt.json"))
+
+
+def test_outage_context_removes_its_plans():
+    inj = FaultInjector()
+    with inj.outage("site_a", "site_b"):
+        with pytest.raises(Exception):
+            inj.maybe_fail("site_a")
+    assert inj._plans.get("site_a", []) == []
+    assert inj._plans.get("site_b", []) == []
+    inj.maybe_fail("site_a")              # no stale predicate fires
+
+
+# -- dead-letter replay --------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["inproc", "file", "redis"])
+def test_replay_dead_letters_all_backends(kind, tmp_path):
+    if kind == "inproc":
+        q = InProcQueue()
+    elif kind == "file":
+        q = FileQueue(str(tmp_path / "q"))
+    else:
+        q = RedisQueue(client=FakeRedis())
+    record = {"uri": "fixable", "data": [1.0, 2.0, 3.0]}
+    q.put_error("fixable", "preprocess: boom", record=record)
+    q.put_error("lost", "predict: no record kept")   # not replayable
+
+    assert OutputQueue.is_error(q.get_result("fixable"))
+    out = q.replay_dead_letters()
+    assert out["replayed"] == ["fixable"] and out["skipped"] == ["lost"]
+    # stale error marker cleared; record back on the stream
+    assert q.get_result("fixable") is None
+    batch = q.read_batch(10, timeout_s=0.01)
+    assert [rid for rid, _ in batch] == ["fixable"]
+    assert batch[0][1] == record
+    # replayed entry cleared from the store, unreplayable one kept
+    assert [d["uri"] for d in q.dead_letters()] == ["lost"]
+
+
+def test_replay_on_full_queue_keeps_error_marker(tmp_path):
+    """Replay against a full stream must stop BEFORE destroying the stale
+    error marker — a polling client still sees the quarantine error."""
+    q = InProcQueue(max_depth=1)
+    q.xadd({"uri": "occupier", "data": [0.0]})     # stream at capacity
+    q.put_error("stuck", "preprocess: boom",
+                record={"uri": "stuck", "data": [1.0]})
+    out = q.replay_dead_letters()
+    assert out["replayed"] == []
+    assert OutputQueue.is_error(q.get_result("stuck"))   # marker intact
+    assert [d["uri"] for d in q.dead_letters()] == ["stuck"]
+
+
+def test_replay_strips_stale_deadline():
+    """A replayed record must not carry its long-expired deadline_ns — the
+    engine would shed it as deadline-exceeded the moment it is read."""
+    q = InProcQueue()
+    q.put_error("r1", "preprocess: transient",
+                record={"uri": "r1", "data": [1.0],
+                        "deadline_ns": 1})          # expired ages ago
+    out = q.replay_dead_letters()
+    assert out["replayed"] == ["r1"]
+    [(rid, rec)] = q.read_batch(5, timeout_s=0.01)
+    assert rid == "r1" and "deadline_ns" not in rec
+    assert rec["data"] == [1.0]
+
+
+def test_replay_skips_malformed_entry_quarantines():
+    """A malformed-entry quarantine (record={'raw': ...}) is NOT replayable:
+    re-enqueueing it would erase its error marker and churn junk straight
+    back into quarantine."""
+    q = RedisQueue(client=FakeRedis())
+    q.put_error("3-0", "read_batch: malformed entry: bad json",
+                record={"raw": "{not json"})
+    out = q.replay_dead_letters()
+    assert out["replayed"] == [] and out["skipped"] == ["3-0"]
+    assert OutputQueue.is_error(q.get_result("3-0"))     # marker intact
+    assert len(q.dead_letters()) == 1
+
+
+def test_replay_filter_narrows(tmp_path):
+    q = InProcQueue()
+    q.put_error("a", "stage: x", record={"uri": "a", "data": [1.0]})
+    q.put_error("b", "stage: y", record={"uri": "b", "data": [2.0]})
+    out = q.replay_dead_letters(filter=lambda e: e["uri"] == "b")
+    assert out["replayed"] == ["b"]
+    assert [d["uri"] for d in q.dead_letters()] == ["a"]
+
+
+def test_manager_replay_cli(tmp_path, capsys):
+    from analytics_zoo_tpu.serving import manager
+
+    qdir = tmp_path / "q"
+    q = FileQueue(str(qdir))
+    q.put_error("r1", "preprocess: bad pixel",
+                record={"uri": "r1", "data": [1.0]})
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(f"data:\n  src: file:{qdir}\n")
+    rc = manager.main(["replay", "-c", str(cfg)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["replayed"] == 1 and out["uris"] == ["r1"]
+    assert q.dead_letters() == []
+    assert [rid for rid, _ in q.read_batch(5, timeout_s=0.01)] == ["r1"]
+
+
+# -- manager health CLI (satellite) --------------------------------------------
+
+def test_manager_health_cli_schema_matches_engine(tmp_path, capsys, ctx):
+    """The `<pidfile>.health.json` snapshot and the probe endpoints serve the
+    same ClusterServing.health() document, and the health CLI exits by its
+    `running` verdict."""
+    import os
+
+    from analytics_zoo_tpu.serving import manager
+
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    serving.start()
+    try:
+        expected = serving.health()
+        pidfile = str(tmp_path / "cs.pid")
+        with open(pidfile, "w") as f:
+            f.write(str(os.getpid()))     # a live pid: this test process
+        manager._write_health(serving, manager._health_path(pidfile))
+
+        rc = manager.main(["health", "--pidfile", pidfile])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        # snapshot schema == engine schema (+ the writer's timestamp)
+        assert set(doc) == set(expected) | {"ts"}
+        for key in ("running", "workers", "breaker", "queue", "ready",
+                    "draining", "shed"):
+            assert key in doc
+        assert set(doc["workers"]) == {"serving-preprocess",
+                                       "serving-predict"}
+        for w in doc["workers"].values():
+            assert {"state", "alive", "restart_count",
+                    "crash_streak"} <= set(w)
+        assert set(doc["ready"]) == {"ready", "reasons"}
+
+        # the HTTP probe serves the same document (modulo live counters)
+        _, live = _get(serving._http.url + "/healthz")
+        assert set(live) == set(expected)
+    finally:
+        serving.shutdown()
+
+    # stale snapshot (dead pid) must not report healthy
+    pid2 = str(tmp_path / "cs2.pid")
+    with open(pid2, "w") as f:
+        f.write("999999999")
+    manager._write_health(serving, manager._health_path(pid2))
+    rc = manager.main(["health", "--pidfile", pid2])
+    assert rc == 1
+    err = json.loads(capsys.readouterr().err.strip())
+    assert err["stale"] is True and err["running"] is False
+
+
+# -- chaos acceptance scenario (ISSUE criteria) --------------------------------
+
+def test_chaos_outage_flood_and_drain_acceptance(ctx):
+    """FaultInjector kills the Redis backend's read path mid-stream while an
+    enqueue flood runs past the depth cap: /readyz flips to not-ready and
+    back, no request hangs (every record resolves to a result or a typed
+    QueueFull rejection at admission), supervision never burns a restart,
+    and shutdown(drain_s) flushes all in-flight results before exit."""
+    fake = FakeRedis()
+    q = RedisQueue(client=fake, max_depth=16, read_retries=0,
+                   read_breaker_threshold=3, read_breaker_cooldown_s=0.1)
+    serving = _serving(q, http_port=0, batch_size=4)
+    inj = FaultInjector()
+    fake.xread = inj.wrap("xread", fake.xread)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    serving.start()
+    url = serving._http.url
+    try:
+        # phase 1: healthy traffic
+        rids = [cin.enqueue_tensor(f"a{i}", np.ones(DIM, np.float32),
+                                   timeout_s=60.0) for i in range(8)]
+        got = _drain_results(cout, rids)
+        assert len(got) == 8 and all(not OutputQueue.is_error(r)
+                                     for r in got.values())
+        code, _ = _get(url + "/readyz")
+        assert code == 200
+
+        # phase 2: backend read outage mid-stream + enqueue flood
+        accepted, rejected = [], 0
+        with inj.outage("xread", exc=ConnectionError):
+            deadline = time.time() + 10
+            flipped = False
+            while time.time() < deadline and not flipped:
+                code, doc = _get(url + "/readyz")
+                flipped = code == 503 and any(
+                    "read-breaker-open" in r for r in doc["reasons"])
+                time.sleep(0.02)
+            assert flipped, "readyz never flipped during the outage"
+            # flood: consumption is down, so the depth cap must reject
+            for i in range(64):
+                try:
+                    accepted.append(cin.enqueue_tensor(
+                        f"b{i}", np.ones(DIM, np.float32), timeout_s=60.0))
+                except QueueFull:
+                    rejected += 1
+            assert rejected > 0, "flood never hit the admission cap"
+            assert len(accepted) <= 16
+
+        # phase 3: backend heals -> readiness recovers, backlog served
+        deadline = time.time() + 10
+        recovered = False
+        while time.time() < deadline and not recovered:
+            code, _ = _get(url + "/readyz")
+            recovered = code == 200
+            time.sleep(0.02)
+        assert recovered, "readyz never recovered after the outage"
+        # the outage degraded reads; it must NOT have burned restarts
+        h = serving.health()
+        assert h["workers"]["serving-preprocess"]["restart_count"] == 0
+
+        # phase 4: graceful drain under the backlog
+        serving.shutdown(drain_s=30.0)
+        for rid in accepted:
+            res = q.get_result(rid)
+            assert res is not None, f"{rid} hung through the drain"
+        served = sum(1 for rid in accepted
+                     if not OutputQueue.is_error(q.get_result(rid)))
+        assert served == len(accepted)
+        assert serving.total_records == 8 + len(accepted)
+    finally:
+        serving.shutdown()

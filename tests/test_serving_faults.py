@@ -17,6 +17,10 @@ from analytics_zoo_tpu.utils.chaos import FaultInjector
 
 DIM, NCLS = 3, 4
 
+# chaos tests drive worker threads + injected faults: cap each one so a
+# stuck drain or wedged worker can't stall the tier-1 run (conftest SIGALRM)
+pytestmark = pytest.mark.timeout(120)
+
 
 def _serving(queue, **params):
     from analytics_zoo_tpu.inference.inference_model import InferenceModel

@@ -69,6 +69,7 @@ def _write_zoo_model(tmp_path):
     return weights, topo
 
 
+@pytest.mark.timeout(120)
 def test_serve_from_config_end_to_end(tmp_path, ctx):
     """manager-driven engine over a FileQueue: enqueue -> result."""
     weights, topo = _write_zoo_model(tmp_path)
@@ -93,6 +94,7 @@ def test_serve_from_config_end_to_end(tmp_path, ctx):
         serving.shutdown()
 
 
+@pytest.mark.timeout(240)
 def test_cli_start_stop_cycle(tmp_path):
     """The scripts' CLI: start (forked daemon) -> status -> stop."""
     weights, topo = _write_zoo_model(tmp_path)
