@@ -31,6 +31,33 @@ def _bucket(n: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+def _pad_to_bucket(xs: List[np.ndarray], scales, n: int, bucket: int):
+    """Zero-pad the batch arrays (and per-row scales, padded with ones)
+    from ``n`` rows up to the pow-2 ``bucket``.  The ONE padding
+    implementation shared by `do_predict` and `dispatch`, so both paths
+    produce identical padded signatures and hit one compile cache."""
+    if n < bucket:
+        xs = [np.concatenate(
+            [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)])
+            for a in xs]
+    if scales is None:
+        return xs, None
+    sc = np.concatenate([np.asarray(scales, np.float32),
+                         np.ones((bucket - n,), np.float32)])
+    return xs, sc
+
+
+class _LazyPending:
+    """Deferred-call result handle (`dispatch` oversized-batch fallback):
+    the work happens at ``result()``, matching `_Pending`'s interface."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def result(self):
+        return self._fn()
+
+
 class InferenceModel:
     """supported_concurrent_num is the concurrency CONTRACT
     (InferenceModel.scala:33,67: a queue of N weight-sharing clones): here it
@@ -147,6 +174,52 @@ class InferenceModel:
             lambda p, s, x: model.apply(p, s, x, training=False)[0])
         return self
 
+    # -- async dispatch (serving hot path, PR 3) ------------------------------
+    class _Pending:
+        """Handle for one async-dispatched batch: the jitted program is
+        already enqueued on the device; ``result()`` blocks on the host
+        transfer and strips the bucket padding."""
+
+        def __init__(self, device_out, take: int):
+            self._out = device_out
+            self._take = take
+
+        def result(self):
+            take = self._take
+            return jax.tree.map(lambda a: np.asarray(a)[:take], self._out)
+
+    def dispatch(self, x, scales: Optional[np.ndarray] = None) -> "_Pending":
+        """Dispatch ONE batch to the device without blocking on the host
+        readback.  JAX dispatch is asynchronous, so the caller's next stage
+        (preprocessing batch k+1, writing batch k-1's results) overlaps this
+        batch's device compute; call ``.result()`` on the returned handle to
+        transfer the outputs.  Pads to the same power-of-two bucket as
+        `do_predict`, so the two paths share one compile cache.
+
+        Unlike `do_predict` this takes no concurrency semaphore and does no
+        internal chunking — callers (the serving engine's
+        ``inflight_batches`` bound) cap how many handles they keep open; a
+        batch larger than ``max_batch`` falls back to the chunking
+        synchronous path, evaluated lazily at ``result()``."""
+        if self._jitted is None:
+            raise RuntimeError("load a model first")
+        multi = isinstance(x, (list, tuple))
+        if scales is not None and multi:
+            raise ValueError("scales= supports single-input models only")
+        xs = [np.asarray(a) for a in (x if multi else [x])]
+        n = xs[0].shape[0]
+        if n > self.max_batch:
+            return _LazyPending(lambda: self.do_predict(x, scales=scales))
+        bucket = _bucket(n, self.max_batch)
+        xs, sc = _pad_to_bucket(xs, scales, n, bucket)
+        if sc is not None:
+            out = self._jitted_with_scales()(self._params, self._state,
+                                             xs[0], sc)
+        else:
+            arg = xs if multi else xs[0]
+            out = self._jitted(self._params, self._state, arg)
+        return self._Pending(out, n)
+
     # -- predict --------------------------------------------------------------
     def _jitted_with_scales(self):
         """Lazily-built dequantizing predict: the int8/uint8 batch is
@@ -209,15 +282,10 @@ class InferenceModel:
                 take = min(step, n - i)
                 bucket = _bucket(take, self.max_batch)
                 chunk = [a[i:i + take] for a in xs]
-                if take < bucket:
-                    chunk = [np.concatenate(
-                        [c, np.zeros((bucket - take,) + c.shape[1:],
-                                     c.dtype)])
-                        for c in chunk]
-                if sc is not None:
-                    schunk = np.concatenate(
-                        [sc[i:i + take],
-                         np.ones((bucket - take,), np.float32)])
+                chunk, schunk = _pad_to_bucket(
+                    chunk, None if sc is None else sc[i:i + take],
+                    take, bucket)
+                if schunk is not None:
                     pending.append((self._jitted_with_scales()(
                         self._params, self._state, chunk[0], schunk), take))
                 else:

@@ -111,21 +111,57 @@ class OutputQueue:
         self.queue = queue
 
     def query(self, uri: str, timeout_s: Optional[float] = 0.0,
-              poll_s: float = 0.01) -> Optional[Dict]:
+              poll_s: float = 0.01,
+              poll_max_s: float = 0.1) -> Optional[Dict]:
         """Poll for the record's result until `timeout_s` (None = until a
         result arrives).  A quarantined
         record resolves to an ``{"error": ...}`` dict (engine dead-letter
         path) — callers should check `is_error` rather than blocking on a
-        value that will never arrive."""
+        value that will never arrive.
+
+        The poll interval backs off 1.5x per empty read up to
+        ``poll_max_s`` (PR 3): a long wait costs O(log) round-trips against
+        the backend instead of one per ``poll_s``."""
         deadline = Deadline(timeout_s)
+        poll = poll_s
         while True:
             res = self.queue.get_result(uri)
             if res is not None or deadline.expired():
                 return res
-            time.sleep(min(poll_s, max(deadline.remaining(), 0.001)))
+            time.sleep(min(poll, max(deadline.remaining(), 0.001)))
+            poll = min(poll * 1.5, poll_max_s)
+
+    def query_many(self, uris, timeout_s: Optional[float] = 0.0,
+                   poll_s: float = 0.01,
+                   poll_max_s: float = 0.25) -> Dict[str, Optional[Dict]]:
+        """Poll for MANY records with one batched ``get_results`` per sweep
+        (PR 3): a 1k-record query costs one backend round-trip per poll
+        instead of 1k, and the poll interval backs off while results are
+        pending.  Returns ``{uri: result-or-None}``; unresolved uris map to
+        None once ``timeout_s`` elapses (None = wait for all)."""
+        uris = list(uris)              # may be a generator: iterated twice
+        deadline = Deadline(timeout_s)
+        got: Dict[str, Dict] = {}
+        pending = list(uris)
+        poll = poll_s
+        while pending:
+            res = self.queue.get_results(pending)
+            for u, r in res.items():
+                if r is not None:
+                    got[u] = r
+            before = len(pending)
+            pending = [u for u in pending if u not in got]
+            if not pending or deadline.expired():
+                break
+            if len(pending) < before:
+                poll = poll_s          # stream is draining: stay responsive
+            time.sleep(min(poll, max(deadline.remaining(), 0.001)))
+            poll = min(poll * 1.5, poll_max_s)
+        return {u: got.get(u) for u in uris}
 
     def dequeue(self, uris) -> Dict[str, Dict]:
-        return {u: self.queue.get_result(u) for u in uris}
+        """One batched read for all uris (no polling)."""
+        return dict(self.queue.get_results(uris))
 
     @staticmethod
     def is_error(result: Optional[Dict]) -> bool:

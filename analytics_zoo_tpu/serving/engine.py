@@ -11,13 +11,38 @@ power-of-two buckets (InferenceModel) so the compile cache stays tiny; the micro
 loop is a plain thread, not a Spark Structured Streaming job.
 
 Resilience (PR 1): the reference delegated failure recovery to Spark
-Structured Streaming restarts; here the two worker loops run under
+Structured Streaming restarts; here the worker loops run under
 `SupervisedThread` (crash -> log -> backoff -> restart, capped), one
 malformed record quarantines ONLY itself to the queue's dead-letter channel
 (the client sees an `{"error": ...}` result instead of hanging), a predict
 crash bisects the batch to isolate the poison input, and result writes go
 through a `RetryPolicy` + `CircuitBreaker` instead of the old ad-hoc loop.
 `ClusterServing.health()` reports worker/breaker/dead-letter state.
+
+Throughput data plane (PR 3): the reference leaned on Spark Structured
+Streaming for micro-batch coalescing and parallel executors; the rebuilt
+loop gets the same effects natively:
+
+- **adaptive micro-batching** — `_read_coalesced` fills device-sized
+  batches (`max_batch`) under load, waiting at most `max_wait_ms` once the
+  first record of a partial batch has arrived; an idle stream still returns
+  within `poll_timeout_s`, so latency stays low when traffic is light.
+- **parallel preprocess** — `preprocess_workers > 1` fans the per-record
+  decode (base64 + cv2, the measured host bottleneck) across a thread pool;
+  per-record quarantine and shape re-grouping semantics are unchanged.
+- **async device pipeline** — the predict worker DISPATCHES batches
+  (`InferenceModel.dispatch`, no host readback) and hands the in-flight
+  handle to a downstream write worker; up to `inflight_batches` batches
+  overlap device compute with both preprocess and result writing.
+- **batched result writes** — one `queue.put_results(pairs)` round-trip per
+  micro-batch (Redis pipeline-style `hset` mapping / FileQueue batch spool /
+  InProc bulk), falling back to per-record writes under the existing
+  RetryPolicy + CircuitBreaker when a batch write fails; `trim()` runs on an
+  amortized `trim_interval_s` schedule instead of once per batch.
+- **per-stage metrics** — read/preprocess/stage-wait/predict/write timers
+  plus end-to-end (read -> result written) p50/p99 latency, exposed through
+  `metrics()`/`/metrics` and carried on the `health()` document, so the
+  bottleneck is measured rather than inferred.
 """
 
 from __future__ import annotations
@@ -26,8 +51,9 @@ import base64
 import logging
 import threading
 import time
+from collections import deque
 from queue import Full as _FULL
-from typing import Callable, Dict, List, NamedTuple, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -100,9 +126,112 @@ def default_preprocess(record: Dict):
 
 
 def default_postprocess(probs: np.ndarray, top_n: int = 5) -> List:
-    """top-N (class, prob) pairs (PostProcessing.scala:1-117)."""
-    idx = np.argsort(-probs)[:top_n]
+    """top-N (class, prob) pairs (PostProcessing.scala:1-117).
+
+    O(n) selection: `np.argpartition` pulls the top slice, then only that
+    slice is sorted — at classification widths (1k-20k classes) this beats
+    the previous full `np.argsort` (O(n log n)) per record on the serving
+    write path."""
+    n = probs.shape[-1]
+    if top_n >= n:
+        idx = np.argsort(-probs)
+    else:
+        part = np.argpartition(-probs, top_n)[:top_n]
+        idx = part[np.argsort(-probs[part])]
     return [[int(i), float(probs[i])] for i in idx]
+
+
+class StageStats:
+    """Per-stage counter + latency reservoir (bounded ring of recent
+    samples) feeding the `metrics()` stage breakdown: count, cumulative
+    seconds, and p50/p99 over the last `maxlen` samples.  Thread-safe —
+    read/preprocess record from the preprocess worker while predict/write
+    record from their own workers."""
+
+    def __init__(self, maxlen: int = 2048):
+        self.count = 0
+        self.total_s = 0.0
+        self._samples = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, dt_s: float, n: int = 1) -> None:
+        """Record one duration; ``n > 1`` weights it as n samples (a batch
+        whose records share the same end-to-end latency)."""
+        with self._lock:
+            self.count += n
+            self.total_s += dt_s * n
+            self._samples.extend([dt_s] * n)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            samples = list(self._samples)
+            count, total_s = self.count, self.total_s
+        doc = {"count": count, "total_s": round(total_s, 6)}
+        if samples:
+            arr = np.asarray(samples) * 1e3
+            doc["mean_ms"] = round(float(arr.mean()), 3)
+            doc["p50_ms"] = round(float(np.percentile(arr, 50)), 3)
+            doc["p99_ms"] = round(float(np.percentile(arr, 99)), 3)
+        else:
+            doc["mean_ms"] = doc["p50_ms"] = doc["p99_ms"] = None
+        return doc
+
+
+class _Staged(NamedTuple):
+    """One same-shape micro-batch staged between preprocess and predict."""
+
+    ids: List
+    tensors: np.ndarray
+    scales: Optional[np.ndarray]
+    deadlines: Optional[List]
+    t_read: Optional[float]       # monotonic: read_batch returned
+    t_ready: Optional[float]      # monotonic: preprocess/grouping done
+
+
+class _InFlight(NamedTuple):
+    """One dispatched batch between the predict and write workers.  Keeps
+    the host-side tensors so a device failure surfacing at readback can
+    still bisect-quarantine the poison row."""
+
+    ids: List
+    tensors: np.ndarray
+    scales: Optional[np.ndarray]
+    handle: "_ResultHandle"
+    t_read: Optional[float]
+    t_dispatch: float
+
+
+class _ResultHandle:
+    """Deferred prediction result: `.result()` blocks on (and returns) the
+    host value, re-raising any dispatch/compute failure there so the write
+    stage owns the bisect fallback."""
+
+    def result(self):
+        raise NotImplementedError
+
+
+class _LazyResult(_ResultHandle):
+    """Synchronous fallback handle: the predict call itself is deferred to
+    `.result()` (used when `do_predict` is instance-patched — chaos tests
+    and user shims must stay on the hot path — or the model has no async
+    `dispatch` entry point)."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def result(self):
+        return self._fn()
+
+
+class _FailedDispatch(_ResultHandle):
+    """A dispatch that raised synchronously (e.g. a shape-mismatch trace
+    error): surfaces the exception at `.result()` like any other failure."""
+
+    def __init__(self, exc: BaseException):
+        self._exc = exc
+
+    def result(self):
+        raise self._exc
 
 
 class ServingParams:
@@ -120,7 +249,12 @@ class ServingParams:
                  http_port: Optional[int] = None,
                  http_host: str = "127.0.0.1",
                  drain_s: Optional[float] = None,
-                 ready_queue_depth: Optional[int] = None):
+                 ready_queue_depth: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: float = 5.0,
+                 preprocess_workers: int = 1,
+                 inflight_batches: int = 2,
+                 trim_interval_s: float = 5.0):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -145,6 +279,18 @@ class ServingParams:
         self.http_host = http_host
         self.drain_s = drain_s
         self.ready_queue_depth = ready_queue_depth
+        # throughput data plane (PR 3): adaptive batcher ceiling (None =
+        # batch_size, i.e. the pre-PR-3 fixed read) + coalescing budget,
+        # preprocess fan-out, device pipeline depth, amortized trim period.
+        # inflight_batches bounds the dispatched-handle QUEUE between the
+        # predict and write workers; up to two more batches are transiently
+        # resident (one mid-readback in the writer, one held by the predict
+        # worker awaiting a slot) — size device memory for inflight + 2
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.preprocess_workers = preprocess_workers
+        self.inflight_batches = inflight_batches
+        self.trim_interval_s = trim_interval_s
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -170,7 +316,13 @@ class ServingParams:
             drain_s=(None if p.get("drain_s") is None
                      else float(p["drain_s"])),
             ready_queue_depth=(None if p.get("ready_queue_depth") is None
-                               else int(p["ready_queue_depth"])))
+                               else int(p["ready_queue_depth"])),
+            max_batch=(None if p.get("max_batch") is None
+                       else int(p["max_batch"])),
+            max_wait_ms=float(p.get("max_wait_ms", 5.0)),
+            preprocess_workers=int(p.get("preprocess_workers", 1)),
+            inflight_batches=int(p.get("inflight_batches", 2)),
+            trim_interval_s=float(p.get("trim_interval_s", 5.0)))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -214,6 +366,14 @@ class ClusterServing:
             cooldown_s=p.breaker_cooldown_s, name="dead-letter-write")
         self._pre_sup: Optional[SupervisedThread] = None
         self._predict_sup: Optional[SupervisedThread] = None
+        self._write_sup: Optional[SupervisedThread] = None
+        self._pre_pool = None                # lazy preprocess thread pool
+        self._last_trim = time.monotonic()   # amortized trim schedule
+        # per-stage timers + end-to-end (read -> result written) latency
+        self._stages: Dict[str, StageStats] = {
+            name: StageStats() for name in
+            ("read", "preprocess", "stage_wait", "predict", "write")}
+        self._e2e = StageStats()
         self._tb = None
         if tensorboard_dir:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
@@ -226,6 +386,39 @@ class ClusterServing:
         every batch grind through the full retry schedule."""
         self._breaker.call(self._write_retry.call,
                            self.queue.put_result, rid, value)
+
+    def _flush_results(self, pairs: List[Tuple[str, Dict]]) -> int:
+        """Write one micro-batch of results in a single backend round-trip
+        (`queue.put_results`), behind the same RetryPolicy + CircuitBreaker
+        as single writes.  When the batch write fails (mid-way or wholesale),
+        fall back to per-record writes: `put_result` is idempotent per key,
+        so re-writing an already-committed pair cannot duplicate a result,
+        and only the records that individually fail are quarantined."""
+        if not pairs:
+            return 0
+        try:
+            self._breaker.call(self._write_retry.call,
+                               self.queue.put_results, pairs)
+            return len(pairs)
+        except Exception as e:  # noqa: BLE001 — batch path down: degrade
+            if not isinstance(e, CircuitBreakerOpen):
+                logger.warning(
+                    "serving: batched result write failed (%s: %s); "
+                    "falling back to per-record writes",
+                    type(e).__name__, e)
+            n = 0
+            for rid, value in pairs:
+                try:
+                    self._put_result(rid, value)
+                    n += 1
+                except Exception as rec_exc:  # noqa: BLE001 — record down
+                    # deliberate shed-don't-block tradeoff: when the result
+                    # store is down past the retry budget the computed value
+                    # is dead-lettered (client sees the error and can
+                    # re-enqueue) instead of stalling the write worker
+                    # behind an unbounded blocking retry
+                    self._quarantine(rid, "put_result", rec_exc)
+            return n
 
     def _quarantine(self, rid, stage: str, exc: BaseException,
                     record: Optional[Dict] = None):
@@ -265,20 +458,58 @@ class ClusterServing:
             pass           # deadline still unblocks it
         return True
 
-    def _stack_group(self, ids, items, deadlines):
+    # -- adaptive micro-batching (PR 3 tentpole) -----------------------------
+    def _read_coalesced(self):
+        """Coalescing read: pull up to ``max_batch`` records, and once a
+        PARTIAL batch has arrived keep reading for at most ``max_wait_ms``
+        to fill a device-sized batch (the Structured-Streaming micro-batch
+        coalescing analog).  An idle stream still returns empty within
+        ``poll_timeout_s`` — the wait budget only starts when there is a
+        first record to amortize it against."""
+        p = self.params
+        max_batch = p.max_batch or p.batch_size
+        batch = self.queue.read_batch(max_batch, p.poll_timeout_s)
+        if not batch or len(batch) >= max_batch or p.max_wait_ms <= 0:
+            return batch
+        deadline = time.monotonic() + p.max_wait_ms / 1000.0
+        while len(batch) < max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            more = self.queue.read_batch(max_batch - len(batch),
+                                         min(remaining, p.poll_timeout_s))
+            if more:
+                batch.extend(more)
+        return batch
+
+    def _stack_group(self, ids, items, deadlines, t_read=None):
         """Stack one same-shape group into a staged
         (ids, tensors, scales, deadlines) micro-batch."""
+        t_ready = time.monotonic()
         if all(isinstance(it, QuantizedTensor) for it in items):
             # compact-dtype batch: ship the int8/uint8 bytes to the device,
             # dequantize there (per-row scales)
             tensors = np.stack([it.data for it in items])
             scales = np.asarray([it.scale for it in items], np.float32)
-            return ids, tensors, scales, deadlines
+            return _Staged(ids, tensors, scales, deadlines, t_read, t_ready)
         # mixed float/quantized batches dequantize the stragglers on host
         tensors = np.stack([
             it.data.astype(np.float32) * it.scale
             if isinstance(it, QuantizedTensor) else it for it in items])
-        return ids, tensors, None, deadlines
+        return _Staged(ids, tensors, None, deadlines, t_read, t_ready)
+
+    def _preprocess_pool(self):
+        """Lazy thread pool for ``preprocess_workers > 1`` (base64 + cv2
+        decode release the GIL, so a pool scales on multi-core hosts);
+        ``None`` means inline preprocessing (the pre-PR-3 behaviour)."""
+        if self.params.preprocess_workers <= 1:
+            return None
+        if self._pre_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pre_pool = ThreadPoolExecutor(
+                max_workers=self.params.preprocess_workers,
+                thread_name_prefix="serving-pre")
+        return self._pre_pool
 
     def _read_and_preprocess(self):
         """Read one micro-batch and preprocess it record-by-record, returning
@@ -287,31 +518,57 @@ class ClusterServing:
         mismatch) quarantines alone; records with a different-but-valid shape
         form their own group (multi-shape clients are legitimate — the pow-2
         bucketing in InferenceModel compiles per signature anyway) instead of
-        poisoning np.stack or being rejected for losing a batch vote."""
-        batch = self.queue.read_batch(self.params.batch_size,
-                                      self.params.poll_timeout_s)
+        poisoning np.stack or being rejected for losing a batch vote.
+
+        With ``preprocess_workers > 1`` the per-record decode fans out across
+        the pool; results are gathered in submission order, so quarantine
+        attribution and shape grouping are identical to the inline path."""
+        t0 = time.monotonic()
+        batch = self._read_coalesced()
+        t_read = time.monotonic()
         if not batch:
             return None       # stream empty (drain may exit on this)
-        groups: Dict[tuple, List] = {}
+        self._stages["read"].record(t_read - t0)
+        kept = []
         for rid, rec in batch:
             if self._shed_expired(rid, rec):
                 continue
-            try:
-                item = self.preprocess(rec)
-            except Exception as e:  # noqa: BLE001 — malformed record
-                self._quarantine(rid, "preprocess", e, record=rec)
-                continue
+            kept.append((rid, rec))
+        pool = self._preprocess_pool()
+        items: List = []      # (rid, item-or-exception, deadline_ns)
+        if pool is None:
+            for rid, rec in kept:
+                try:
+                    items.append((rid, self.preprocess(rec),
+                                  rec.get("deadline_ns")))
+                except Exception as e:  # noqa: BLE001 — malformed record
+                    self._quarantine(rid, "preprocess", e, record=rec)
+        else:
+            futures = [pool.submit(self.preprocess, rec)
+                       for _, rec in kept]
+            for (rid, rec), fut in zip(kept, futures):
+                try:
+                    items.append((rid, fut.result(),
+                                  rec.get("deadline_ns")))
+                except Exception as e:  # noqa: BLE001 — malformed record
+                    self._quarantine(rid, "preprocess", e, record=rec)
+        if kept:
+            # one sample per micro-batch (like the other stage timers);
+            # per-RECORD weighting is reserved for the e2e latency reservoir
+            self._stages["preprocess"].record(time.monotonic() - t_read)
+        groups: Dict[tuple, List] = {}
+        for rid, item, dl in items:
             shape = np.shape(item.data if isinstance(item, QuantizedTensor)
                              else item)
-            groups.setdefault(shape, []).append(
-                (rid, item, rec.get("deadline_ns")))
+            groups.setdefault(shape, []).append((rid, item, dl))
         if not groups:
             # records WERE read but all shed/quarantined: distinct from an
             # empty stream so a draining _pre_loop keeps reading the backlog
             return []
         return [self._stack_group([rid for rid, _, _ in triples],
                                   [it for _, it, _ in triples],
-                                  [dl for _, _, dl in triples])
+                                  [dl for _, _, dl in triples],
+                                  t_read=t_read)
                 for triples in groups.values()]
 
     def _predict_isolated(self, ids, tensors, scales):
@@ -321,20 +578,59 @@ class ClusterServing:
         try:
             return [(ids, self.model.do_predict(tensors, scales=scales))]
         except Exception as e:  # noqa: BLE001 — device/input failure
-            if len(ids) == 1:
-                self._quarantine(ids[0], "predict", e)
-                return []
-            mid = len(ids) // 2
-            lo = self._predict_isolated(
-                ids[:mid], tensors[:mid],
-                None if scales is None else scales[:mid])
-            hi = self._predict_isolated(
-                ids[mid:], tensors[mid:],
-                None if scales is None else scales[mid:])
-            return lo + hi
+            return self._bisect_halves(ids, tensors, scales, e)
 
-    def _predict_and_write(self, ids, tensors, scales=None,
-                           deadlines=None) -> int:
+    def _bisect_halves(self, ids, tensors, scales, exc: BaseException):
+        """The bisect step shared by `_predict_isolated` and the write
+        stage's readback-failure fallback: a single poisoned row is
+        quarantined; a larger batch recurses on its halves."""
+        if len(ids) == 1:
+            self._quarantine(ids[0], "predict", exc)
+            return []
+        mid = len(ids) // 2
+        lo = self._predict_isolated(
+            ids[:mid], tensors[:mid],
+            None if scales is None else scales[:mid])
+        hi = self._predict_isolated(
+            ids[mid:], tensors[mid:],
+            None if scales is None else scales[mid:])
+        return lo + hi
+
+    # -- async device pipeline (PR 3 tentpole) --------------------------------
+    def _dispatch_batch(self, tensors, scales) -> _ResultHandle:
+        """Dispatch one batch to the device WITHOUT blocking on the host
+        readback (`InferenceModel.dispatch`): the write worker calls
+        `.result()` downstream, so device compute overlaps both the next
+        batch's preprocessing and the previous batch's result writes.
+
+        A customized ``do_predict`` — instance-patched (chaos tests wrap it)
+        OR overridden on a subclass (user shims) — must stay on the hot
+        path unless the subclass customized ``dispatch`` alongside it, and
+        bridge models may lack ``dispatch`` entirely: all of those fall
+        back to a lazy synchronous call whose work (and failure) surfaces
+        at `.result()` on the write stage."""
+        model = self.model
+        custom_predict = (
+            "do_predict" in vars(model)
+            or getattr(type(model), "do_predict", None)
+            is not InferenceModel.do_predict)
+        custom_dispatch = (
+            "dispatch" in vars(model)
+            or getattr(type(model), "dispatch", None)
+            is not InferenceModel.dispatch)
+        if not hasattr(model, "dispatch") or \
+                (custom_predict and not custom_dispatch):
+            return _LazyResult(
+                lambda: model.do_predict(tensors, scales=scales))
+        try:
+            return model.dispatch(tensors, scales=scales)
+        except Exception as e:  # noqa: BLE001 — trace/shape error at dispatch
+            return _FailedDispatch(e)
+
+    def _predict_stage(self, ids, tensors, scales=None, deadlines=None,
+                       t_read=None, t_ready=None) -> Optional[_InFlight]:
+        """Deadline gate 2 + async dispatch.  Returns the in-flight handle
+        for the write stage, or None when every record was shed."""
         # second deadline gate: a record can expire while staged behind a
         # slow predict — shed it here so the batch never wastes device time
         # on rows nobody is waiting for
@@ -342,41 +638,78 @@ class ClusterServing:
             keep = [i for i, (rid, dl) in enumerate(zip(ids, deadlines))
                     if not self._shed_expired(rid, None, deadline_ns=dl)]
             if not keep:
-                return 0
+                return None
             if len(keep) < len(ids):
                 ids = [ids[i] for i in keep]
                 tensors = tensors[keep]
                 if scales is not None:
                     scales = scales[keep]
-        t0 = time.time()
-        n = 0
-        for chunk_ids, probs in self._predict_isolated(ids, tensors, scales):
+        t0 = time.monotonic()
+        if t_ready is not None:
+            self._stages["stage_wait"].record(t0 - t_ready)
+        handle = self._dispatch_batch(tensors, scales)
+        return _InFlight(ids, tensors, scales, handle, t_read, t0)
+
+    def _write_stage(self, inflight: _InFlight) -> int:
+        """Block on the dispatched batch's host readback, postprocess per
+        record, and flush the whole micro-batch of results in one batched
+        write.  A readback failure falls straight into the bisect halves
+        (the full batch was already tried once by the dispatch), preserving
+        the log2(n) poison-isolation cost."""
+        ids, tensors, scales = inflight.ids, inflight.tensors, inflight.scales
+        try:
+            chunks = [(ids, inflight.handle.result())]
+        except Exception as e:  # noqa: BLE001 — device/input failure
+            chunks = self._bisect_halves(ids, tensors, scales, e)
+        t_done = time.monotonic()
+        self._stages["predict"].record(t_done - inflight.t_dispatch)
+        pairs: List[Tuple[str, Dict]] = []
+        for chunk_ids, probs in chunks:
             for rid, row in zip(chunk_ids, probs):
                 try:
-                    value = {"value": self.postprocess(np.asarray(row))}
+                    pairs.append(
+                        (rid, {"value": self.postprocess(np.asarray(row))}))
                 except Exception as e:  # noqa: BLE001 — per-record isolation
                     self._quarantine(rid, "postprocess", e)
-                    continue
-                try:
-                    self._put_result(rid, value)
-                except Exception as e:  # noqa: BLE001 — write path down
-                    # deliberate shed-don't-block tradeoff: when the result
-                    # store is down past the retry budget the computed value
-                    # is dead-lettered (client sees the error and can
-                    # re-enqueue) instead of stalling the predict worker
-                    # behind an unbounded blocking retry
-                    self._quarantine(rid, "put_result", e)
-                    continue
-                n += 1
+        n = self._flush_results(pairs)
+        now = time.monotonic()
+        if pairs:
+            self._stages["write"].record(now - t_done)
+        if n and inflight.t_read is not None:
+            self._e2e.record(now - inflight.t_read, n=n)
         self.total_records += n
-        dt = max(time.time() - t0, 1e-9)
+        dt = max(now - inflight.t_dispatch, 1e-9)
         if self._tb is not None:
             self._tb.add_scalar("Serving Throughput", n / dt,
                                 self.total_records)
             self._tb.add_scalar("Total Records Number", self.total_records,
                                 self.total_records)
-        self.queue.trim(self.params.stream_max_len)
+        self._maybe_trim()
         return n
+
+    def _maybe_trim(self):
+        """Amortized memory guard: the XTRIM analog used to cost one backend
+        round-trip per micro-batch; now it runs at most once per
+        ``trim_interval_s`` (<= 0 restores the every-batch behaviour)."""
+        interval = self.params.trim_interval_s
+        if interval > 0:
+            now = time.monotonic()
+            if now - self._last_trim < interval:
+                return
+            self._last_trim = now
+        self.queue.trim(self.params.stream_max_len)
+
+    def _predict_and_write(self, ids, tensors, scales=None,
+                           deadlines=None, t_read=None, t_ready=None) -> int:
+        """Synchronous predict+write for one staged group (serve_once and
+        the write-stage fallbacks); the pipelined loop runs the same two
+        stages on separate workers."""
+        inflight = self._predict_stage(ids, tensors, scales=scales,
+                                       deadlines=deadlines, t_read=t_read,
+                                       t_ready=t_ready)
+        if inflight is None:
+            return 0
+        return self._write_stage(inflight)
 
     # -- one micro-batch (synchronous path, used by tests/clients) -----------
     def serve_once(self) -> int:
@@ -387,12 +720,21 @@ class ClusterServing:
 
     # -- lifecycle (cluster-serving-start/stop scripts parity) ----------------
     def start(self):
-        """Pipelined loop: a host thread reads+preprocesses micro-batches into
-        a bounded buffer while the predict thread runs the device — host
-        preprocessing overlaps device compute (round-2 weak #5); the bounded
-        buffer gives natural backpressure when predict falls behind.
+        """Pipelined loop, three supervised stages (PR 3 data plane):
 
-        Both workers run SUPERVISED (PR 1): an escaping exception no longer
+        - ``serving-preprocess`` reads coalesced micro-batches and fans the
+          per-record decode across the preprocess pool;
+        - ``serving-predict`` gates deadlines and DISPATCHES batches to the
+          device without blocking on readback (up to ``inflight_batches``
+          in flight);
+        - ``serving-write`` blocks on each readback, postprocesses, and
+          flushes results in one batched write per micro-batch.
+
+        Host preprocess, device compute, and result writing all overlap; the
+        two bounded hand-off buffers give natural backpressure when a
+        downstream stage falls behind.
+
+        All workers run SUPERVISED (PR 1): an escaping exception no longer
         kills the loop silently — it is logged, the worker restarts with
         backoff up to `params.max_worker_restarts`, and `health()` reports
         state/restarts/last error."""
@@ -413,6 +755,23 @@ class ClusterServing:
             self._http = HealthServer(self, host=p.http_host,
                                       port=p.http_port).start()
         self._staged = _q.Queue(maxsize=p.pipeline_depth)
+        # dispatch() takes no semaphore, so the engine is what bounds
+        # device-resident batches: the handle queue holds `inflight`, plus
+        # one mid-readback in the writer and one held by the predict worker
+        # awaiting a slot — `inflight + 2` total.  Clamp the queue to the
+        # model's supported_concurrent_num so that total never exceeds the
+        # model's contract + 2 (the README sizing guidance)
+        inflight = max(1, p.inflight_batches)
+        model_cap = getattr(self.model, "concurrent_num", None)
+        if model_cap is not None and inflight > model_cap:
+            logger.warning(
+                "serving: inflight_batches=%d exceeds the model's "
+                "supported_concurrent_num=%d; clamping the handle queue "
+                "(up to %d batches stay transiently resident)",
+                inflight, model_cap, model_cap + 2)
+            inflight = max(1, model_cap)
+        self._writeq = _q.Queue(maxsize=inflight)
+        self._last_trim = time.monotonic()
         self._pre_sup = SupervisedThread(
             self._pre_loop, name="serving-preprocess",
             max_restarts=p.max_worker_restarts,
@@ -421,8 +780,13 @@ class ClusterServing:
             self._predict_loop, name="serving-predict",
             max_restarts=p.max_worker_restarts,
             backoff_s=p.worker_backoff_s, stop_event=self._stop)
+        self._write_sup = SupervisedThread(
+            self._write_loop, name="serving-write",
+            max_restarts=p.max_worker_restarts,
+            backoff_s=p.worker_backoff_s, stop_event=self._stop)
         self._pre_sup.start()
         self._predict_sup.start()
+        self._write_sup.start()
         # compat aliases: the raw threads, for callers that poked at them
         self._pre_thread = self._pre_sup._thread
         self._thread = self._predict_sup._thread
@@ -474,16 +838,51 @@ class ClusterServing:
                         and self._staged.empty():
                     return             # drain: upstream done + buffer empty
                 continue
-            self._predict_and_write(*group)
+            inflight = self._predict_stage(*group)
+            if inflight is None:
+                continue               # whole group shed at gate 2
+            while not self._stop.is_set():
+                try:
+                    self._writeq.put(inflight, timeout=0.1)
+                    break
+                except _FULL:
+                    continue           # device pipeline full: backpressure
+
+    def _write_loop(self):
+        import queue as _q
+        sup = self._write_sup
+        while not self._stop.is_set():
+            if sup is not None:
+                sup.heartbeat()
+            try:
+                inflight = self._writeq.get(timeout=0.1)
+            except _q.Empty:
+                # drain exit mirrors _predict_loop: predict worker dead AND
+                # nothing left in flight
+                if self._draining.is_set() and self._predict_sup is not None \
+                        and not self._predict_sup.is_alive() \
+                        and self._writeq.empty():
+                    return             # drain: upstream done + buffer empty
+                continue
+            self._write_stage(inflight)
+
+    def stage_metrics(self) -> Dict:
+        """Per-stage timing document (PR 3): read / preprocess / stage_wait /
+        predict (dispatch -> host readback done) / write counters with
+        p50/p99 over recent samples, plus ``e2e`` — per-record latency from
+        read_batch return to result written."""
+        doc = {name: st.snapshot() for name, st in self._stages.items()}
+        doc["e2e"] = self._e2e.snapshot()
+        return doc
 
     def health(self) -> Dict:
         """Serving health surface (manager `status` / ops, `/healthz`):
         worker states, restart counts, breaker state, record/dead-letter/
-        shed counters, queue health, and the readiness verdict — the one
-        document every surface (health.json snapshot, health CLI, HTTP
-        probes) serves."""
+        shed counters, per-stage timing, queue health, and the readiness
+        verdict — the one document every surface (health.json snapshot,
+        health CLI, HTTP probes) serves."""
         workers = {}
-        for sup in (self._pre_sup, self._predict_sup):
+        for sup in (self._pre_sup, self._predict_sup, self._write_sup):
             if sup is not None:
                 workers[sup.name] = sup.health()
         running = bool(workers) and all(
@@ -505,6 +904,7 @@ class ClusterServing:
              "breaker": self._breaker.health(),
              "dead_letter_breaker": self._dead_breaker.health(),
              "workers": workers,
+             "stages": self.stage_metrics(),
              "queue": queue_health}
         h["ready"] = self._readiness(h)
         return h
@@ -537,8 +937,10 @@ class ClusterServing:
         return self.health()["ready"]
 
     def metrics(self) -> Dict:
-        """Flat JSON counters (`/metrics`)."""
+        """Flat JSON counters + the per-stage timing breakdown
+        (`/metrics`)."""
         h = self.health()
+        e2e = h["stages"]["e2e"]
         return {"served": h["total_records"],
                 "quarantined": h["dead_lettered"],
                 "shed": h["shed"],
@@ -546,18 +948,21 @@ class ClusterServing:
                                 for w in h["workers"].values()),
                 "queue_depth": h["queue"].get("depth", -1),
                 "dead_letters": h["queue"].get("dead_letters", -1),
-                "breaker_trips": h["breaker"]["trip_count"]}
+                "breaker_trips": h["breaker"]["trip_count"],
+                "stages": h["stages"],
+                "latency_ms": {"p50": e2e["p50_ms"], "p99": e2e["p99_ms"]}}
 
     def shutdown(self, drain_s: Optional[float] = None):
         """Stop serving.  With ``drain_s`` (graceful drain, PR 2): close
         admission on the queue, flip `/readyz` to ``draining`` so probes
         stop routing traffic, let the workers finish the stream backlog and
-        flush every in-flight result, then join — falling back to a hard
-        stop when the budget runs out.  Without it: immediate stop (the
-        PR 1 behaviour)."""
+        flush every staged AND dispatched in-flight batch, then join —
+        falling back to a hard stop when the budget runs out.  Without it:
+        immediate stop (the PR 1 behaviour)."""
         if drain_s is None:
             drain_s = 0.0
-        started = self._pre_sup is not None or self._predict_sup is not None
+        sups = (self._pre_sup, self._predict_sup, self._write_sup)
+        started = any(s is not None for s in sups)
         if drain_s > 0 and started:
             self._draining.set()
             try:
@@ -565,14 +970,16 @@ class ClusterServing:
             except Exception:  # noqa: BLE001 — backend down: drain anyway
                 pass
             wait_until(lambda: not any(
-                s is not None and s.is_alive()
-                for s in (self._pre_sup, self._predict_sup)), drain_s)
+                s is not None and s.is_alive() for s in sups), drain_s)
         # the compat aliases (_pre_thread/_thread) point at the SAME thread
         # objects the supervisors own — joining the supervisors covers them
         self._stop.set()
-        for sup in (self._pre_sup, self._predict_sup):
+        for sup in sups:
             if sup is not None:
                 sup.join(timeout=5)
+        if self._pre_pool is not None:
+            self._pre_pool.shutdown(wait=False)
+            self._pre_pool = None
         if self._http is not None:
             self._http.stop()
             self._http = None

@@ -16,7 +16,10 @@ existing health surface on three routes:
   ``{"ready": false, "reasons": [...]}`` otherwise — ``"draining"`` during
   graceful shutdown so load balancers stop routing before the process exits.
 - ``GET /metrics``  — JSON counters: ``served``, ``quarantined``, ``shed``
-  (deadline-exceeded), ``restarts``, ``queue_depth``, ``dead_letters``.
+  (deadline-exceeded), ``restarts``, ``queue_depth``, ``dead_letters``,
+  ``breaker_trips``, plus (PR 3) ``stages`` — per-stage timing
+  (read / preprocess / stage_wait / predict / write / e2e, each with
+  count + p50/p99 ms) — and ``latency_ms`` (end-to-end p50/p99).
 
 Zero dependencies: `ThreadingHTTPServer` on a daemon thread, started by
 ``ClusterServing.start()`` when ``ServingParams.http_port`` is set (0 picks

@@ -32,6 +32,12 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       http_host: 127.0.0.1              # /readyz, /metrics probe endpoint
       drain_s: null                     # graceful-drain budget on SIGTERM
       ready_queue_depth: null           # /readyz depth threshold
+      max_batch: null                   # throughput (PR 3): adaptive batcher
+                                        # ceiling (null = batch_size)
+      max_wait_ms: 5                    # coalescing budget for partial batches
+      preprocess_workers: 1             # decode fan-out (>1 = thread pool)
+      inflight_batches: 2               # async device pipeline depth
+      trim_interval_s: 5                # amortized stream-trim period
 
 CLI (used by scripts/cluster-serving/*.sh):
     python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]

@@ -24,6 +24,14 @@ Availability layer (PR 2):
 - RedisQueue reads (`read_batch`/`get_result`) go through RetryPolicy + a
   read-side CircuitBreaker: an outage degrades to empty batches (readiness
   flips) instead of crash-looping the supervised preprocess worker.
+
+Throughput data plane (PR 3):
+- `put_results(pairs)` / `get_results(keys)` — batched result I/O: one
+  backend round-trip per micro-batch (Redis `hset` mapping / `hmget`,
+  FileQueue batch spool with a single directory fsync / single listing,
+  InProc one lock).  The defaults loop the single-record calls so custom
+  backends stay correct; writes are idempotent per key so the engine's
+  per-record fallback after a failed batch write cannot duplicate results.
 """
 
 from __future__ import annotations
@@ -67,6 +75,24 @@ class BaseQueue:
 
     def get_result(self, key: str) -> Optional[Dict]:
         raise NotImplementedError
+
+    # -- batched result I/O (PR 3 throughput) --------------------------------
+    def put_results(self, pairs: List[Tuple[str, Dict]]) -> None:
+        """Write one micro-batch of results in a single backend round-trip
+        where the backend supports it (Redis `hset` mapping, FileQueue batch
+        spool, InProc bulk append under one lock).  The default loops
+        `put_result` so custom backends stay correct.  Writes are idempotent
+        per key: re-running a partially-committed batch cannot duplicate a
+        result, which is what lets the engine fall back to per-record writes
+        when a batch write fails mid-way."""
+        for key, value in pairs:
+            self.put_result(key, value)
+
+    def get_results(self, keys) -> Dict[str, Optional[Dict]]:
+        """Batched result lookup (client polling): one round-trip for N keys
+        where the backend supports it (Redis `hmget`, FileQueue single
+        directory listing, InProc one lock).  Missing keys map to None."""
+        return {key: self.get_result(key) for key in keys}
 
     def result_count(self) -> int:
         raise NotImplementedError
@@ -277,9 +303,19 @@ class InProcQueue(BaseQueue):
         with self._lock:
             self._results[key] = value
 
+    def put_results(self, pairs):
+        # bulk append: one lock acquisition for the whole micro-batch
+        with self._lock:
+            for key, value in pairs:
+                self._results[key] = value
+
     def get_result(self, key):
         with self._lock:
             return self._results.get(key)
+
+    def get_results(self, keys):
+        with self._lock:
+            return {key: self._results.get(key) for key in keys}
 
     def result_count(self):
         with self._lock:
@@ -329,6 +365,13 @@ class FileQueue(BaseQueue):
         os.makedirs(self.result_dir, exist_ok=True)
         os.makedirs(self.dead_dir, exist_ok=True)
         self.max_depth = max_depth
+        # consumer-side read cache (PR 3): one sorted directory listing
+        # amortized across many read_batch calls — re-sorting a deep spool
+        # on EVERY poll made reads O(depth) per batch.  Safe under the
+        # documented one-consumer/many-producers model: new records sort
+        # after the snapshot (time_ns names), and a cached name deleted
+        # under us (trim/raced consumer) is skipped via FileNotFoundError.
+        self._read_cache: deque = deque()
 
     def depth(self):
         return sum(1 for f in os.listdir(self.stream_dir)
@@ -371,9 +414,12 @@ class FileQueue(BaseQueue):
         deadline = time.time() + timeout_s
         out = []
         while len(out) < max_items:
-            files = sorted(f for f in os.listdir(self.stream_dir)
-                           if f.endswith(".json"))
-            for fname in files[:max_items - len(out)]:
+            if not self._read_cache:
+                self._read_cache.extend(sorted(
+                    f for f in os.listdir(self.stream_dir)
+                    if f.endswith(".json")))
+            while self._read_cache and len(out) < max_items:
+                fname = self._read_cache.popleft()
                 path = os.path.join(self.stream_dir, fname)
                 try:
                     with open(path) as f:
@@ -411,12 +457,68 @@ class FileQueue(BaseQueue):
             json.dump(value, f)
         os.rename(tmp, os.path.join(self.result_dir, f"{key}.json"))
 
+    def put_results(self, pairs):
+        # batch spool: write every tmp file, rename them all, then pay ONE
+        # directory fsync for the whole micro-batch — the durability point
+        # moves from per-record to per-batch without losing the atomic
+        # tmp/rename visibility contract readers depend on
+        renames = []
+        for key, value in pairs:
+            tmp = os.path.join(self.result_dir, f".{key}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(value, f)
+            renames.append((tmp, os.path.join(self.result_dir,
+                                              f"{key}.json")))
+        for tmp, dst in renames:
+            os.rename(tmp, dst)
+        try:
+            fd = os.open(self.result_dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass                           # fsync is best-effort (e.g. NFS)
+
     def get_result(self, key):
         path = os.path.join(self.result_dir, f"{key}.json")
         if not os.path.exists(path):
             return None
         with open(path) as f:
             return json.load(f)
+
+    # below this many keys, per-key stats beat listing the result dir —
+    # which only ever grows over a deployment's lifetime
+    _LIST_THRESHOLD = 32
+
+    def get_results(self, keys):
+        # one directory listing instead of N existence probes for BIG key
+        # sets (absent keys, the common case while polling, cost a set
+        # lookup instead of a stat); small key sets — absolutely, or
+        # relative to the last observed directory size (a mature
+        # deployment's result dir can dwarf any key set) — keep the
+        # per-key path
+        keys = list(keys)
+        if len(keys) < self._LIST_THRESHOLD or \
+                len(keys) * 8 < getattr(self, "_result_dir_size", 0):
+            return {key: self.get_result(key) for key in keys}
+        try:
+            present = set(os.listdir(self.result_dir))
+            self._result_dir_size = len(present)
+        except OSError:
+            return {key: None for key in keys}
+        out = {}
+        for key in keys:
+            if f"{key}.json" in present:
+                try:
+                    with open(os.path.join(self.result_dir,
+                                           f"{key}.json")) as f:
+                        out[key] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    out[key] = None        # raced a writer: poll again
+            else:
+                out[key] = None
+        return out
 
     def result_count(self):
         # only committed results: put_result writes `.{key}.tmp` then renames,
@@ -582,9 +684,11 @@ class RedisQueue(BaseQueue):
 
     def read_batch(self, max_items, timeout_s=0.1):
         try:
+            # block floor of 1 ms: Redis treats BLOCK 0 as "block forever",
+            # which a sub-millisecond coalescing remainder must NOT become
             resp = self._guarded_read(
                 self.r.xread, {self.stream: self._last_id}, count=max_items,
-                block=int(timeout_s * 1000))
+                block=max(1, int(timeout_s * 1000)))
         except _ReadUnavailable:
             self._last_read_failed = True
             return []                      # degrade: readiness reports it
@@ -627,12 +731,35 @@ class RedisQueue(BaseQueue):
     def put_result(self, key, value):
         self.r.hset(self.table, key, json.dumps(value))
 
+    def put_results(self, pairs):
+        # one HSET with a field mapping: a whole micro-batch of results
+        # costs one round-trip instead of len(pairs) — the Redis-pipeline
+        # analog of the reference's bulk result-table writes
+        if not pairs:
+            return
+        self.r.hset(self.table,
+                    mapping={key: json.dumps(value) for key, value in pairs})
+
     def get_result(self, key):
         try:
             v = self._guarded_read(self.r.hget, self.table, key)
         except _ReadUnavailable:
             return None                    # poller keeps waiting; readiness
         return json.loads(v) if v else None
+
+    def get_results(self, keys):
+        # one HMGET for N keys, behind the same retry + read breaker as
+        # single reads: an outage degrades to all-None (pollers keep
+        # waiting, readiness flips) instead of raising
+        keys = list(keys)
+        if not keys:
+            return {}
+        try:
+            vals = self._guarded_read(self.r.hmget, self.table, keys)
+        except _ReadUnavailable:
+            return {key: None for key in keys}
+        return {key: (json.loads(v) if v else None)
+                for key, v in zip(keys, vals)}
 
     def result_count(self):
         return self.r.hlen(self.table)

@@ -54,15 +54,11 @@ def main(argv=None):
     ids = [client_in.enqueue_tensor(f"t{i}",
                                     g.normal(size=(6,)).astype(np.float32))
            for i in range(args.n)]
-    results = {}
-    deadline = time.time() + 30
-    while len(results) < args.n and time.time() < deadline:
-        for rid in ids:
-            if rid not in results:
-                r = client_out.query(rid)
-                if r is not None:
-                    results[rid] = r
-        time.sleep(0.01)
+    # batched polling (PR 3): one get_results round-trip per sweep with
+    # backoff, instead of one read per id per sweep
+    results = {rid: r for rid, r in
+               client_out.query_many(ids, timeout_s=30).items()
+               if r is not None}
     serving.shutdown()
 
     ok = len(results) == args.n

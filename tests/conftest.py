@@ -32,6 +32,10 @@ def pytest_configure(config):
         "longer — SIGALRM-based (no pytest-timeout in this image), so a "
         "hung drain or stuck subprocess can't stall the tier-1 run past "
         "its budget")
+    config.addinivalue_line(
+        "markers",
+        "slow: throughput sweeps / long benchmarks excluded from the "
+        "tier-1 run (`-m 'not slow'`)")
 
 
 @pytest.hookimpl(wrapper=True)

@@ -120,14 +120,24 @@ def test_result_write_retries_with_backoff(ctx):
     im = InferenceModel().do_load_model(model, model._params, model._state)
 
     class Flaky(InProcQueue):
+        # transient result-store outage on the write hot path: the engine
+        # writes through the batched put_results (PR 3) and falls back to
+        # per-record put_result, so both draw from one failure budget
         def __init__(self):
             super().__init__()
             self.failures = 3
 
-        def put_result(self, key, value):
+        def _maybe_fail(self):
             if self.failures > 0:
                 self.failures -= 1
                 raise ConnectionError("redis OOM")   # ClusterServing.scala:276
+
+        def put_results(self, pairs):
+            self._maybe_fail()
+            return super().put_results(pairs)
+
+        def put_result(self, key, value):
+            self._maybe_fail()
             return super().put_result(key, value)
 
     q = Flaky()

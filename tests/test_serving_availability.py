@@ -128,14 +128,23 @@ class FakeRedis:
             if maxlen is not None and len(s) > maxlen:
                 self.streams[stream] = s[-maxlen:]
 
-    def hset(self, table, key, value):
+    def hset(self, table, key=None, value=None, mapping=None):
         with self._lock:
-            self.hashes.setdefault(table, {})[key] = value
+            h = self.hashes.setdefault(table, {})
+            if mapping is not None:
+                h.update(mapping)
+            if key is not None:
+                h[key] = value
 
     def hget(self, table, key):
         with self._lock:
             v = self.hashes.get(table, {}).get(key)
         return v.encode() if isinstance(v, str) else v
+
+    def hmget(self, table, keys):
+        with self._lock:
+            vals = [self.hashes.get(table, {}).get(k) for k in keys]
+        return [v.encode() if isinstance(v, str) else v for v in vals]
 
     def hdel(self, table, *keys):
         with self._lock:
@@ -329,7 +338,11 @@ def test_probe_endpoints_serve_health_document(ctx):
         assert code == 200
         assert set(metrics) == {"served", "quarantined", "shed", "restarts",
                                 "queue_depth", "dead_letters",
-                                "breaker_trips"}
+                                "breaker_trips", "stages", "latency_ms"}
+        # PR 3: per-stage timing + end-to-end latency ride the same doc
+        assert {"read", "preprocess", "stage_wait", "predict", "write",
+                "e2e"} <= set(metrics["stages"])
+        assert set(metrics["latency_ms"]) == {"p50", "p99"}
 
         code, _ = _get(url + "/nope")
         assert code == 404
@@ -679,7 +692,7 @@ def test_manager_health_cli_schema_matches_engine(tmp_path, capsys, ctx):
                     "draining", "shed"):
             assert key in doc
         assert set(doc["workers"]) == {"serving-preprocess",
-                                       "serving-predict"}
+                                       "serving-predict", "serving-write"}
         for w in doc["workers"].values():
             assert {"state", "alive", "restart_count",
                     "crash_streak"} <= set(w)

@@ -102,7 +102,8 @@ def test_poisoned_stream_completes_with_quarantine(queue_kind, tmp_path, ctx):
         # both workers still alive and healthy
         h = serving.health()
         assert h["running"] is True
-        assert set(h["workers"]) == {"serving-preprocess", "serving-predict"}
+        assert set(h["workers"]) == {"serving-preprocess", "serving-predict",
+                                     "serving-write"}
         for w in h["workers"].values():
             assert w["alive"] and w["state"] == "running"
         assert h["dead_lettered"] == 3 and h["total_records"] == 17
@@ -199,7 +200,11 @@ def test_write_retry_then_circuit_breaker_sheds_load(ctx):
                                       clock=lambda: clock[0],
                                       name="result-write")
     inj = FaultInjector()
+    # PR 3: the engine writes through the batched put_results first and only
+    # falls back to put_result — both entry points share one injection site
+    # so the retry/breaker contract is asserted across the whole write path
     q.put_result = inj.wrap("put_result", q.put_result)
+    q.put_results = inj.wrap("put_result", q.put_results)
     cin = InputQueue(q)
 
     # transient: 1 failure, 1 retry -> success, breaker stays closed

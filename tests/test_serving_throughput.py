@@ -1,0 +1,452 @@
+"""Serving hot-path throughput overhaul (PR 3 tentpole): adaptive
+micro-batch coalescing, parallel preprocess with quarantine/grouping
+semantics preserved, the async device pipeline (dispatch -> downstream
+write stage), batched result writes with per-record fallback, amortized
+trim, per-stage metrics, and batched client polling."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import (ClusterServing, ServingParams,
+                                              _LazyResult)
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue, RedisQueue
+from analytics_zoo_tpu.utils.chaos import FaultInjector
+
+from test_serving_availability import FakeRedis
+
+DIM, NCLS = 3, 4
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def _serving(queue, **params):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    model = Sequential()
+    model.add(Dense(NCLS, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=4, poll_timeout_s=0.02, write_backoff_s=0.01,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue, params=ServingParams(**defaults))
+
+
+# -- adaptive micro-batching ---------------------------------------------------
+
+def test_coalescing_batcher_fills_device_batch(ctx):
+    """Records that dribble out of the backend one per read are coalesced
+    into a single device-sized batch within the max_wait budget."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4, max_batch=8, max_wait_ms=2000)
+    orig = q.read_batch
+    q.read_batch = lambda n, t: orig(min(n, 1), t)   # backend dribbles
+    cin = InputQueue(q)
+    for i in range(8):
+        cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+    batch = serving._read_coalesced()
+    assert len(batch) == 8                           # one full device batch
+
+
+def test_coalescing_batcher_releases_partial_batch_at_max_wait(ctx):
+    """A partial batch is released once max_wait_ms elapses — coalescing
+    bounds latency, it does not hold records hostage for a full batch."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4, max_batch=64, max_wait_ms=50)
+    InputQueue(q).enqueue_tensor("r0", np.ones(DIM, np.float32))
+    t0 = time.monotonic()
+    batch = serving._read_coalesced()
+    dt = time.monotonic() - t0
+    assert len(batch) == 1
+    assert 0.04 <= dt < 5.0                          # waited ~the budget
+
+
+def test_coalescing_batcher_idle_stream_stays_low_latency(ctx):
+    """An EMPTY stream returns within poll_timeout_s: the coalescing wait
+    only starts once a first record has arrived to amortize it against."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4, max_batch=64, max_wait_ms=5000,
+                       poll_timeout_s=0.02)
+    t0 = time.monotonic()
+    batch = serving.queue.read_batch(64, 0.01) or serving._read_coalesced()
+    assert not batch
+    assert time.monotonic() - t0 < 2.0               # no max_wait penalty
+
+
+def test_default_max_batch_is_batch_size(ctx):
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4)              # max_batch=None
+    cin = InputQueue(q)
+    for i in range(12):
+        cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+    assert len(serving._read_coalesced()) == 4       # pre-PR-3 read size
+
+
+# -- parallel preprocess -------------------------------------------------------
+
+def test_parallel_preprocess_preserves_quarantine_and_grouping(ctx):
+    """With a preprocess pool, a malformed record still quarantines ALONE
+    and multi-shape batches still re-group by shape downstream."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=8, preprocess_workers=4)
+    cin = InputQueue(q)
+    cin.enqueue_tensor("a0", np.ones(DIM, np.float32))
+    cin.enqueue_tensor("a1", np.ones(DIM, np.float32))
+    q.xadd({"uri": "bad", "b64": "!!!not-base64!!!", "dtype": "<f4",
+            "shape": [DIM]})
+    cin.enqueue_tensor("wide0", np.ones((2, DIM), np.float32))
+    cin.enqueue_tensor("a2", np.ones(DIM, np.float32))
+    groups = serving._read_and_preprocess()
+    shapes = sorted(g.tensors.shape for g in groups)
+    assert shapes == [(1, 2, DIM), (3, DIM)]         # re-grouped by shape
+    by_shape = {g.tensors.shape: g for g in groups}
+    assert by_shape[(3, DIM)].ids == ["a0", "a1", "a2"]
+    assert by_shape[(1, 2, DIM)].ids == ["wide0"]
+    assert [d["uri"] for d in q.dead_letters()] == ["bad"]
+    assert OutputQueue.is_error(q.get_result("bad"))
+    assert serving._pre_pool is not None             # pool actually in use
+
+
+def test_parallel_preprocess_end_to_end(ctx):
+    """Pipelined loop with a preprocess pool serves a poisoned stream to
+    completion — the PR 1 acceptance semantics hold under fan-out."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=8, preprocess_workers=4,
+                       max_batch=16, max_wait_ms=20)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = []
+    for i in range(20):
+        rid = f"r{i}"
+        if i in (3, 11):
+            q.xadd({"uri": rid, "b64": "%%%", "dtype": "<f4",
+                    "shape": [DIM]})
+        else:
+            cin.enqueue_tensor(rid, np.ones(DIM, np.float32))
+        rids.append(rid)
+    serving.start()
+    try:
+        got = cout.query_many(rids, timeout_s=30)
+        assert all(r is not None for r in got.values())
+        errs = [rid for rid, r in got.items() if OutputQueue.is_error(r)]
+        assert sorted(errs) == ["r11", "r3"]
+        assert serving.total_records == 18
+    finally:
+        serving.shutdown()
+    assert serving._pre_pool is None                 # shutdown released it
+
+
+# -- async device pipeline -----------------------------------------------------
+
+def test_dispatch_matches_do_predict(ctx):
+    """InferenceModel.dispatch + .result() == do_predict, including bucket
+    padding (n=5 -> pow-2 bucket 8) and the int8 scales path."""
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense, Flatten
+
+    model = Sequential()
+    model.add(Flatten(input_shape=(4, 3)))
+    model.add(Dense(5, activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+
+    g = np.random.default_rng(0)
+    x = g.normal(size=(5, 4, 3)).astype(np.float32)
+    np.testing.assert_allclose(im.dispatch(x).result(), im.do_predict(x),
+                               rtol=1e-5, atol=1e-6)
+    qx = g.integers(-127, 127, (5, 4, 3)).astype(np.int8)
+    scales = g.uniform(0.01, 0.1, (5,)).astype(np.float32)
+    np.testing.assert_allclose(im.dispatch(qx, scales=scales).result(),
+                               im.do_predict(qx, scales=scales),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_engine_uses_async_dispatch_unless_model_is_patched(ctx):
+    """The hot path dispatches asynchronously; an instance-patched
+    do_predict (chaos wrappers, user shims) stays on the hot path via the
+    lazy synchronous fallback."""
+    serving = _serving(InProcQueue())
+    h = serving._dispatch_batch(np.ones((2, DIM), np.float32), None)
+    assert not isinstance(h, _LazyResult)            # real async dispatch
+    assert h.result().shape == (2, NCLS)
+
+    serving.model.do_predict = \
+        lambda x, scales=None: np.full((len(x), NCLS), 0.25)
+    h2 = serving._dispatch_batch(np.ones((2, DIM), np.float32), None)
+    assert isinstance(h2, _LazyResult)
+    assert h2.result().shape == (2, NCLS)
+
+    # a CLASS-level do_predict override (user subclass) must be honored
+    # too — the base dispatch would silently bypass it
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+
+    class Shimmed(InferenceModel):
+        def do_predict(self, x, batch_size=None, scales=None):
+            return np.full((len(x), NCLS), 0.5)
+
+    serving2 = _serving(InProcQueue())
+    shim = Shimmed()
+    shim.do_load_model(serving2.model._model)
+    serving2.model = shim
+    h3 = serving2._dispatch_batch(np.ones((2, DIM), np.float32), None)
+    assert isinstance(h3, _LazyResult)
+    assert (h3.result() == 0.5).all()
+
+
+def test_drain_flushes_dispatched_inflight_batches(ctx):
+    """Graceful drain under the ASYNC pipeline: batches sitting dispatched
+    in the write queue (slow result store) are all flushed before exit."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4, inflight_batches=4)
+    orig = q.put_results
+
+    def slow_put_results(pairs):
+        time.sleep(0.05)                  # writer becomes the bottleneck
+        return orig(pairs)
+
+    q.put_results = slow_put_results
+    cin = InputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(32)]
+    serving.start()
+    time.sleep(0.1)                       # write queue fills
+    serving.shutdown(drain_s=30.0)
+    got = {rid: q.get_result(rid) for rid in rids}
+    missing = [rid for rid, r in got.items() if r is None]
+    assert not missing, f"drain dropped {missing}"
+    assert all(not OutputQueue.is_error(r) for r in got.values())
+    assert serving.total_records == 32
+
+
+# -- batched result writes -----------------------------------------------------
+
+def test_put_results_all_backends(tmp_path):
+    for q in (InProcQueue(), FileQueue(str(tmp_path / "q")),
+              RedisQueue(client=FakeRedis())):
+        q.put_results([("a", {"value": [1]}), ("b", {"value": [2]})])
+        assert q.get_result("a") == {"value": [1]}
+        assert q.get_result("b") == {"value": [2]}
+        assert q.result_count() == 2
+        got = q.get_results(["a", "b", "missing"])
+        assert got == {"a": {"value": [1]}, "b": {"value": [2]},
+                       "missing": None}
+
+
+def test_batch_write_failure_falls_back_without_loss(ctx):
+    """A failing batch write degrades to per-record writes: every record
+    still resolves exactly once, nothing quarantined."""
+    q = InProcQueue()
+    serving = _serving(q, write_retries=0)
+    inj = FaultInjector().fail("put_results", times=99, exc=ConnectionError)
+    q.put_results = inj.wrap("put_results", q.put_results)
+    cin = InputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(4)]
+    assert serving.serve_once() == 4
+    for rid in rids:
+        assert not OutputQueue.is_error(q.get_result(rid))
+    assert q.result_count() == 4                    # no loss, no duplication
+    assert serving.dead_lettered == 0
+
+
+def test_batch_write_midway_failure_quarantines_only_failing_record(ctx):
+    """Batch write down + ONE record's fallback write also failing: the
+    other records commit, only the culprit is dead-lettered."""
+    q = InProcQueue()
+    serving = _serving(q, write_retries=0)
+    inj = FaultInjector()
+    inj.fail("put_results", times=99, exc=ConnectionError)
+    inj.fail_when("put_result", lambda c: c["args"][0] == "r2",
+                  exc=ConnectionError)
+    q.put_results = inj.wrap("put_results", q.put_results)
+    q.put_result = inj.wrap("put_result", q.put_result)
+    cin = InputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(4)]
+    assert serving.serve_once() == 3
+    for rid in rids:
+        res = q.get_result(rid)
+        assert res is not None
+        assert OutputQueue.is_error(res) == (rid == "r2")
+    assert [d["uri"] for d in q.dead_letters()] == ["r2"]
+    assert serving.dead_lettered == 1
+
+
+def test_trim_runs_on_amortized_schedule(ctx):
+    """Satellite regression: trim used to cost one backend round-trip per
+    micro-batch; now it follows trim_interval_s (0 restores per-batch)."""
+    # amortized: a long interval means ZERO trims across many batches
+    q = InProcQueue()
+    serving = _serving(q, trim_interval_s=3600.0)
+    inj = FaultInjector()
+    q.trim = inj.wrap("trim", q.trim)
+    cin = InputQueue(q)
+    for i in range(12):
+        cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+    while serving.serve_once():
+        pass
+    assert inj.count("trim") == 0
+    # elapsed interval: exactly one trim fires, then the clock re-arms
+    serving._last_trim = time.monotonic() - 7200.0
+    cin.enqueue_tensor("late", np.ones(DIM, np.float32))
+    serving.serve_once()
+    assert inj.count("trim") == 1
+    # interval 0: the pre-PR-3 per-batch behaviour
+    q2 = InProcQueue()
+    serving2 = _serving(q2, trim_interval_s=0.0)
+    inj2 = FaultInjector()
+    q2.trim = inj2.wrap("trim", q2.trim)
+    cin2 = InputQueue(q2)
+    for i in range(12):
+        cin2.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+    while serving2.serve_once():
+        pass
+    assert inj2.count("trim") == 3                  # 12 records / batch 4
+
+
+# -- per-stage metrics ---------------------------------------------------------
+
+def test_stage_metrics_and_latency_populated(ctx):
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(8)]
+    serving.start()
+    try:
+        got = cout.query_many(rids, timeout_s=30)
+        assert all(r is not None for r in got.values())
+        m = serving.metrics()
+        for stage in ("read", "preprocess", "stage_wait", "predict",
+                      "write"):
+            assert m["stages"][stage]["count"] > 0, stage
+            assert m["stages"][stage]["p50_ms"] is not None, stage
+            assert m["stages"][stage]["p99_ms"] is not None, stage
+        assert m["stages"]["e2e"]["count"] == 8
+        assert m["latency_ms"]["p50"] is not None
+        assert m["latency_ms"]["p99"] >= m["latency_ms"]["p50"]
+        # health() carries the same stage document
+        h = serving.health()
+        assert h["stages"] is not None
+        assert set(h["stages"]) == set(m["stages"])
+    finally:
+        serving.shutdown()
+
+
+# -- batched client polling ----------------------------------------------------
+
+def test_query_many_uses_batched_reads_with_backoff(ctx):
+    """A many-record query costs one get_results round-trip per poll sweep
+    (never per-id reads), and the sweep interval backs off."""
+    q = InProcQueue()
+    inj = FaultInjector()
+    q.get_result = inj.wrap("get_result", q.get_result)
+    q.get_results = inj.wrap("get_results", q.get_results)
+    for i in range(50):
+        q.put_result(f"r{i}", {"value": [i]})
+    uris = [f"r{i}" for i in range(50)] + ["missing"]
+    out = OutputQueue(q).query_many(uris, timeout_s=0.3)
+    assert sum(1 for r in out.values() if r is not None) == 50
+    assert out["missing"] is None
+    assert inj.count("get_result") == 0             # never per-id
+    assert 1 <= inj.count("get_results") <= 20      # backoff bounds sweeps
+
+
+def test_query_single_backs_off(ctx):
+    q = InProcQueue()
+    inj = FaultInjector()
+    q.get_result = inj.wrap("get_result", q.get_result)
+    out = OutputQueue(q).query("nope", timeout_s=0.5, poll_s=0.01)
+    assert out is None
+    # fixed 0.01 polling would need ~50 reads; backoff caps it far lower
+    assert inj.count("get_result") <= 20
+
+
+def test_dequeue_is_one_round_trip(ctx):
+    fake = FakeRedis()
+    q = RedisQueue(client=fake)
+    inj = FaultInjector()
+    fake.hmget = inj.wrap("hmget", fake.hmget)
+    fake.hget = inj.wrap("hget", fake.hget)
+    q.put_results([(f"r{i}", {"value": [i]}) for i in range(16)])
+    out = OutputQueue(q).dequeue([f"r{i}" for i in range(16)])
+    assert len(out) == 16 and all(r is not None for r in out.values())
+    assert inj.count("hmget") == 1 and inj.count("hget") == 0
+
+
+# -- O(n) top-N postprocess ----------------------------------------------------
+
+def test_argpartition_postprocess_matches_argsort(ctx):
+    from analytics_zoo_tpu.serving.engine import default_postprocess
+    g = np.random.default_rng(0)
+    for width in (3, 5, 17, 1000):
+        probs = g.random(width).astype(np.float32)
+        got = default_postprocess(probs, top_n=5)
+        idx = np.argsort(-probs)[:5]
+        want = [[int(i), float(probs[i])] for i in idx]
+        assert got == want, width
+
+
+# -- bench smoke + sweep (CI/tooling satellite) --------------------------------
+
+def _bench_main():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serving_bench", os.path.join(REPO, "tools", "serving_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_serving_bench_smoke_mode(ctx):
+    """`serving_bench.py --smoke` completes inside the tier-1 budget and
+    asserts the pipeline + stage metrics itself; here we just re-check the
+    returned document."""
+    out = _bench_main()(["--smoke", "--n", "48"])
+    assert out["records"] == 48
+    assert out["stages"]["e2e"]["count"] == 48
+    assert out["latency_ms"]["p99"] is not None
+
+
+@pytest.mark.slow
+def test_serving_bench_batching_sweep(ctx):
+    """Throughput sweep across batch sizes (slow: excluded from tier-1)."""
+    outs = _bench_main()(["--smoke", "--n", "96", "--sweep", "4,8,16"])
+    assert [o["batch_size"] for o in outs] == [4, 8, 16]
+    for o in outs:
+        assert o["records"] == 96
+
+
+def test_threaded_enqueue_while_serving(ctx):
+    """Coalescing + async pipeline under a LIVE trickle (not pre-filled):
+    all records resolve, none lost between the stage hand-offs."""
+    q = InProcQueue()
+    serving = _serving(q, batch_size=4, max_batch=16, max_wait_ms=10,
+                       preprocess_workers=2, inflight_batches=3)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [f"r{i}" for i in range(60)]
+
+    def feed():
+        for rid in rids:
+            cin.enqueue_tensor(rid, np.ones(DIM, np.float32))
+            time.sleep(0.002)
+
+    t = threading.Thread(target=feed)
+    serving.start()
+    try:
+        t.start()
+        got = cout.query_many(rids, timeout_s=30)
+        t.join()
+        assert all(r is not None for r in got.values())
+        assert all(not OutputQueue.is_error(r) for r in got.values())
+        assert serving.total_records == 60
+    finally:
+        serving.shutdown()
