@@ -1,0 +1,626 @@
+"""Unified telemetry — metrics registry, Prometheus exposition, tracing.
+
+PR 1-3 each grew a bespoke signal surface: `/metrics` served a hand-rolled
+JSON dict, `StageStats` reservoirs lived only inside the serving engine, and
+`Estimator.fit` measured itself with raw `time.time()`.  This module is the
+one telemetry layer all of them now share (the Prometheus/Borgmon pull-
+metrics + Dapper per-request-trace shape):
+
+- ``MetricsRegistry`` — process- or component-scoped registry of labeled
+  ``Counter`` / ``Gauge`` / ``Histogram`` primitives.  Thread-safe (the
+  serving workers record from three threads; training from the fit loop).
+  Histograms keep cumulative bucket counts for Prometheus exposition AND a
+  bounded reservoir of recent samples for p50/p95/p99 summaries — subsuming
+  what the engine's ``StageStats`` did.
+- ``MetricsRegistry.to_prometheus()`` — text exposition format v0.0.4
+  (``# HELP`` / ``# TYPE`` / ``name{label="v"} value`` with
+  ``_bucket``/``_sum``/``_count`` histogram series), served by
+  ``serving/http.py`` under ``/metrics?format=prom``.
+- ``Tracer`` — per-record spans in a bounded ring buffer.  A ``trace_id``
+  is stamped on each record at client enqueue (riding the wire next to
+  ``deadline_ns``); the engine records one span per pipeline stage per
+  record (read → preprocess → stage_wait → predict → write), with the error
+  attached for quarantined/shed records, and can export Chrome trace-event
+  JSON for Perfetto / ``chrome://tracing`` (``tools/trace_view.py``
+  summarizes a dump offline).
+
+Pure stdlib + numpy-free: safe to import from the client, the queues, and
+the trainer without dragging in jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Latency-in-seconds default, sub-ms to 10 s — covers queue polls through
+# cold predict compiles.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting: integers render bare (``3``),
+    floats via repr (``0.005``), specials as ``+Inf``/``-Inf``/``NaN``."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v) == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """numpy.percentile(interpolation='linear') over an already-sorted list —
+    keeps this module numpy-free while matching the StageStats numbers."""
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (n - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+class _Metric:
+    """Base labeled metric: children are keyed by their label-value tuple;
+    an unlabeled metric uses its single ``()`` child, reachable through the
+    convenience methods on the metric itself."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            unexpected = set(kv) - set(self.labelnames)
+            if unexpected:
+                raise ValueError(
+                    f"{self.name}: unexpected label(s) {sorted(unexpected)} "
+                    f"(expected {self.labelnames})")
+            try:
+                values = tuple(kv[ln] for ln in self.labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"{self.name}: missing label {e} "
+                    f"(expected {self.labelnames})") from e
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got {len(values)} label values, "
+                f"expected {len(self.labelnames)}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}: "
+                "call .labels(...) first")
+        return self.labels()
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class _CounterChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default().inc(n)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class _GaugeChild:
+    __slots__ = ("_value", "_fns", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._fns: List[Callable[[], float]] = []
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            self._fns = []
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Callback gauge: sampled at render/snapshot time (queue depth,
+        breaker trip counts — values owned elsewhere).  Replaces any
+        providers registered so far; use `add_function` to accumulate."""
+        with self._lock:
+            self._fns = [fn]
+
+    def add_function(self, fn: Callable[[], float]) -> None:
+        """Register an ADDITIONAL provider: the gauge samples as the sum
+        of all providers, so several engines sharing one registry each stay
+        visible instead of the last registration silently winning."""
+        with self._lock:
+            if fn not in self._fns:
+                self._fns.append(fn)
+
+    def remove_function(self, fn: Callable[[], float]) -> None:
+        """Drop a provider (no-op when absent) — called on engine shutdown
+        so a stopped engine neither skews the sum nor stays reachable from
+        a shared registry."""
+        with self._lock:
+            if fn in self._fns:
+                self._fns.remove(fn)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fns = list(self._fns)
+            if not fns:
+                return self._value
+        total, live = 0.0, 0
+        for fn in fns:
+            try:
+                v = float(fn())
+            except Exception:  # noqa: BLE001 — a dead backend must not kill
+                continue       # the whole exposition
+            if v != v:         # NaN: that provider's backend is down —
+                continue       # don't blind the sum to the healthy ones
+            total += v
+            live += 1
+        return total if live else float("nan")
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self._default().set(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._default().dec(n)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._default().set_function(fn)
+
+    def add_function(self, fn: Callable[[], float]) -> None:
+        self._default().add_function(fn)
+
+    def remove_function(self, fn: Callable[[], float]) -> None:
+        self._default().remove_function(fn)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class _HistogramChild:
+    __slots__ = ("_buckets", "_counts", "_sum", "_count", "_samples", "_lock")
+
+    def __init__(self, buckets: Sequence[float], reservoir: int):
+        self._buckets = tuple(buckets)          # sorted, no +Inf
+        self._counts = [0] * (len(self._buckets) + 1)   # +Inf last
+        self._sum = 0.0
+        self._count = 0
+        self._samples: deque = deque(maxlen=reservoir)
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, n: int = 1) -> None:
+        """Record one value; ``n > 1`` weights it as n samples (a batch
+        whose records share the same latency — StageStats semantics)."""
+        v = float(v)
+        i = 0
+        for i, ub in enumerate(self._buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self._buckets)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
+            self._samples.extend([v] * n)
+
+    # StageStats-compatible alias: the engine's stage timers call record()
+    record = observe
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def recent(self) -> List[float]:
+        """The bounded reservoir of recent raw samples (tbwriter mirroring,
+        trace-free percentile checks)."""
+        with self._lock:
+            return list(self._samples)
+
+    def state(self) -> Tuple[Tuple[float, ...], List[int], float, int]:
+        """(bucket bounds, per-bucket counts incl. +Inf, sum, count) — one
+        consistent read for the Prometheus renderer."""
+        with self._lock:
+            return self._buckets, list(self._counts), self._sum, self._count
+
+    def percentiles(self, qs: Sequence[float] = (50, 95, 99)) -> Dict:
+        samples = sorted(self.recent())
+        if not samples:
+            return {f"p{int(q) if q == int(q) else q}": None for q in qs}
+        return {f"p{int(q) if q == int(q) else q}": _percentile(samples, q)
+                for q in qs}
+
+    def snapshot(self) -> Dict:
+        """The StageStats document, byte-compatible with PR 3's metrics
+        surface: count, cumulative seconds, and mean/p50/p99 in ms over the
+        recent-sample reservoir."""
+        with self._lock:
+            samples = list(self._samples)
+            count, total = self._count, self._sum
+        doc = {"count": count, "total_s": round(total, 6)}
+        if samples:
+            ms = sorted(s * 1e3 for s in samples)
+            doc["mean_ms"] = round(sum(ms) / len(ms), 3)
+            doc["p50_ms"] = round(_percentile(ms, 50), 3)
+            doc["p99_ms"] = round(_percentile(ms, 99), 3)
+        else:
+            doc["mean_ms"] = doc["p50_ms"] = doc["p99_ms"] = None
+        return doc
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 reservoir: int = 2048):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.reservoir = int(reservoir)
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets, self.reservoir)
+
+    def observe(self, v: float, n: int = 1) -> None:
+        self._default().observe(v, n=n)
+
+    record = observe
+
+    @property
+    def count(self) -> int:
+        return self._default().count
+
+    @property
+    def sum(self) -> float:
+        return self._default().sum
+
+    def recent(self) -> List[float]:
+        return self._default().recent()
+
+    def percentiles(self, qs: Sequence[float] = (50, 95, 99)) -> Dict:
+        return self._default().percentiles(qs)
+
+    def snapshot(self) -> Dict:
+        return self._default().snapshot()
+
+
+class MetricsRegistry:
+    """Named metric store.  ``counter``/``gauge``/``histogram`` are
+    get-or-create: re-registering the same name with the same kind and
+    labels returns the existing metric (each serving worker, the inference
+    model, and the trainer can all ask for their metrics without
+    coordinating); a kind or label mismatch raises."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw) -> _Metric:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}{m.labelnames}, wanted "
+                        f"{cls.kind}{labelnames}")
+                return m
+            m = self._metrics[name] = cls(name, help, labelnames, **kw)
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = (),
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._get_or_create(Gauge, name, help, labels)
+        if fn is not None and not labels:
+            # additive: a second registrant (another engine pooling into
+            # this registry) joins the sum instead of clobbering the first
+            g.add_function(fn)
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None,
+                  reservoir: Optional[int] = None) -> Histogram:
+        m = self._get_or_create(
+            Histogram, name, help, labels,
+            buckets=DEFAULT_BUCKETS if buckets is None else buckets,
+            reservoir=2048 if reservoir is None else reservoir)
+        # get-or-create returns the existing metric: explicitly requested
+        # buckets/reservoir that disagree with it would silently land every
+        # observation in the wrong series — refuse like a kind mismatch.
+        # (omitting the arguments means "whatever is registered")
+        if buckets is not None and \
+                tuple(sorted(float(b) for b in buckets)) != m.buckets:
+            raise ValueError(
+                f"metric {name!r} already registered with buckets "
+                f"{m.buckets}, wanted {tuple(buckets)}")
+        if reservoir is not None and int(reservoir) != m.reservoir:
+            raise ValueError(
+                f"metric {name!r} already registered with reservoir "
+                f"{m.reservoir}, wanted {reservoir}")
+        return m
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- snapshots ------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        """JSON document: {name: {type, help, values: [{labels, ...}]}} —
+        the machine-readable sibling of the Prometheus text."""
+        out: Dict = {}
+        for m in self.metrics():
+            vals = []
+            for key, child in m.children():
+                labels = dict(zip(m.labelnames, key))
+                if m.kind == "histogram":
+                    _, counts, total, count = child.state()
+                    vals.append(dict(labels=labels, count=count,
+                                     sum=round(total, 9),
+                                     **{k: v for k, v in
+                                        child.snapshot().items()
+                                        if k not in ("count", "total_s")}))
+                else:
+                    vals.append({"labels": labels, "value": child.value})
+            out[m.name] = {"type": m.kind, "help": m.help, "values": vals}
+        return out
+
+    # -- Prometheus text exposition format v0.0.4 -----------------------------
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def to_prometheus(self) -> str:
+        lines: List[str] = []
+        for m in self.metrics():
+            lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in sorted(m.children(), key=lambda kv: kv[0]):
+                pairs = [f'{ln}="{_escape_label(v)}"'
+                         for ln, v in zip(m.labelnames, key)]
+                if m.kind == "histogram":
+                    bounds, counts, total, count = child.state()
+                    cum = 0
+                    for ub, c in zip(list(bounds) + [float("inf")], counts):
+                        cum += c
+                        lbl = ",".join(pairs + [f'le="{_fmt(ub)}"'])
+                        lines.append(f"{m.name}_bucket{{{lbl}}} {cum}")
+                    suffix = "{" + ",".join(pairs) + "}" if pairs else ""
+                    lines.append(f"{m.name}_sum{suffix} {_fmt(total)}")
+                    lines.append(f"{m.name}_count{suffix} {count}")
+                else:
+                    suffix = "{" + ",".join(pairs) + "}" if pairs else ""
+                    lines.append(f"{m.name}{suffix} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+# -- process-wide default registry/tracer --------------------------------------
+
+_global_registry: Optional[MetricsRegistry] = None
+_global_tracer: Optional["Tracer"] = None
+_global_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (training, standalone inference).  Serving
+    engines default to their OWN registry instance so per-engine counters and
+    stage percentiles stay attributable; pass ``registry=get_registry()`` to
+    pool them."""
+    global _global_registry
+    with _global_lock:
+        if _global_registry is None:
+            _global_registry = MetricsRegistry()
+        return _global_registry
+
+
+def get_tracer() -> "Tracer":
+    global _global_tracer
+    with _global_lock:
+        if _global_tracer is None:
+            _global_tracer = Tracer()
+        return _global_tracer
+
+
+# -- tracing -------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    """128-bit random id, truncated to 16 hex chars (Dapper-style): stamped
+    on the record at client enqueue, carried on every span and on
+    quarantine/shed error results so one slow or poisoned record is
+    greppable end to end."""
+    return uuid.uuid4().hex[:16]
+
+
+class Tracer:
+    """Bounded ring buffer of spans.  A span is a plain dict:
+    ``{trace_id, uri, stage, ts, dur_s, error?}`` with ``ts`` on the
+    monotonic clock (self-consistent within one process, which is where a
+    trace lives).  ``chrome_trace()`` renders the Perfetto /
+    ``chrome://tracing`` event-list form."""
+
+    def __init__(self, maxlen: int = 8192):
+        self._spans: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    new_trace_id = staticmethod(new_trace_id)
+
+    def span(self, stage: str, t0_s: float, t1_s: float,
+             trace_id: Optional[str] = None, uri=None,
+             error: Optional[str] = None) -> Dict:
+        s = {"trace_id": trace_id, "uri": uri, "stage": stage,
+             "ts": float(t0_s), "dur_s": max(float(t1_s) - float(t0_s), 0.0)}
+        if error is not None:
+            s["error"] = str(error)
+        with self._lock:
+            self._spans.append(s)
+        return s
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            out = list(self._spans)
+        if trace_id is not None:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def stages_for(self, trace_id: str) -> List[str]:
+        return [s["stage"] for s in self.spans(trace_id)]
+
+    def chrome_trace(self) -> Dict:
+        """Chrome trace-event JSON (``ph: "X"`` complete events, µs units).
+        One tid per stage so Perfetto lays the pipeline out as parallel
+        tracks; trace_id/uri/error ride in ``args``."""
+        pid = os.getpid()
+        tids: Dict[str, int] = {}
+        events = []
+        for s in self.spans():
+            tid = tids.setdefault(s["stage"], len(tids) + 1)
+            ev = {"name": s["stage"], "cat": "serving", "ph": "X",
+                  "ts": round(s["ts"] * 1e6, 3),
+                  "dur": round(s["dur_s"] * 1e6, 3),
+                  "pid": pid, "tid": tid,
+                  "args": {"trace_id": s["trace_id"], "uri": s["uri"]}}
+            if "error" in s:
+                ev["args"]["error"] = s["error"]
+            events.append(ev)
+        meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                 "args": {"name": stage}} for stage, tid in tids.items()]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        doc = self.chrome_trace()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+
+class SpanTimer:
+    """``with SpanTimer(tracer, "predict", trace_id=..., uri=...):`` — spans
+    a code block; an escaping exception is recorded on the span and
+    re-raised."""
+
+    def __init__(self, tracer: Tracer, stage: str,
+                 trace_id: Optional[str] = None, uri=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._tracer = tracer
+        self.stage = stage
+        self.trace_id = trace_id
+        self.uri = uri
+        self._clock = clock
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = self._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        err = None if exc is None else f"{type(exc).__name__}: {exc}"
+        self._tracer.span(self.stage, self._t0, self._clock(),
+                          trace_id=self.trace_id, uri=self.uri, error=err)
+        return False

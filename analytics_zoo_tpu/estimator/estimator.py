@@ -198,7 +198,8 @@ class Estimator:
 
     def __init__(self, model: Layer, optimizer=None, loss=None, metrics=(),
                  ctx=None, clip_norm: Optional[float] = None,
-                 clip_value: Optional[float] = None, param_plan=None):
+                 clip_value: Optional[float] = None, param_plan=None,
+                 registry=None):
         self.model = model
         self.ctx = ctx or get_context()
         opt = optimizers_lib.get(optimizer) if optimizer is not None else None
@@ -222,6 +223,45 @@ class Estimator:
         self._guard: Optional[_PreemptionGuard] = None
         self._tb_writer = None
         self._tb_val_writer = None
+        # unified telemetry (PR 4): step-time/throughput/loss land in an
+        # observability.MetricsRegistry — the process-wide one by default,
+        # so training and (embedded) serving can share one scrape surface
+        self._obs_registry = registry
+        self._fit_obs = None
+
+    def _fit_metrics_objs(self) -> Dict:
+        """Lazily-registered fit metrics (get-or-create: several estimators
+        in one process share the registry series)."""
+        if self._fit_obs is None:
+            from analytics_zoo_tpu.common.observability import get_registry
+            reg = self._obs_registry or get_registry()
+            self._obs_registry = reg
+            self._fit_obs = {
+                "step_time": reg.histogram(
+                    "fit_step_seconds",
+                    "Wall time per optimizer step (dispatch-side)"),
+                "steps": reg.counter("fit_steps_total",
+                                     "Optimizer steps run"),
+                "samples": reg.counter("fit_samples_total",
+                                       "Weighted training samples consumed"),
+                "loss": reg.gauge("fit_loss", "Last recorded training loss"),
+                "throughput": reg.gauge(
+                    "fit_samples_per_second",
+                    "Training throughput over the last epoch"),
+            }
+        return self._fit_obs
+
+    def fit_summary(self) -> Dict:
+        """Snapshot of the fit metrics in the registry: cumulative
+        steps/samples, the step-time distribution (count + mean/p50/p99 ms,
+        same document shape as the serving stage timers), last loss, and
+        last-epoch throughput."""
+        obs = self._fit_metrics_objs()
+        return {"steps": int(obs["steps"].value),
+                "samples": obs["samples"].value,
+                "step_time": obs["step_time"].snapshot(),
+                "samples_per_second": obs["throughput"].value,
+                "loss": obs["loss"].value}
 
     # -- configuration --------------------------------------------------------
     def set_checkpoint(self, directory: str, trigger: Optional[ZooTrigger] = None,
@@ -488,11 +528,13 @@ class Estimator:
     def _fit_loop(self, data, batch_size, feed_bs, epochs, validation_data,
                   shuffle, verbose, log_every, end_trigger, steps_per_call,
                   hist, np_rng, tstate, retries_left) -> History:
+        obs = self._fit_metrics_objs()
         epoch = 0
         while epoch < epochs:
             t0 = time.time()
             losses, seen = [], 0
             feed = None
+            t_step = time.perf_counter()
             try:
                 batch_iter = self._sync_batch_count(
                     data.batches(feed_bs, shuffle=shuffle, rng=np_rng,
@@ -534,11 +576,23 @@ class Estimator:
                         self.global_step += 1
                         losses.append(l)
                     seen += int(wsum)
+                    # registry metrics (PR 4): per-step wall time on the
+                    # dispatch side (a scanned call spreads its wall time
+                    # over its k fused steps), cumulative step/sample
+                    # counters.  Wall, not device, time — the same clock the
+                    # epoch throughput line uses.
+                    now_step = time.perf_counter()
+                    k = ksteps if steps_per_call > 1 else 1
+                    obs["step_time"].observe((now_step - t_step) / k, n=k)
+                    obs["steps"].inc(k)
+                    obs["samples"].inc(wsum)
+                    t_step = now_step
                     tstate.iteration = self.global_step
                     tstate.epoch_finished = False
                     if self.global_step % log_every == 0:
                         lf = float(l)
                         tstate.loss = lf
+                        obs["loss"].set(lf)
                         if self._tb_writer is not None:
                             self._tb_writer.add_scalar("Loss", lf,
                                                        self.global_step)
@@ -613,10 +667,24 @@ class Estimator:
             throughput = seen / max(dt, 1e-9)
             hist.append("loss", mean_loss)
             hist.append("throughput", throughput)
+            if mean_loss == mean_loss:       # not NaN (empty epoch)
+                obs["loss"].set(mean_loss)
+            obs["throughput"].set(throughput)
             if self._tb_writer is not None:
                 self._tb_writer.add_scalar("Loss", mean_loss, self.global_step)
                 self._tb_writer.add_scalar("Throughput", throughput,
                                            self.global_step)
+                # mirror the registry step-time histogram into the event
+                # file (PR 4): same bucket bounds as the Prometheus
+                # exposition, read back with tbwriter.read_histograms
+                recent = obs["step_time"].recent()
+                if recent:
+                    self._tb_writer.add_histogram(
+                        "StepTime_s", recent, self.global_step,
+                        bucket_limits=obs["step_time"].buckets)
+                    self._tb_writer.add_scalar(
+                        "StepTime_ms_mean",
+                        1e3 * sum(recent) / len(recent), self.global_step)
             msg = (f"Epoch {self.epoch} ({epoch}/{epochs}) - loss {mean_loss:.4f} "
                    f"- {throughput:.0f} samples/s")
             if validation_data is not None:

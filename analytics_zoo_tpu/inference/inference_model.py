@@ -16,6 +16,7 @@ BLAS threading, SURVEY.md §7 hard-parts).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -69,7 +70,7 @@ class InferenceModel:
     overlaps device compute of batch k."""
 
     def __init__(self, supported_concurrent_num: int = 2,
-                 max_batch: int = 1024):
+                 max_batch: int = 1024, registry=None):
         self.max_batch = int(max_batch)
         self.concurrent_num = max(1, int(supported_concurrent_num))
         self._predict_fn: Optional[Callable] = None
@@ -78,6 +79,50 @@ class InferenceModel:
         self._model: Optional[Layer] = None
         self._jitted = None
         self._sem = threading.BoundedSemaphore(self.concurrent_num)
+        # unified telemetry (PR 4): predict/dispatch latency + batch-size
+        # histograms.  `registry` is an observability.MetricsRegistry; left
+        # None it binds lazily — to the serving engine's registry when this
+        # model is handed to a ClusterServing (re-bound per engine, so a
+        # model reused across engines follows the live one), else the
+        # process-wide one.  An EXPLICIT registry is pinned: engines won't
+        # re-bind it.
+        self._obs_registry = registry
+        self._obs_registry_explicit = registry is not None
+        self._obs = None
+
+    def bind_registry(self, registry) -> bool:
+        """Adopt `registry` for the predict/dispatch histograms — called by
+        a ClusterServing at construction so one scrape covers the whole
+        data plane.  A model constructed with an EXPLICIT registry stays
+        pinned (returns False); otherwise the model follows the most recent
+        binder (a model reused across engines, e.g. bench --sweep, reports
+        into the live engine's scrape) and the cached histogram handles are
+        dropped so they re-create in the new registry."""
+        if self._obs_registry_explicit:
+            return False
+        self._obs_registry = registry
+        self._obs = None
+        return True
+
+    def _observe(self, method: str, n: int, dt_s: float) -> None:
+        """Record one predict/dispatch call: wall latency and batch size,
+        labeled by entry point (`do_predict` blocks on readback; `dispatch`
+        measures enqueue-to-device only)."""
+        if self._obs is None:
+            from analytics_zoo_tpu.common.observability import get_registry
+            reg = self._obs_registry or get_registry()
+            self._obs_registry = reg
+            self._obs = (
+                reg.histogram("inference_predict_seconds",
+                              "Model predict/dispatch wall latency",
+                              labels=("method",)),
+                reg.histogram("inference_batch_size",
+                              "Records per predict/dispatch call",
+                              labels=("method",),
+                              buckets=tuple(float(1 << i)
+                                            for i in range(12))))
+        self._obs[0].labels(method=method).observe(dt_s)
+        self._obs[1].labels(method=method).observe(float(n))
 
     # -- loaders --------------------------------------------------------------
     def do_load_model(self, model: Layer, params=None, state=None):
@@ -203,6 +248,7 @@ class InferenceModel:
         synchronous path, evaluated lazily at ``result()``."""
         if self._jitted is None:
             raise RuntimeError("load a model first")
+        t0 = time.perf_counter()
         multi = isinstance(x, (list, tuple))
         if scales is not None and multi:
             raise ValueError("scales= supports single-input models only")
@@ -218,6 +264,7 @@ class InferenceModel:
         else:
             arg = xs if multi else xs[0]
             out = self._jitted(self._params, self._state, arg)
+        self._observe("dispatch", n, time.perf_counter() - t0)
         return self._Pending(out, n)
 
     # -- predict --------------------------------------------------------------
@@ -262,6 +309,7 @@ class InferenceModel:
         are dequantized there (single-input models only)."""
         if self._jitted is None:
             raise RuntimeError("load a model first")
+        t0 = time.perf_counter()
         multi = isinstance(x, (list, tuple))
         if scales is not None and multi:
             raise ValueError("scales= supports single-input models only")
@@ -297,6 +345,7 @@ class InferenceModel:
                 i += take
             while pending:
                 drain_one()
+        self._observe("do_predict", n, time.perf_counter() - t0)
         if isinstance(outs[0], (list, tuple)):
             return [np.concatenate([o[j] for o in outs])
                     for j in range(len(outs[0]))]

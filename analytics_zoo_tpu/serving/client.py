@@ -20,24 +20,42 @@ instead of unbounded queue growth.
 from __future__ import annotations
 
 import base64
+import logging
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common.observability import new_trace_id
 from analytics_zoo_tpu.common.resilience import Deadline
 from analytics_zoo_tpu.serving.queues import BaseQueue
 
+logger = logging.getLogger(__name__)
+
 
 def _stamp_deadline(record: Dict, timeout_s: Optional[float]) -> Dict:
+    """Wire metadata stamped at enqueue: ``deadline_ns`` (when a budget was
+    given) and — PR 4 — a ``trace_id`` riding next to it, so the engine's
+    per-stage spans, quarantine errors, and the client's own deadline
+    warnings all correlate on one id."""
     if timeout_s is not None:
         record["deadline_ns"] = time.time_ns() + int(timeout_s * 1e9)
+    record.setdefault("trace_id", new_trace_id())
     return record
 
 
 class InputQueue:
     def __init__(self, queue: BaseQueue):
         self.queue = queue
+        # trace of the last enqueue, PER THREAD: two threads sharing one
+        # client must not cross-wire each other's trace ids between the
+        # enqueue and the caller reading this back
+        self._tl = threading.local()
+
+    @property
+    def last_trace_id(self) -> Optional[str]:
+        return getattr(self._tl, "trace_id", None)
 
     def enqueue_image(self, uri: str, image, resize=None, fmt: str = ".png",
                       quality: int = 95, device_uint8: bool = False,
@@ -68,7 +86,12 @@ class InputQueue:
             record["resize"] = list(resize)
         if device_uint8:
             record["u8"] = 1
-        return self.queue.xadd(_stamp_deadline(record, timeout_s))
+        return self._xadd(record, timeout_s)
+
+    def _xadd(self, record: Dict, timeout_s: Optional[float]) -> str:
+        record = _stamp_deadline(record, timeout_s)
+        self._tl.trace_id = record["trace_id"]
+        return self.queue.xadd(record)
 
     def enqueue_tensor(self, uri: str, tensor: np.ndarray,
                        wire: str = "f32",
@@ -88,22 +111,22 @@ class InputQueue:
             a = np.asarray(tensor, np.float32)
             scale = float(np.max(np.abs(a)) / 127.0) or 1.0
             q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
-            return self.queue.xadd(_stamp_deadline({
+            return self._xadd({
                 "uri": uri,
                 "b64": base64.b64encode(
                     np.ascontiguousarray(q).tobytes()).decode("ascii"),
                 "dtype": "<i1",
                 "scale": scale,
-                "shape": list(q.shape)}, timeout_s))
+                "shape": list(q.shape)}, timeout_s)
         if wire != "f32":
             raise ValueError(f"unknown wire format {wire!r} "
                              "(expected 'f32' or 'int8')")
         arr = np.ascontiguousarray(np.asarray(tensor, "<f4"))
-        return self.queue.xadd(_stamp_deadline({
+        return self._xadd({
             "uri": uri,
             "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
             "dtype": "<f4",
-            "shape": list(arr.shape)}, timeout_s))
+            "shape": list(arr.shape)}, timeout_s)
 
 
 class OutputQueue:
@@ -195,6 +218,9 @@ class Client:
         self.output = OutputQueue(queue)
         self.default_timeout_s = default_timeout_s
         self._deadline_ns: Dict[str, int] = {}
+        # uri -> (trace_id, budget_s): kept in lockstep with _deadline_ns so
+        # the deadline-expiry warning can name the trace and the budget
+        self._trace_meta: Dict[str, Tuple[Optional[str], float]] = {}
 
     _MAX_TRACKED = 1024
 
@@ -214,8 +240,12 @@ class Client:
                 keep = sorted(self._deadline_ns.items(),
                               key=lambda kv: kv[1])[self._MAX_TRACKED // 2:]
                 self._deadline_ns = dict(keep)
+            self._trace_meta = {u: m for u, m in self._trace_meta.items()
+                                if u in self._deadline_ns}
         if timeout_s is not None:
             self._deadline_ns[uri] = now + int(timeout_s * 1e9)
+            self._trace_meta[uri] = (self.input.last_trace_id,
+                                     float(timeout_s))
 
     def enqueue_tensor(self, uri: str, tensor, wire: str = "f32",
                        timeout_s: Optional[float] = None) -> str:
@@ -253,11 +283,24 @@ class Client:
         res = self.output.query(uri, timeout_s=timeout_s, poll_s=poll_s)
         if res is not None:
             self._deadline_ns.pop(uri, None)
+            self._trace_meta.pop(uri, None)
             return res
         if stamped is not None and time.time_ns() >= stamped:
             self._deadline_ns.pop(uri, None)
-            return {"error": "deadline-exceeded: client budget elapsed "
-                             "before a result arrived"}
+            trace_id, budget_s = self._trace_meta.pop(uri, (None, None))
+            # structured expiry warning (PR 4): the old behaviour — a bare
+            # None quietly turning into "not ready" — hid dropped requests;
+            # the trace_id links this client-side timeout to whatever the
+            # engine's spans say happened (or never happened) server-side
+            logger.warning(
+                "serving client: deadline expired uri=%s trace_id=%s "
+                "budget_s=%s", uri, trace_id,
+                "?" if budget_s is None else f"{budget_s:.3f}")
+            err = {"error": "deadline-exceeded: client budget elapsed "
+                            "before a result arrived"}
+            if trace_id is not None:
+                err["trace_id"] = trace_id
+            return err
         return None
 
     def predict(self, uri: str, tensor, wire: str = "f32",

@@ -43,20 +43,36 @@ loop gets the same effects natively:
   plus end-to-end (read -> result written) p50/p99 latency, exposed through
   `metrics()`/`/metrics` and carried on the `health()` document, so the
   bottleneck is measured rather than inferred.
+
+Unified telemetry (PR 4): the bespoke `StageStats` reservoirs are replaced
+by `common/observability.py` registry primitives — every stage timer is a
+labeled `Histogram` (`serving_stage_seconds{stage=...}`), quarantine/shed/
+record counts are `Counter`s, queue depth / restarts / breaker trips are
+callback `Gauge`s — and the whole registry renders as Prometheus text
+exposition via `/metrics?format=prom` (the JSON document is unchanged).  A
+`Tracer` records one span per pipeline stage per record, keyed by the
+`trace_id` the client stamped at enqueue (riding the wire next to
+`deadline_ns`); quarantined and shed records get a span carrying the error,
+so a single slow or poisoned record is diagnosable by trace_id
+(`ClusterServing.export_trace()` dumps Chrome trace-event JSON that
+`tools/trace_view.py` summarizes).
 """
 
 from __future__ import annotations
 
 import base64
+import itertools
 import logging
+import os
 import threading
 import time
-from collections import deque
 from queue import Full as _FULL
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.common.observability import (MetricsRegistry, Tracer,
+                                                    new_trace_id)
 from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                  CircuitBreakerOpen,
                                                  RetryPolicy,
@@ -141,49 +157,21 @@ def default_postprocess(probs: np.ndarray, top_n: int = 5) -> List:
     return [[int(i), float(probs[i])] for i in idx]
 
 
-class StageStats:
-    """Per-stage counter + latency reservoir (bounded ring of recent
-    samples) feeding the `metrics()` stage breakdown: count, cumulative
-    seconds, and p50/p99 over the last `maxlen` samples.  Thread-safe —
-    read/preprocess record from the preprocess worker while predict/write
-    record from their own workers."""
-
-    def __init__(self, maxlen: int = 2048):
-        self.count = 0
-        self.total_s = 0.0
-        self._samples = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
-
-    def record(self, dt_s: float, n: int = 1) -> None:
-        """Record one duration; ``n > 1`` weights it as n samples (a batch
-        whose records share the same end-to-end latency)."""
-        with self._lock:
-            self.count += n
-            self.total_s += dt_s * n
-            self._samples.extend([dt_s] * n)
-
-    def snapshot(self) -> Dict:
-        with self._lock:
-            samples = list(self._samples)
-            count, total_s = self.count, self.total_s
-        doc = {"count": count, "total_s": round(total_s, 6)}
-        if samples:
-            arr = np.asarray(samples) * 1e3
-            doc["mean_ms"] = round(float(arr.mean()), 3)
-            doc["p50_ms"] = round(float(np.percentile(arr, 50)), 3)
-            doc["p99_ms"] = round(float(np.percentile(arr, 99)), 3)
-        else:
-            doc["mean_ms"] = doc["p50_ms"] = doc["p99_ms"] = None
-        return doc
+# StageStats (PR 3) is gone: the per-stage reservoirs are now labeled
+# observability.Histogram children (`serving_stage_seconds{stage=...}`)
+# whose .snapshot() emits the same {count,total_s,mean_ms,p50_ms,p99_ms}
+# document, plus Prometheus _bucket/_sum/_count series for free.
 
 
 class _Staged(NamedTuple):
-    """One same-shape micro-batch staged between preprocess and predict."""
+    """One same-shape micro-batch staged between preprocess and predict.
+    Field order is part of the internal API: `_predict_stage(*staged)`."""
 
     ids: List
     tensors: np.ndarray
     scales: Optional[np.ndarray]
     deadlines: Optional[List]
+    traces: Optional[List]        # per-record trace_id (wire-stamped)
     t_read: Optional[float]       # monotonic: read_batch returned
     t_ready: Optional[float]      # monotonic: preprocess/grouping done
 
@@ -197,6 +185,7 @@ class _InFlight(NamedTuple):
     tensors: np.ndarray
     scales: Optional[np.ndarray]
     handle: "_ResultHandle"
+    traces: Optional[List]
     t_read: Optional[float]
     t_dispatch: float
 
@@ -254,7 +243,8 @@ class ServingParams:
                  max_wait_ms: float = 5.0,
                  preprocess_workers: int = 1,
                  inflight_batches: int = 2,
-                 trim_interval_s: float = 5.0):
+                 trim_interval_s: float = 5.0,
+                 tracing: bool = True):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -291,6 +281,11 @@ class ServingParams:
         self.preprocess_workers = preprocess_workers
         self.inflight_batches = inflight_batches
         self.trim_interval_s = trim_interval_s
+        # per-record span recording (PR 4).  On by default — the ring buffer
+        # is bounded — but the span dicts + tracer lock are per-record hot-
+        # path cost, so latency-critical deployments can switch it off
+        # (metrics histograms stay on; only traces go dark)
+        self.tracing = bool(tracing)
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -322,7 +317,8 @@ class ServingParams:
             max_wait_ms=float(p.get("max_wait_ms", 5.0)),
             preprocess_workers=int(p.get("preprocess_workers", 1)),
             inflight_batches=int(p.get("inflight_batches", 2)),
-            trim_interval_s=float(p.get("trim_interval_s", 5.0)))
+            trim_interval_s=float(p.get("trim_interval_s", 5.0)),
+            tracing=bool(p.get("tracing", True)))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -337,7 +333,9 @@ class ClusterServing:
                  params: Optional[ServingParams] = None,
                  preprocess: Callable = default_preprocess,
                  postprocess: Optional[Callable] = None,
-                 tensorboard_dir: Optional[str] = None):
+                 tensorboard_dir: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.model = model
         self.queue = queue
         self.params = params or ServingParams()
@@ -351,6 +349,18 @@ class ClusterServing:
         self.dead_lettered = 0
         self.shed = 0                        # deadline-exceeded rejections
         self._http = None                    # HealthServer when http_port set
+        # unified telemetry (PR 4): per-ENGINE registry by default so
+        # counters and stage percentiles stay attributable when several
+        # engines share a process (tests, embedded serving); pass
+        # observability.get_registry() to pool process-wide
+        self.registry = registry or MetricsRegistry()
+        self.tracer = tracer or Tracer()
+        # span recording is per-record hot-path work; params.tracing=False
+        # compiles the switch down to a no-op callable
+        self._span = (self.tracer.span if self.params.tracing
+                      else (lambda *a, **kw: None))
+        self._t_start = time.monotonic()     # re-stamped by start()
+        self._snapshot_seq = itertools.count(1)
         p = self.params
         self._write_retry = RetryPolicy(max_retries=p.write_retries,
                                         base_delay_s=p.write_backoff_s)
@@ -369,15 +379,71 @@ class ClusterServing:
         self._write_sup: Optional[SupervisedThread] = None
         self._pre_pool = None                # lazy preprocess thread pool
         self._last_trim = time.monotonic()   # amortized trim schedule
-        # per-stage timers + end-to-end (read -> result written) latency
-        self._stages: Dict[str, StageStats] = {
-            name: StageStats() for name in
+        # per-stage timers + end-to-end (read -> result written) latency,
+        # now registry histograms: same .record()/.snapshot() surface as the
+        # old StageStats, plus Prometheus exposition
+        reg = self.registry
+        stage_hist = reg.histogram(
+            "serving_stage_seconds",
+            "Per-stage latency of the serving pipeline", labels=("stage",))
+        self._stages = {
+            name: stage_hist.labels(stage=name) for name in
             ("read", "preprocess", "stage_wait", "predict", "write")}
-        self._e2e = StageStats()
+        self._e2e = reg.histogram(
+            "serving_e2e_seconds",
+            "Per-record latency from read_batch return to result written")
+        self._m_records = reg.counter(
+            "serving_records_total", "Records served (results written)")
+        self._m_quarantined = reg.counter(
+            "serving_quarantined_total", "Records dead-lettered, by stage",
+            labels=("stage",))
+        self._m_shed = reg.counter(
+            "serving_shed_total", "Deadline-exceeded records shed")
+        # callback gauges are registered additively (engines pooling into
+        # one registry each contribute to the sum) and deregistered on
+        # shutdown so a stopped engine neither skews the scrape nor stays
+        # reachable from a shared registry
+        self._gauge_fns = [
+            (reg.gauge("serving_queue_depth", "Records waiting in the stream",
+                       fn=self._queue_depth_metric), self._queue_depth_metric),
+            (reg.gauge("serving_dead_letters", "Dead-letter backlog",
+                       fn=self._dead_letter_metric), self._dead_letter_metric),
+            (reg.gauge("serving_worker_restarts",
+                       "Supervised-worker restarts across all stages",
+                       fn=self._restarts_metric), self._restarts_metric),
+        ]
+        trips = lambda: self._breaker.trip_count  # noqa: E731
+        self._gauge_fns.append(
+            (reg.gauge("serving_breaker_trips", "Result-write breaker trips",
+                       fn=trips), trips))
+        # inference-side latency/batch histograms (InferenceModel) ride this
+        # engine's registry so one scrape covers the whole data plane (see
+        # InferenceModel.bind_registry for the re-binding/pinning rules)
+        if isinstance(model, InferenceModel):
+            model.bind_registry(self.registry)
         self._tb = None
         if tensorboard_dir:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
             self._tb = FileWriter(tensorboard_dir)
+
+    # -- callback-gauge samplers (guarded: a dead backend yields NaN) --------
+    def _queue_depth_metric(self) -> float:
+        try:
+            return float(self.queue.depth())
+        except Exception:  # noqa: BLE001 — backend down
+            return float("nan")
+
+    def _dead_letter_metric(self) -> float:
+        try:
+            return float(self.queue.dead_letter_count())
+        except Exception:  # noqa: BLE001
+            return float("nan")
+
+    def _restarts_metric(self) -> float:
+        return float(sum(
+            s.health()["restart_count"]
+            for s in (self._pre_sup, self._predict_sup, self._write_sup)
+            if s is not None))
 
     # -- result write with backpressure (ClusterServing.scala:276-307) -------
     def _put_result(self, rid, value):
@@ -387,7 +453,8 @@ class ClusterServing:
         self._breaker.call(self._write_retry.call,
                            self.queue.put_result, rid, value)
 
-    def _flush_results(self, pairs: List[Tuple[str, Dict]]) -> int:
+    def _flush_results(self, pairs: List[Tuple[str, Dict]],
+                       tmap: Optional[Dict] = None) -> int:
         """Write one micro-batch of results in a single backend round-trip
         (`queue.put_results`), behind the same RetryPolicy + CircuitBreaker
         as single writes.  When the batch write fails (mid-way or wholesale),
@@ -417,20 +484,30 @@ class ClusterServing:
                     # is dead-lettered (client sees the error and can
                     # re-enqueue) instead of stalling the write worker
                     # behind an unbounded blocking retry
-                    self._quarantine(rid, "put_result", rec_exc)
+                    self._quarantine(rid, "put_result", rec_exc,
+                                     trace_id=(tmap or {}).get(rid))
             return n
 
     def _quarantine(self, rid, stage: str, exc: BaseException,
-                    record: Optional[Dict] = None):
+                    record: Optional[Dict] = None,
+                    trace_id: Optional[str] = None):
         """Per-record fault isolation: the poisoned record gets an error
         RESULT (client unblocks and sees the failure) plus a dead-letter
-        entry; the rest of its micro-batch proceeds untouched."""
+        entry; the rest of its micro-batch proceeds untouched.  The span
+        carries the error (and the record's trace_id when known), so the
+        quarantine is diagnosable from the trace alone."""
         self.dead_lettered += 1
+        self._m_quarantined.labels(stage=stage).inc()
         msg = f"{stage}: {type(exc).__name__}: {exc}"
+        if trace_id is None and record is not None:
+            trace_id = record.get("trace_id")
+        now = time.monotonic()
+        self._span(stage, now, now, trace_id=trace_id, uri=rid,
+                         error=msg)
         logger.warning("serving: quarantining record %r (%s)", rid, msg)
         try:
             self._dead_breaker.call(self.queue.put_error, rid, msg,
-                                    record=record)
+                                    record=record, trace_id=trace_id)
         except CircuitBreakerOpen:
             # store is down: shed quietly instead of blocking per record on
             # the dead backend (the counter above still records the loss)
@@ -441,19 +518,32 @@ class ClusterServing:
 
     # -- end-to-end deadlines (PR 2 availability) ----------------------------
     def _shed_expired(self, rid, rec: Optional[Dict],
-                      deadline_ns: Optional[int] = None) -> bool:
+                      deadline_ns: Optional[int] = None,
+                      stage: str = "read",
+                      trace_id: Optional[str] = None) -> bool:
         """True when the record's enqueue-stamped `deadline_ns` has passed:
         the client gets a `deadline-exceeded` error result and the record
-        never occupies a predict slot."""
+        never occupies a predict slot.  The shed is recorded as a zero-width
+        span at the gate's stage, error attached, so an expired record still
+        shows up in its trace."""
         dl = deadline_ns if deadline_ns is not None \
             else (rec or {}).get("deadline_ns")
         if dl is None or time.time_ns() <= int(dl):
             return False
         self.shed += 1
+        self._m_shed.inc()
+        if trace_id is None and rec is not None:
+            trace_id = rec.get("trace_id")
+        now = time.monotonic()
+        error = "deadline-exceeded: budget elapsed before predict"
+        self._span(stage, now, now, trace_id=trace_id, uri=rid,
+                         error=error)
         logger.info("serving: shedding expired record %r", rid)
+        result = {"error": error}
+        if trace_id is not None:
+            result["trace_id"] = trace_id
         try:
-            self._put_result(rid, {"error": "deadline-exceeded: budget "
-                                            "elapsed before predict"})
+            self._put_result(rid, result)
         except Exception:  # noqa: BLE001 — store down: client's own
             pass           # deadline still unblocks it
         return True
@@ -482,21 +572,23 @@ class ClusterServing:
                 batch.extend(more)
         return batch
 
-    def _stack_group(self, ids, items, deadlines, t_read=None):
+    def _stack_group(self, ids, items, deadlines, traces=None, t_read=None):
         """Stack one same-shape group into a staged
-        (ids, tensors, scales, deadlines) micro-batch."""
+        (ids, tensors, scales, deadlines, traces) micro-batch."""
         t_ready = time.monotonic()
         if all(isinstance(it, QuantizedTensor) for it in items):
             # compact-dtype batch: ship the int8/uint8 bytes to the device,
             # dequantize there (per-row scales)
             tensors = np.stack([it.data for it in items])
             scales = np.asarray([it.scale for it in items], np.float32)
-            return _Staged(ids, tensors, scales, deadlines, t_read, t_ready)
+            return _Staged(ids, tensors, scales, deadlines, traces,
+                           t_read, t_ready)
         # mixed float/quantized batches dequantize the stragglers on host
         tensors = np.stack([
             it.data.astype(np.float32) * it.scale
             if isinstance(it, QuantizedTensor) else it for it in items])
-        return _Staged(ids, tensors, None, deadlines, t_read, t_ready)
+        return _Staged(ids, tensors, None, deadlines, traces,
+                       t_read, t_ready)
 
     def _preprocess_pool(self):
         """Lazy thread pool for ``preprocess_workers > 1`` (base64 + cv2
@@ -529,71 +621,88 @@ class ClusterServing:
         if not batch:
             return None       # stream empty (drain may exit on this)
         self._stages["read"].record(t_read - t0)
+        for rid, rec in batch:
+            # every record that enters the pipeline gets a trace: producers
+            # that bypass the client (raw xadd) are stamped at read instead
+            rec.setdefault("trace_id", new_trace_id())
+            self._span("read", t0, t_read,
+                             trace_id=rec["trace_id"], uri=rid)
         kept = []
         for rid, rec in batch:
             if self._shed_expired(rid, rec):
                 continue
             kept.append((rid, rec))
+
+        def pre_one(rec):
+            """Per-record timed decode, so one slow record is visible in
+            its own preprocess span rather than smeared across the batch."""
+            p0 = time.monotonic()
+            out = self.preprocess(rec)
+            return out, p0, time.monotonic()
+
         pool = self._preprocess_pool()
-        items: List = []      # (rid, item-or-exception, deadline_ns)
+        items: List = []      # (rid, item, deadline_ns, trace_id)
         if pool is None:
-            for rid, rec in kept:
-                try:
-                    items.append((rid, self.preprocess(rec),
-                                  rec.get("deadline_ns")))
-                except Exception as e:  # noqa: BLE001 — malformed record
-                    self._quarantine(rid, "preprocess", e, record=rec)
+            gathered = [(rid, rec, None) for rid, rec in kept]
         else:
-            futures = [pool.submit(self.preprocess, rec)
-                       for _, rec in kept]
-            for (rid, rec), fut in zip(kept, futures):
-                try:
-                    items.append((rid, fut.result(),
-                                  rec.get("deadline_ns")))
-                except Exception as e:  # noqa: BLE001 — malformed record
-                    self._quarantine(rid, "preprocess", e, record=rec)
+            gathered = [(rid, rec, pool.submit(pre_one, rec))
+                        for rid, rec in kept]
+        for rid, rec, fut in gathered:
+            try:
+                item, p0, p1 = fut.result() if fut is not None \
+                    else pre_one(rec)
+                self._span("preprocess", p0, p1,
+                                 trace_id=rec.get("trace_id"), uri=rid)
+                items.append((rid, item, rec.get("deadline_ns"),
+                              rec.get("trace_id")))
+            except Exception as e:  # noqa: BLE001 — malformed record
+                self._quarantine(rid, "preprocess", e, record=rec)
         if kept:
             # one sample per micro-batch (like the other stage timers);
             # per-RECORD weighting is reserved for the e2e latency reservoir
             self._stages["preprocess"].record(time.monotonic() - t_read)
         groups: Dict[tuple, List] = {}
-        for rid, item, dl in items:
+        for rid, item, dl, tid in items:
             shape = np.shape(item.data if isinstance(item, QuantizedTensor)
                              else item)
-            groups.setdefault(shape, []).append((rid, item, dl))
+            groups.setdefault(shape, []).append((rid, item, dl, tid))
         if not groups:
             # records WERE read but all shed/quarantined: distinct from an
             # empty stream so a draining _pre_loop keeps reading the backlog
             return []
-        return [self._stack_group([rid for rid, _, _ in triples],
-                                  [it for _, it, _ in triples],
-                                  [dl for _, _, dl in triples],
+        return [self._stack_group([rid for rid, _, _, _ in quads],
+                                  [it for _, it, _, _ in quads],
+                                  [dl for _, _, dl, _ in quads],
+                                  traces=[tid for _, _, _, tid in quads],
                                   t_read=t_read)
-                for triples in groups.values()]
+                for quads in groups.values()]
 
-    def _predict_isolated(self, ids, tensors, scales):
+    def _predict_isolated(self, ids, tensors, scales, tmap=None):
         """Predict with graceful degradation: on failure, bisect the batch to
         isolate the poison input — sane rows still get answers, only the
         culprit is dead-lettered (log2(n) extra predict calls, worst case)."""
         try:
             return [(ids, self.model.do_predict(tensors, scales=scales))]
         except Exception as e:  # noqa: BLE001 — device/input failure
-            return self._bisect_halves(ids, tensors, scales, e)
+            return self._bisect_halves(ids, tensors, scales, e, tmap=tmap)
 
-    def _bisect_halves(self, ids, tensors, scales, exc: BaseException):
+    def _bisect_halves(self, ids, tensors, scales, exc: BaseException,
+                       tmap=None):
         """The bisect step shared by `_predict_isolated` and the write
         stage's readback-failure fallback: a single poisoned row is
-        quarantined; a larger batch recurses on its halves."""
+        quarantined; a larger batch recurses on its halves.  ``tmap``
+        (rid -> trace_id) keeps quarantine spans correlatable."""
         if len(ids) == 1:
-            self._quarantine(ids[0], "predict", exc)
+            self._quarantine(ids[0], "predict", exc,
+                             trace_id=(tmap or {}).get(ids[0]))
             return []
         mid = len(ids) // 2
         lo = self._predict_isolated(
             ids[:mid], tensors[:mid],
-            None if scales is None else scales[:mid])
+            None if scales is None else scales[:mid], tmap=tmap)
         hi = self._predict_isolated(
             ids[mid:], tensors[mid:],
-            None if scales is None else scales[mid:])
+            None if scales is None else scales[mid:], tmap=tmap)
         return lo + hi
 
     # -- async device pipeline (PR 3 tentpole) --------------------------------
@@ -628,7 +737,8 @@ class ClusterServing:
             return _FailedDispatch(e)
 
     def _predict_stage(self, ids, tensors, scales=None, deadlines=None,
-                       t_read=None, t_ready=None) -> Optional[_InFlight]:
+                       traces=None, t_read=None,
+                       t_ready=None) -> Optional[_InFlight]:
         """Deadline gate 2 + async dispatch.  Returns the in-flight handle
         for the write stage, or None when every record was shed."""
         # second deadline gate: a record can expire while staged behind a
@@ -636,7 +746,9 @@ class ClusterServing:
         # on rows nobody is waiting for
         if deadlines is not None and any(d is not None for d in deadlines):
             keep = [i for i, (rid, dl) in enumerate(zip(ids, deadlines))
-                    if not self._shed_expired(rid, None, deadline_ns=dl)]
+                    if not self._shed_expired(
+                        rid, None, deadline_ns=dl, stage="stage_wait",
+                        trace_id=traces[i] if traces else None)]
             if not keep:
                 return None
             if len(keep) < len(ids):
@@ -644,11 +756,16 @@ class ClusterServing:
                 tensors = tensors[keep]
                 if scales is not None:
                     scales = scales[keep]
+                if traces is not None:
+                    traces = [traces[i] for i in keep]
         t0 = time.monotonic()
         if t_ready is not None:
             self._stages["stage_wait"].record(t0 - t_ready)
+            for rid, tid in zip(ids, traces or [None] * len(ids)):
+                self._span("stage_wait", t_ready, t0,
+                                 trace_id=tid, uri=rid)
         handle = self._dispatch_batch(tensors, scales)
-        return _InFlight(ids, tensors, scales, handle, t_read, t0)
+        return _InFlight(ids, tensors, scales, handle, traces, t_read, t0)
 
     def _write_stage(self, inflight: _InFlight) -> int:
         """Block on the dispatched batch's host readback, postprocess per
@@ -657,27 +774,35 @@ class ClusterServing:
         (the full batch was already tried once by the dispatch), preserving
         the log2(n) poison-isolation cost."""
         ids, tensors, scales = inflight.ids, inflight.tensors, inflight.scales
+        tmap = dict(zip(ids, inflight.traces or []))
         try:
             chunks = [(ids, inflight.handle.result())]
         except Exception as e:  # noqa: BLE001 — device/input failure
-            chunks = self._bisect_halves(ids, tensors, scales, e)
+            chunks = self._bisect_halves(ids, tensors, scales, e, tmap=tmap)
         t_done = time.monotonic()
         self._stages["predict"].record(t_done - inflight.t_dispatch)
         pairs: List[Tuple[str, Dict]] = []
         for chunk_ids, probs in chunks:
             for rid, row in zip(chunk_ids, probs):
+                self._span("predict", inflight.t_dispatch, t_done,
+                                 trace_id=tmap.get(rid), uri=rid)
                 try:
                     pairs.append(
                         (rid, {"value": self.postprocess(np.asarray(row))}))
                 except Exception as e:  # noqa: BLE001 — per-record isolation
-                    self._quarantine(rid, "postprocess", e)
-        n = self._flush_results(pairs)
+                    self._quarantine(rid, "postprocess", e,
+                                     trace_id=tmap.get(rid))
+        n = self._flush_results(pairs, tmap=tmap)
         now = time.monotonic()
         if pairs:
             self._stages["write"].record(now - t_done)
+            for rid, _ in pairs:
+                self._span("write", t_done, now,
+                                 trace_id=tmap.get(rid), uri=rid)
         if n and inflight.t_read is not None:
             self._e2e.record(now - inflight.t_read, n=n)
         self.total_records += n
+        self._m_records.inc(n)
         dt = max(now - inflight.t_dispatch, 1e-9)
         if self._tb is not None:
             self._tb.add_scalar("Serving Throughput", n / dt,
@@ -700,13 +825,14 @@ class ClusterServing:
         self.queue.trim(self.params.stream_max_len)
 
     def _predict_and_write(self, ids, tensors, scales=None,
-                           deadlines=None, t_read=None, t_ready=None) -> int:
+                           deadlines=None, traces=None, t_read=None,
+                           t_ready=None) -> int:
         """Synchronous predict+write for one staged group (serve_once and
         the write-stage fallbacks); the pipelined loop runs the same two
         stages on separate workers."""
         inflight = self._predict_stage(ids, tensors, scales=scales,
-                                       deadlines=deadlines, t_read=t_read,
-                                       t_ready=t_ready)
+                                       deadlines=deadlines, traces=traces,
+                                       t_read=t_read, t_ready=t_ready)
         if inflight is None:
             return 0
         return self._write_stage(inflight)
@@ -742,6 +868,7 @@ class ClusterServing:
         p = self.params
         self._stop.clear()
         self._draining.clear()
+        self._t_start = time.monotonic()
         try:
             # a prior drained shutdown closed admission; serving again means
             # taking traffic again
@@ -898,6 +1025,12 @@ class ClusterServing:
                             "error": f"{type(e).__name__}: {e}"}
         h = {"running": running,
              "draining": self._draining.is_set(),
+             # staleness/restart detection (PR 4): a monotonically
+             # increasing sequence lets orchestrators spot a frozen
+             # snapshot file; pid + uptime reset on a silent restart
+             "uptime_s": round(time.monotonic() - self._t_start, 3),
+             "pid": os.getpid(),
+             "snapshot_seq": next(self._snapshot_seq),
              "total_records": self.total_records,
              "dead_lettered": self.dead_lettered,
              "shed": self.shed,
@@ -936,10 +1069,10 @@ class ClusterServing:
         """Readiness probe document (`/readyz`)."""
         return self.health()["ready"]
 
-    def metrics(self) -> Dict:
-        """Flat JSON counters + the per-stage timing breakdown
-        (`/metrics`)."""
-        h = self.health()
+    @staticmethod
+    def metrics_from_health(h: Dict) -> Dict:
+        """The `/metrics` JSON document derived from a health() document —
+        shared with `manager metrics`, which only has the snapshot file."""
         e2e = h["stages"]["e2e"]
         return {"served": h["total_records"],
                 "quarantined": h["dead_lettered"],
@@ -951,6 +1084,23 @@ class ClusterServing:
                 "breaker_trips": h["breaker"]["trip_count"],
                 "stages": h["stages"],
                 "latency_ms": {"p50": e2e["p50_ms"], "p99": e2e["p99_ms"]}}
+
+    def metrics(self) -> Dict:
+        """Flat JSON counters + the per-stage timing breakdown (`/metrics`)
+        — byte-compatible with the PR 2/3 document; the Prometheus rendering
+        of the same registry lives on `prom_metrics()`."""
+        return self.metrics_from_health(self.health())
+
+    def prom_metrics(self) -> str:
+        """Prometheus text exposition v0.0.4 of this engine's registry
+        (`/metrics?format=prom`)."""
+        return self.registry.to_prometheus()
+
+    def export_trace(self, path: str) -> str:
+        """Dump the tracer's span ring as Chrome trace-event JSON (open in
+        Perfetto / chrome://tracing, or summarize with
+        `tools/trace_view.py`)."""
+        return self.tracer.export_chrome_trace(path)
 
     def shutdown(self, drain_s: Optional[float] = None):
         """Stop serving.  With ``drain_s`` (graceful drain, PR 2): close
@@ -983,5 +1133,11 @@ class ClusterServing:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        # deregister this engine's callback gauges: a stopped engine must
+        # not contribute stale samples to (or be kept alive by) a registry
+        # it shares with live engines; idempotent across repeat shutdowns
+        for gauge, fn in self._gauge_fns:
+            gauge.remove_function(fn)
+        self._gauge_fns = []
         if self._tb is not None:
             self._tb.flush()

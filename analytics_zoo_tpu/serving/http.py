@@ -20,6 +20,11 @@ existing health surface on three routes:
   ``breaker_trips``, plus (PR 3) ``stages`` — per-stage timing
   (read / preprocess / stage_wait / predict / write / e2e, each with
   count + p50/p99 ms) — and ``latency_ms`` (end-to-end p50/p99).
+  With ``?format=prom`` — or an ``Accept`` header asking for
+  ``text/plain`` and not JSON — the SAME registry renders as Prometheus
+  text exposition format v0.0.4 (PR 4), scrape-ready:
+  ``serving_stage_seconds_bucket{stage="predict",le="0.05"} ...``.  The
+  default JSON document is unchanged, so PR 2/3 consumers keep working.
 
 Zero dependencies: `ThreadingHTTPServer` on a daemon thread, started by
 ``ClusterServing.start()`` when ``ServingParams.http_port`` is set (0 picks
@@ -63,16 +68,44 @@ class HealthServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, status: int, text: str,
+                            content_type: str) -> None:
+                body = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _wants_prom(self, query: str) -> bool:
+                from urllib.parse import parse_qs
+                fmt = (parse_qs(query).get("format") or [None])[0]
+                if fmt is not None:
+                    return fmt == "prom"
+                # content negotiation: a scraper asking for text/plain (and
+                # not json) gets the exposition format; default stays JSON
+                accept = self.headers.get("Accept", "") or ""
+                return ("text/plain" in accept
+                        and "application/json" not in accept)
+
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                from urllib.parse import urlsplit
+                parts = urlsplit(self.path)
                 try:
-                    if self.path == "/healthz":
+                    if parts.path == "/healthz":
                         h = serving.health()
                         self._reply(200 if h.get("running") else 503, h)
-                    elif self.path == "/readyz":
+                    elif parts.path == "/readyz":
                         r = serving.ready()
                         self._reply(200 if r.get("ready") else 503, r)
-                    elif self.path == "/metrics":
-                        self._reply(200, serving.metrics())
+                    elif parts.path == "/metrics":
+                        if self._wants_prom(parts.query):
+                            from analytics_zoo_tpu.common.observability \
+                                import MetricsRegistry
+                            self._reply_text(200, serving.prom_metrics(),
+                                             MetricsRegistry.CONTENT_TYPE)
+                        else:
+                            self._reply(200, serving.metrics())
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except Exception as e:  # noqa: BLE001 — probe must answer

@@ -46,6 +46,11 @@ CLI (used by scripts/cluster-serving/*.sh):
         # dead-letter state from the daemon's <pidfile>.health.json snapshot
     python -m analytics_zoo_tpu.serving.manager replay [--filter SUBSTR]
         # re-enqueue quarantined records after a fix (dead-letter replay)
+    python -m analytics_zoo_tpu.serving.manager metrics [--prom]
+        # live metrics snapshot: GET the daemon's /metrics endpoint when
+        # params.http_port is configured (--prom asks for the Prometheus
+        # text exposition), else derive the same JSON document from the
+        # health.json snapshot
 """
 
 from __future__ import annotations
@@ -219,13 +224,16 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
-                             "replay"])
+                             "replay", "metrics"])
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
     ap.add_argument("--filter", default=None, metavar="SUBSTR",
                     help="replay only dead letters whose uri or error "
                          "contains SUBSTR")
+    ap.add_argument("--prom", action="store_true",
+                    help="metrics: print the Prometheus text exposition "
+                         "(requires params.http_port on the daemon)")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -249,6 +257,47 @@ def main(argv=None):
         except (OSError, ValueError):
             return None
 
+    if args.action == "metrics":
+        # live metrics snapshot (PR 4).  Preferred source: the daemon's own
+        # /metrics endpoint (exactly what a scraper sees, including
+        # ?format=prom); fallback: derive the JSON document from the
+        # health.json snapshot the daemon writes every second.
+        try:
+            params = serving_params(load_config(args.config))
+        except OSError:
+            params = ServingParams()       # no config: snapshot-only path
+        if params.http_port:
+            import urllib.request
+            url = (f"http://{params.http_host}:{params.http_port}/metrics"
+                   + ("?format=prom" if args.prom else ""))
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    body = resp.read().decode()
+                print(body if args.prom else json.dumps(json.loads(body)))
+                return 0
+            except Exception as e:  # noqa: BLE001 — daemon down/unreachable
+                print(json.dumps({"warning": f"probe endpoint {url} "
+                                             f"unreachable "
+                                             f"({type(e).__name__}: {e}); "
+                                             "falling back to the health "
+                                             "snapshot"}), file=sys.stderr)
+        if args.prom:
+            print(json.dumps({"error": "--prom needs a reachable "
+                                       "params.http_port probe endpoint"}),
+                  file=sys.stderr)
+            return 1
+        health = read_health()
+        if health is None:
+            print(json.dumps({"error": "no health snapshot (serving not "
+                                       "running, or not yet written)"}),
+                  file=sys.stderr)
+            return 1
+        pid = read_pid()
+        doc = ClusterServing.metrics_from_health(health)
+        if pid is None or not alive(pid):
+            doc["stale"] = True            # snapshot outlived its daemon
+        print(json.dumps(doc))
+        return 0
     if args.action == "replay":
         # dead-letter replay (ROADMAP open item): re-enqueue quarantined
         # records after a fix — works against the live daemon's backend

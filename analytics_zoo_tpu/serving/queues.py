@@ -167,9 +167,13 @@ class BaseQueue:
 
     # -- dead-letter side channel (PR 1 resilience) --------------------------
     def put_error(self, key: str, error: str,
-                  record: Optional[Dict] = None) -> None:
+                  record: Optional[Dict] = None,
+                  trace_id: Optional[str] = None) -> None:
         """Quarantine one poisoned record: write an error RESULT the client
-        can see (same key it is polling) and append a dead-letter entry."""
+        can see (same key it is polling) and append a dead-letter entry.
+        ``trace_id`` (PR 4, falls back to ``record["trace_id"]``) rides both
+        the error result and the dead-letter entry, so a quarantine is
+        correlatable with its trace spans from either side."""
         raise NotImplementedError
 
     def dead_letters(self) -> List[Dict]:
@@ -232,8 +236,10 @@ class BaseQueue:
                 # quarantine error, then stop with the partial report
                 if uri:
                     try:
-                        self.put_result(uri, {"error": entry.get(
-                            "error", "quarantined (replay pending)")})
+                        self.put_result(uri, _error_result(
+                            entry.get("error", "quarantined (replay "
+                                               "pending)"),
+                            record, entry.get("trace_id")))
                     except Exception:  # noqa: BLE001 — best-effort
                         pass
                 break
@@ -253,12 +259,24 @@ class BaseQueue:
         """Memory guard (ClusterServing.scala:134-140 XTRIM analog)."""
 
 
-def _dead_letter_entry(key: str, error: str,
-                       record: Optional[Dict]) -> Dict:
+def _dead_letter_entry(key: str, error: str, record: Optional[Dict],
+                       trace_id: Optional[str] = None) -> Dict:
     entry = {"uri": key, "error": str(error)}
     if record is not None:
         entry["record"] = record
+    tid = trace_id or (record or {}).get("trace_id")
+    if tid is not None:
+        entry["trace_id"] = tid
     return entry
+
+
+def _error_result(error: str, record: Optional[Dict],
+                  trace_id: Optional[str] = None) -> Dict:
+    out = {"error": str(error)}
+    tid = trace_id or (record or {}).get("trace_id")
+    if tid is not None:
+        out["trace_id"] = tid
+    return out
 
 
 class InProcQueue(BaseQueue):
@@ -325,10 +343,11 @@ class InProcQueue(BaseQueue):
         with self._lock:
             self._results.pop(key, None)
 
-    def put_error(self, key, error, record=None):
+    def put_error(self, key, error, record=None, trace_id=None):
         with self._lock:
-            self._results[key] = {"error": str(error)}
-            self._dead.append(_dead_letter_entry(key, error, record))
+            self._results[key] = _error_result(error, record, trace_id)
+            self._dead.append(_dead_letter_entry(key, error, record,
+                                                 trace_id))
 
     def dead_letters(self):
         with self._lock:
@@ -532,12 +551,12 @@ class FileQueue(BaseQueue):
         except FileNotFoundError:
             pass
 
-    def put_error(self, key, error, record=None):
-        self.put_result(key, {"error": str(error)})
+    def put_error(self, key, error, record=None, trace_id=None):
+        self.put_result(key, _error_result(error, record, trace_id))
         seq = f"{time.time_ns()}"
         tmp = os.path.join(self.dead_dir, f".{seq}-{key}.tmp")
         with open(tmp, "w") as f:
-            json.dump(_dead_letter_entry(key, error, record), f)
+            json.dump(_dead_letter_entry(key, error, record, trace_id), f)
         os.rename(tmp, os.path.join(self.dead_dir, f"{seq}-{key}.json"))
 
     def dead_letters(self):
@@ -767,11 +786,13 @@ class RedisQueue(BaseQueue):
     def delete_result(self, key):
         self.r.hdel(self.table, key)
 
-    def put_error(self, key, error, record=None):
-        self.r.hset(self.table, key, json.dumps({"error": str(error)}))
+    def put_error(self, key, error, record=None, trace_id=None):
+        self.r.hset(self.table, key,
+                    json.dumps(_error_result(error, record, trace_id)))
         self.r.xadd(self.dead_stream,
                     {"data": json.dumps(_dead_letter_entry(key, error,
-                                                           record))})
+                                                           record,
+                                                           trace_id))})
 
     def dead_letters(self):
         return [e for _, e in self._dead_letter_items()]

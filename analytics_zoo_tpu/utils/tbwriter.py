@@ -8,7 +8,11 @@ protobufs + TFRecord framing with masked CRC32C — no tensorflow dependency.
 Wire format per record: [length:uint64le][masked_crc32c(length):uint32le][payload]
 [masked_crc32c(payload):uint32le].  Event proto fields used: wall_time(1,double),
 step(2,int64), file_version(3,string), summary(5,message); Summary.value(1) with
-tag(1,string) and simple_value(2,float).
+tag(1,string), simple_value(2,float), and (PR 4) histo(5,HistogramProto) —
+min(1,double), max(2), num(3), sum(4), sum_squares(5), bucket_limit(6,packed
+double), bucket(7,packed double) — so observability-registry histograms (e.g.
+`fit_step_seconds`) mirror into TensorBoard's HISTOGRAMS tab, with
+`read_histograms` as the read-back path.
 """
 
 from __future__ import annotations
@@ -98,6 +102,55 @@ def encode_version_event(wall_time: float) -> bytes:
     return _pb_double(1, wall_time) + _pb_str(3, "brain.Event:2")
 
 
+def _pb_packed_doubles(field: int, vals) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _pb_bytes(field, payload)
+
+
+def histogram_summary(values, bucket_limits=None) -> Dict:
+    """Build a `Summary.histo`-style record from raw samples: min / max /
+    num / sum / sum_squares plus per-bucket counts against ``bucket_limits``
+    (ascending upper bounds; a final +Inf bound is appended when missing —
+    registry histograms pass their own bucket bounds so the TensorBoard
+    mirror matches the Prometheus exposition bucket-for-bucket)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("histogram_summary needs at least one sample")
+    if bucket_limits is None:
+        lo, hi = min(vals), max(vals)
+        if lo == hi:                       # degenerate: one bucket catches all
+            bucket_limits = [hi]
+        else:
+            span = (hi - lo) / 20.0
+            bucket_limits = [lo + span * (i + 1) for i in range(20)]
+    limits = sorted(float(b) for b in bucket_limits)
+    if not limits or limits[-1] != float("inf"):
+        limits.append(float("inf"))
+    counts = [0] * len(limits)
+    for v in vals:
+        for i, ub in enumerate(limits):
+            if v <= ub:
+                counts[i] += 1
+                break
+    return {"min": min(vals), "max": max(vals), "num": float(len(vals)),
+            "sum": sum(vals), "sum_squares": sum(v * v for v in vals),
+            "bucket_limit": limits, "bucket": [float(c) for c in counts]}
+
+
+def encode_histogram_event(tag: str, histo: Dict, step: int,
+                           wall_time: float) -> bytes:
+    """Event carrying one Summary.Value{tag, histo} (HistogramProto)."""
+    hp = (_pb_double(1, histo["min"]) + _pb_double(2, histo["max"])
+          + _pb_double(3, histo["num"]) + _pb_double(4, histo["sum"])
+          + _pb_double(5, histo["sum_squares"])
+          + _pb_packed_doubles(6, histo["bucket_limit"])
+          + _pb_packed_doubles(7, histo["bucket"]))
+    val = _pb_str(1, tag) + _pb_bytes(5, hp)
+    summary = _pb_bytes(1, val)
+    return (_pb_double(1, wall_time) + _pb_int64(2, step)
+            + _pb_bytes(5, summary))
+
+
 def _record(payload: bytes) -> bytes:
     header = struct.pack("<Q", len(payload))
     return (header + struct.pack("<I", _masked_crc(header)) + payload
@@ -119,6 +172,20 @@ class FileWriter:
 
     def add_scalar(self, tag: str, value: float, step: int):
         ev = encode_scalar_event(tag, value, step, time.time())
+        self._f.write(_record(ev))
+        if time.time() - self._last_flush > self.flush_secs:
+            self.flush()
+
+    def add_histogram(self, tag: str, values, step: int,
+                      bucket_limits=None):
+        """Write raw samples as one histogram summary record (PR 4): pass
+        the observability registry's bucket bounds via ``bucket_limits`` to
+        mirror a registry histogram exactly; empty ``values`` is a no-op."""
+        values = list(values)
+        if not values:
+            return
+        ev = encode_histogram_event(
+            tag, histogram_summary(values, bucket_limits), step, time.time())
         self._f.write(_record(ev))
         if time.time() - self._last_flush > self.flush_secs:
             self.flush()
@@ -203,4 +270,72 @@ def read_scalars(path_or_dir: str) -> Dict[str, List[Tuple[int, float]]]:
                         (value,) = struct.unpack("<f", v2)
                 if tag is not None and value is not None:
                     out.setdefault(tag, []).append((step, value))
+    return out
+
+
+def _resolve_events_file(path_or_dir: str) -> str:
+    if os.path.isdir(path_or_dir):
+        files = sorted(f for f in os.listdir(path_or_dir)
+                       if f.startswith("events.out.tfevents"))
+        if not files:
+            return ""
+        return os.path.join(path_or_dir, files[-1])
+    return path_or_dir
+
+
+def _unpack_doubles(buf: bytes) -> List[float]:
+    return [struct.unpack("<d", buf[i:i + 8])[0]
+            for i in range(0, len(buf) - 7, 8)]
+
+
+def read_histograms(path_or_dir: str) -> Dict[str, List[Tuple[int, Dict]]]:
+    """Read back {tag: [(step, histo), ...]} where histo carries min / max /
+    num / sum / sum_squares / bucket_limit / bucket — the read-back check
+    for `FileWriter.add_histogram` (registry-histogram mirroring)."""
+    path = _resolve_events_file(path_or_dir)
+    if not path:
+        return {}
+    out: Dict[str, List[Tuple[int, Dict]]] = {}
+    with open(path, "rb") as f:
+        data = f.read()
+    i = 0
+    while i + 12 <= len(data):
+        (ln,) = struct.unpack("<Q", data[i:i + 8])
+        payload = data[i + 12:i + 12 + ln]
+        i += 12 + ln + 4
+        step, summary = 0, None
+        for field, wire, v in _parse_fields(payload):
+            if field == 2 and wire == 0:
+                step = v
+            elif field == 5 and wire == 2:
+                summary = v
+        if summary is None:
+            continue
+        for field, wire, v in _parse_fields(summary):
+            if field != 1 or wire != 2:
+                continue
+            tag, histo_buf = None, None
+            for f2, w2, v2 in _parse_fields(v):
+                if f2 == 1 and w2 == 2:
+                    tag = v2.decode()
+                elif f2 == 5 and w2 == 2:
+                    histo_buf = v2
+            if tag is None or histo_buf is None:
+                continue
+            histo: Dict = {"bucket_limit": [], "bucket": []}
+            names = {1: "min", 2: "max", 3: "num", 4: "sum",
+                     5: "sum_squares"}
+            for f3, w3, v3 in _parse_fields(histo_buf):
+                if f3 in names and w3 == 1:
+                    (histo[names[f3]],) = struct.unpack("<d", v3)
+                elif f3 == 6 and w3 == 2:        # packed repeated double
+                    histo["bucket_limit"] = _unpack_doubles(v3)
+                elif f3 == 7 and w3 == 2:
+                    histo["bucket"] = _unpack_doubles(v3)
+                elif f3 == 6 and w3 == 1:        # unpacked fallback
+                    histo["bucket_limit"].append(
+                        struct.unpack("<d", v3)[0])
+                elif f3 == 7 and w3 == 1:
+                    histo["bucket"].append(struct.unpack("<d", v3)[0])
+            out.setdefault(tag, []).append((step, histo))
     return out

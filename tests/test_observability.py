@@ -1,0 +1,608 @@
+"""PR 4 unified telemetry: metrics registry + Prometheus exposition,
+per-record tracing through the serving pipeline, training-loop
+instrumentation, tbwriter histogram mirroring, and the trace_view tool.
+
+Covers the acceptance criteria:
+- golden-file Prometheus text exposition (label escaping, histogram
+  `_bucket`/`_sum`/`_count` lines) + a registry concurrency hammer;
+- an end-to-end serving round trip producing one span per pipeline stage
+  per record, a quarantined record's span carrying the error, exportable as
+  Chrome trace-event JSON and summarized by tools/trace_view.py;
+- `Estimator.fit` step-time/throughput metrics in the registry AND in the
+  tbwriter event files, verified by read-back.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.observability import (MetricsRegistry, Tracer,
+                                                    new_trace_id)
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.serving.client import Client, InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue
+
+pytestmark = pytest.mark.timeout(120)
+
+DIM = 16
+NCLS = 8
+STAGES = ("read", "preprocess", "stage_wait", "predict", "write")
+
+
+def _model():
+    m = Sequential()
+    m.add(Dense(NCLS, activation="softmax", input_shape=(DIM,)))
+    m.init_weights()
+    return InferenceModel().do_load_model(m, m._params, m._state)
+
+
+def _serving(q, model=None, registry=None, **params):
+    return ClusterServing(model if model is not None else _model(), q,
+                          registry=registry,
+                          params=ServingParams(batch_size=4, **params))
+
+
+# -- registry primitives -------------------------------------------------------
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x_total", "a counter")
+    c2 = reg.counter("x_total")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("k",))     # label-shape mismatch
+    with pytest.raises(ValueError):
+        c1.inc(-1)                                # counters only go up
+    g = reg.gauge("g")
+    g.set(3.0)
+    g.inc()
+    g.dec(0.5)
+    assert g.value == pytest.approx(3.5)
+    h = reg.histogram("h_seconds", buckets=(0.01, 0.1))
+    h.observe(0.05, n=4)
+    assert h.count == 4 and h.sum == pytest.approx(0.2)
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["p50_ms"] == pytest.approx(50.0)
+    with pytest.raises(ValueError):
+        h.labels(stage="read")          # unlabeled metric: kwargs rejected,
+    with pytest.raises(ValueError):     # not silently merged into () child
+        reg.histogram("lab_seconds", labels=("stage",)).labels(stge="read")
+    with pytest.raises(ValueError):     # explicit bucket mismatch refused —
+        reg.histogram("h_seconds", buckets=(1.0, 2.0))  # not silently merged
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", reservoir=16)
+    assert reg.histogram("h_seconds") is h  # omitting args = whatever exists
+
+
+def test_gauge_callback_providers_sum_and_remove():
+    """Callback gauges accumulate providers (two engines pooling one
+    registry both stay visible) and drop them on remove_function."""
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", fn=lambda: 3.0)
+    second = lambda: 4.0                                     # noqa: E731
+    assert reg.gauge("depth", fn=second) is g                # get-or-create
+    assert g.value == pytest.approx(7.0)                     # sum, no clobber
+    g.remove_function(second)
+    g.remove_function(second)                                # idempotent
+    assert g.value == pytest.approx(3.0)
+    # one dead provider (NaN / raising) must not blind the healthy one
+    g.add_function(lambda: float("nan"))
+    g.add_function(lambda: 1 / 0)
+    assert g.value == pytest.approx(3.0)
+    dead = reg.gauge("dead", fn=lambda: float("nan"))
+    assert dead.value != dead.value                          # all-dead: NaN
+    g.set(9.0)                                               # set clears fns
+    assert g.value == pytest.approx(9.0)
+
+
+def test_prometheus_exposition_golden():
+    """Exact rendered text: HELP/TYPE lines, label escaping (backslash,
+    quote, newline), histogram cumulative _bucket series + _sum/_count."""
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total", "Total requests", labels=("path",))
+    c.labels(path='/a"b\\c\nd').inc(3)
+    reg.gauge("queue_depth", "Records waiting").set(7)
+    h = reg.histogram("latency_seconds", "Request latency",
+                      buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)
+    h.observe(0.05, n=2)
+    h.observe(5.0)
+    golden = (
+        '# HELP requests_total Total requests\n'
+        '# TYPE requests_total counter\n'
+        'requests_total{path="/a\\"b\\\\c\\nd"} 3\n'
+        '# HELP queue_depth Records waiting\n'
+        '# TYPE queue_depth gauge\n'
+        'queue_depth 7\n'
+        '# HELP latency_seconds Request latency\n'
+        '# TYPE latency_seconds histogram\n'
+        'latency_seconds_bucket{le="0.01"} 1\n'
+        'latency_seconds_bucket{le="0.1"} 3\n'
+        'latency_seconds_bucket{le="1"} 3\n'
+        'latency_seconds_bucket{le="+Inf"} 4\n'
+        'latency_seconds_sum 5.105\n'
+        'latency_seconds_count 4\n')
+    assert reg.to_prometheus() == golden
+
+
+def test_registry_concurrency_hammer():
+    """8 threads hammering one counter + labeled histogram: no lost
+    updates, consistent bucket/count/sum state."""
+    reg = MetricsRegistry()
+    per_thread, nthreads = 1000, 8
+    barrier = threading.Barrier(nthreads)
+
+    def work(i):
+        barrier.wait()
+        c = reg.counter("hits_total")
+        h = reg.histogram("lat_seconds", labels=("worker",),
+                          buckets=(0.5, 1.5))
+        for j in range(per_thread):
+            c.inc()
+            h.labels(worker=str(i % 2)).observe(1.0)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = nthreads * per_thread
+    assert reg.counter("hits_total").value == total
+    h = reg.histogram("lat_seconds", labels=("worker",),
+                      buckets=(0.5, 1.5))
+    counts = sum(h.labels(worker=w).count for w in ("0", "1"))
+    assert counts == total
+    _, bucket_counts, s, n = h.labels(worker="0").state()
+    assert n == sum(bucket_counts) and s == pytest.approx(n * 1.0)
+
+
+# -- end-to-end trace propagation ----------------------------------------------
+
+def test_trace_propagation_end_to_end(ctx, tmp_path):
+    """Client-stamped trace_id flows the wire; every served record gets one
+    span per pipeline stage; a poisoned record's span carries the error; the
+    dump exports as Chrome trace JSON and trace_view summarizes it."""
+    q = InProcQueue()
+    serving = _serving(q)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(6)]
+    # recover per-record trace ids from the wire records before serving
+    trace_ids = {rid: rec["trace_id"] for rid, rec in list(q._stream)}
+    assert len(set(trace_ids.values())) == len(rids)
+    # one poisoned record: undecodable base64 quarantines at preprocess
+    bad_tid = new_trace_id()
+    q.xadd({"uri": "bad", "b64": "!!!not-base64!!!", "dtype": "<f4",
+            "trace_id": bad_tid})
+    serving.start()
+    try:
+        got = cout.query_many(rids + ["bad"], timeout_s=30)
+        assert all(r is not None for r in got.values())
+        assert OutputQueue.is_error(got["bad"])
+        # the quarantine error result carries the trace id (queue backends)
+        assert got["bad"].get("trace_id") == bad_tid
+    finally:
+        serving.shutdown()
+    tracer = serving.tracer
+    for rid in rids:
+        tid = trace_ids[rid]
+        stages = tracer.stages_for(tid)
+        for stage in STAGES:
+            assert stage in stages, (rid, stage, stages)
+        assert all("error" not in s for s in tracer.spans(tid))
+    bad_spans = tracer.spans(bad_tid)
+    assert any("error" in s and "preprocess" in s["stage"]
+               for s in bad_spans), bad_spans
+    # dead-letter entry correlates too
+    assert any(e.get("trace_id") == bad_tid for e in q.dead_letters())
+
+    # chrome export + offline summary
+    path = str(tmp_path / "trace.json")
+    serving.export_trace(path)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import trace_view
+    doc = trace_view.summarize(trace_view.load_events(path))
+    assert doc["traces"] >= len(rids)
+    assert set(STAGES) <= set(doc["stages"])
+    assert any(e["trace_id"] == bad_tid for e in doc["errors"])
+    assert doc["slowest"][0]["e2e_ms"] >= 0
+
+
+def test_trace_view_smoke_mode():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import trace_view
+    assert trace_view.main(["--smoke"]) == 0
+
+
+def test_trace_view_sums_duplicate_stage_spans():
+    """A shed record has BOTH a real read span and a zero-width 'read'
+    error span; the per-trace stage map must keep the real duration
+    (summed), not let the later zero-width span win."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import trace_view
+    events = [
+        {"ph": "X", "name": "read", "ts": 0.0, "dur": 4000.0,
+         "args": {"trace_id": "t1", "uri": "u1"}},
+        {"ph": "X", "name": "read", "ts": 4000.0, "dur": 0.0,
+         "args": {"trace_id": "t1", "uri": "u1",
+                  "error": "deadline-exceeded"}},
+    ]
+    doc = trace_view.summarize(events)
+    (rec,) = doc["slowest"]
+    assert rec["stages"]["read"] == pytest.approx(4.0)   # ms, not 0.0
+    assert rec["error"] == "deadline-exceeded"
+
+
+def test_input_queue_trace_id_is_per_thread(ctx):
+    """Two threads sharing one InputQueue: each reads back ITS OWN record's
+    trace_id, not whichever enqueue landed last."""
+    q = InProcQueue()
+    cin = InputQueue(q)
+    seen = {}
+
+    def work(tag):
+        cin.enqueue_tensor(tag, np.ones(DIM, np.float32))
+        time.sleep(0.05)                 # let the other thread overwrite...
+        seen[tag] = cin.last_trace_id    # ...a shared attribute, if any
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    by_uri = {rec["uri"]: rec["trace_id"]
+              for _rid, rec in q.read_batch(10, 0.1)}
+    assert seen["a"] == by_uri["a"] and seen["b"] == by_uri["b"]
+
+
+# -- serving registry metrics + Prometheus endpoint ----------------------------
+
+def test_engine_registry_counters_and_prom_text(ctx):
+    q = InProcQueue()
+    serving = _serving(q)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(8)]
+    q.xadd({"uri": "bad", "b64": "!!!not-base64!!!", "dtype": "<f4"})
+    serving.start()
+    try:
+        got = cout.query_many(rids + ["bad"], timeout_s=30)
+        assert all(r is not None for r in got.values())
+    finally:
+        serving.shutdown()
+    reg = serving.registry
+    assert reg.counter("serving_records_total").value == 8
+    assert reg.counter("serving_quarantined_total", labels=("stage",)) \
+        .labels(stage="preprocess").value == 1
+    stage_hist = reg.histogram("serving_stage_seconds", labels=("stage",))
+    for stage in STAGES:
+        assert stage_hist.labels(stage=stage).count > 0, stage
+    text = serving.prom_metrics()
+    assert "# TYPE serving_stage_seconds histogram" in text
+    assert 'serving_stage_seconds_bucket{stage="predict",le="+Inf"}' in text
+    assert "serving_records_total 8" in text
+    assert "serving_queue_depth 0" in text
+    # inference-model histograms ride the same engine registry
+    assert reg.get("inference_predict_seconds") is not None
+    # the JSON metrics document is unchanged (PR 2/3 consumers)
+    assert set(serving.metrics()) == {
+        "served", "quarantined", "shed", "restarts", "queue_depth",
+        "dead_letters", "breaker_trips", "stages", "latency_ms"}
+
+
+def test_pooled_registry_two_engines_gauges_aggregate(ctx):
+    """Two engines pooling one registry: serving_queue_depth reports the
+    SUM of both queues (not just the last-constructed engine), and a
+    shut-down engine deregisters its providers from the shared registry."""
+    reg = MetricsRegistry()
+    qa, qb = InProcQueue(), InProcQueue()
+    ea = _serving(qa, registry=reg)
+    eb = _serving(qb, registry=reg)
+    InputQueue(qa).enqueue_tensor("a0", np.ones(DIM, np.float32))
+    for i in range(2):
+        InputQueue(qb).enqueue_tensor(f"b{i}", np.ones(DIM, np.float32))
+    g = reg.gauge("serving_queue_depth")
+    assert g.value == pytest.approx(3.0)          # 1 (A) + 2 (B)
+    ea.shutdown()
+    assert g.value == pytest.approx(2.0)          # A deregistered, B live
+    eb.shutdown()
+    assert g.value == pytest.approx(0.0)          # back to the value store
+
+
+def test_model_rebinds_to_each_engine_registry(ctx):
+    """A model reused across engines (bench --sweep) follows the LIVE
+    engine's registry; a model constructed with an explicit registry stays
+    pinned."""
+    model = _model()
+    e1 = _serving(InProcQueue(), model=model)
+    assert model._obs_registry is e1.registry
+    e2 = _serving(InProcQueue(), model=model)
+    assert model._obs_registry is e2.registry     # re-bound, not stuck on e1
+    model.do_predict(np.ones((2, DIM), np.float32))
+    assert e2.registry.get("inference_predict_seconds") is not None
+    assert e1.registry.get("inference_predict_seconds") is None
+    e1.shutdown(), e2.shutdown()
+
+    pinned = MetricsRegistry()
+    net = Sequential()
+    net.add(Dense(NCLS, activation="softmax", input_shape=(DIM,)))
+    net.init_weights()
+    m2 = InferenceModel(registry=pinned).do_load_model(
+        net, net._params, net._state)
+    e3 = _serving(InProcQueue(), model=m2)
+    assert m2._obs_registry is pinned             # explicit registry wins
+    e3.shutdown()
+
+
+def test_tracing_off_keeps_metrics_hot_path_silent(ctx):
+    """params.tracing=False: no spans recorded, but stage histograms and
+    counters keep working."""
+    q = InProcQueue()
+    serving = _serving(q, tracing=False)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(4)]
+    serving.start()
+    try:
+        got = cout.query_many(rids, timeout_s=30)
+        assert all(r is not None for r in got.values())
+    finally:
+        serving.shutdown()
+    assert serving.tracer.spans() == []
+    assert serving.registry.counter("serving_records_total").value == 4
+    stage_hist = serving.registry.histogram("serving_stage_seconds",
+                                            labels=("stage",))
+    assert stage_hist.labels(stage="predict").count > 0
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_metrics_endpoint_prom_negotiation(ctx):
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rid = cin.enqueue_tensor("r0", np.ones(DIM, np.float32))
+    serving.start()
+    try:
+        assert cout.query(rid, timeout_s=30) is not None
+        url = serving._http.url
+        # default stays JSON (byte-compatible document)
+        code, ctype, body = _get(url + "/metrics")
+        assert code == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["served"] == 1
+        # ?format=prom renders the registry as text exposition v0.0.4
+        code, ctype, body = _get(url + "/metrics?format=prom")
+        assert code == 200
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+        assert "# TYPE serving_e2e_seconds histogram" in body
+        assert "serving_e2e_seconds_count 1" in body
+        # Accept-header negotiation reaches the same rendering
+        code, _, body2 = _get(url + "/metrics",
+                              headers={"Accept": "text/plain"})
+        assert code == 200 and "# TYPE serving_records_total counter" in body2
+        # health doc still serves every health() key (incl. the new ones)
+        code, _, body = _get(url + "/healthz")
+        h = json.loads(body)
+        assert {"uptime_s", "pid", "snapshot_seq"} <= set(h)
+    finally:
+        serving.shutdown()
+
+
+def test_health_uptime_pid_snapshot_seq(ctx):
+    q = InProcQueue()
+    serving = _serving(q)
+    h1 = serving.health()
+    h2 = serving.health()
+    assert h1["pid"] == os.getpid()
+    assert h1["uptime_s"] >= 0
+    assert h2["snapshot_seq"] == h1["snapshot_seq"] + 1
+
+
+# -- client deadline warning ---------------------------------------------------
+
+def test_client_deadline_expiry_logs_structured_warning(caplog):
+    q = InProcQueue()
+    client = Client(q)
+    rid = client.enqueue_tensor("r0", np.ones(DIM, np.float32),
+                                timeout_s=0.01)
+    tid = client.input.last_trace_id
+    assert tid is not None
+    with caplog.at_level(logging.WARNING,
+                         logger="analytics_zoo_tpu.serving.client"):
+        res = client.query(rid, timeout_s=0.05)
+    assert OutputQueue.is_deadline_exceeded(res)
+    assert res.get("trace_id") == tid
+    msgs = [r.getMessage() for r in caplog.records
+            if "deadline expired" in r.getMessage()]
+    assert msgs, caplog.records
+    assert f"trace_id={tid}" in msgs[0]
+    assert "budget_s=0.010" in msgs[0]
+
+
+# -- tbwriter histogram mirroring ----------------------------------------------
+
+def test_tbwriter_histogram_roundtrip(tmp_path):
+    from analytics_zoo_tpu.utils.tbwriter import (FileWriter,
+                                                  read_histograms)
+    w = FileWriter(str(tmp_path))
+    vals = [0.001, 0.004, 0.04, 0.04, 2.0]
+    w.add_histogram("StepTime_s", vals, step=3,
+                    bucket_limits=(0.01, 0.1, 1.0))
+    w.add_histogram("StepTime_s", [0.5], step=4,
+                    bucket_limits=(0.01, 0.1, 1.0))
+    w.close()
+    histos = read_histograms(str(tmp_path))
+    assert set(histos) == {"StepTime_s"}
+    (s3, h3), (s4, h4) = histos["StepTime_s"]
+    assert (s3, s4) == (3, 4)
+    assert h3["num"] == 5 and h3["min"] == 0.001 and h3["max"] == 2.0
+    assert h3["sum"] == pytest.approx(sum(vals))
+    assert h3["sum_squares"] == pytest.approx(sum(v * v for v in vals))
+    assert h3["bucket_limit"][:3] == [0.01, 0.1, 1.0]
+    assert h3["bucket_limit"][3] == float("inf")
+    assert h3["bucket"] == [2.0, 2.0, 0.0, 1.0]
+    assert h4["bucket"] == [0.0, 0.0, 1.0, 0.0]
+
+
+# -- training-loop instrumentation ---------------------------------------------
+
+def test_estimator_fit_metrics_registry_and_tb(ctx, tmp_path):
+    from analytics_zoo_tpu.estimator.estimator import Estimator
+    from analytics_zoo_tpu.utils.tbwriter import (read_histograms,
+                                                  read_scalars)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, DIM)).astype(np.float32)
+    y = np.eye(NCLS, dtype=np.float32)[rng.integers(0, NCLS, 64)]
+    model = Sequential()
+    model.add(Dense(NCLS, activation="softmax", input_shape=(DIM,)))
+    reg = MetricsRegistry()
+    est = Estimator(model, optimizer="sgd", loss="categorical_crossentropy",
+                    registry=reg)
+    est.set_tensorboard(str(tmp_path), "obs")
+    est.fit(x, y, batch_size=16, epochs=2, verbose=False, log_every=1)
+
+    # registry: step-time histogram + counters + gauges, all in `reg`
+    steps = reg.counter("fit_steps_total").value
+    assert steps == 8                       # 4 steps/epoch x 2
+    assert reg.counter("fit_samples_total").value == pytest.approx(128)
+    h = reg.histogram("fit_step_seconds")
+    assert h.count == 8
+    assert reg.gauge("fit_samples_per_second").value > 0
+    assert reg.gauge("fit_loss").value == reg.gauge("fit_loss").value  # set
+
+    # fit summary snapshot API
+    summary = est.fit_summary()
+    assert summary["steps"] == 8
+    assert summary["step_time"]["count"] == 8
+    assert summary["step_time"]["p50_ms"] is not None
+    assert summary["samples_per_second"] > 0
+
+    # tbwriter mirror, verified by read-back
+    train_dir = os.path.join(str(tmp_path), "obs", "train")
+    scalars = read_scalars(train_dir)
+    assert "Loss" in scalars and "Throughput" in scalars
+    assert "StepTime_ms_mean" in scalars
+    histos = read_histograms(train_dir)
+    assert "StepTime_s" in histos
+    step, hd = histos["StepTime_s"][-1]
+    assert hd["num"] == 8                   # reservoir holds both epochs
+    assert hd["sum"] > 0
+    # mirrored bucket bounds match the registry histogram's
+    assert hd["bucket_limit"][:-1] == list(h.buckets)
+
+
+# -- bench trajectory document -------------------------------------------------
+
+def test_serving_bench_json_document(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import serving_bench
+    out_path = str(tmp_path / "bench.json")
+    serving_bench.main(["--smoke", "--n", "32", "--compute", "f32",
+                        "--json", out_path])
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert doc["bench"] == "serving_bench"
+    assert doc["config"]["smoke"] is True
+    (run,) = doc["results"]
+    assert run["records"] == 32 and run["errors"] == 0
+    assert run["stages"]["e2e"]["count"] == 32
+    assert run["wall_records_per_sec"] > 0
+
+
+# -- manager metrics CLI -------------------------------------------------------
+
+def test_manager_metrics_cli_from_health_snapshot(ctx, tmp_path, capsys):
+    """`manager metrics` without a probe endpoint derives the /metrics JSON
+    document from the <pidfile>.health.json snapshot (and flags staleness
+    when the recorded daemon pid is gone)."""
+    from analytics_zoo_tpu.serving import manager
+    q = InProcQueue()
+    serving = _serving(q)
+    cin, cout = InputQueue(q), OutputQueue(q)
+    rid = cin.enqueue_tensor("r0", np.ones(DIM, np.float32))
+    serving.start()
+    try:
+        assert cout.query(rid, timeout_s=30) is not None
+    finally:
+        serving.shutdown()
+    pidfile = str(tmp_path / "cs.pid")
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))          # "daemon" alive: our own pid
+    with open(pidfile + ".health.json", "w") as f:
+        json.dump(serving.health(), f)
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("params:\n  batch_size: 4\n")
+    rc = manager.main(["metrics", "-c", str(cfg), "--pidfile", pidfile])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["served"] == 1
+    assert doc["stages"]["e2e"]["count"] == 1
+    assert "stale" not in doc
+    # dead pid: same document, flagged stale
+    with open(pidfile, "w") as f:
+        f.write("999999999")
+    rc = manager.main(["metrics", "-c", str(cfg), "--pidfile", pidfile])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out.strip())["stale"] is True
+    # --prom needs a live probe endpoint
+    rc = manager.main(["metrics", "-c", str(cfg), "--pidfile", pidfile,
+                       "--prom"])
+    assert rc == 1
+
+
+def test_manager_metrics_cli_over_http(ctx, tmp_path, capsys):
+    """With params.http_port configured, `manager metrics` GETs the live
+    /metrics endpoint — including the Prometheus rendering via --prom."""
+    from analytics_zoo_tpu.serving import manager
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    serving.start()
+    try:
+        port = serving._http.port
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(f"params:\n  http_port: {port}\n")
+        rc = manager.main(["metrics", "-c", str(cfg),
+                           "--pidfile", str(tmp_path / "cs.pid")])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        assert set(doc) >= {"served", "stages", "latency_ms"}
+        rc = manager.main(["metrics", "-c", str(cfg), "--prom",
+                           "--pidfile", str(tmp_path / "cs.pid")])
+        assert rc == 0
+        assert "# TYPE serving_records_total counter" \
+            in capsys.readouterr().out
+    finally:
+        serving.shutdown()
+
+
+# -- FileQueue trace correlation (cross-process backend) -----------------------
+
+def test_file_queue_put_error_carries_trace(tmp_path):
+    q = FileQueue(str(tmp_path / "q"))
+    q.put_error("r1", "predict: boom", record={"uri": "r1", "data": [1.0],
+                                               "trace_id": "abc123"})
+    res = q.get_result("r1")
+    assert res["error"].startswith("predict") and res["trace_id"] == "abc123"
+    (entry,) = q.dead_letters()
+    assert entry["trace_id"] == "abc123"
+    # explicit trace_id kwarg wins over the record's
+    q.put_error("r2", "predict: boom", trace_id="xyz")
+    assert q.get_result("r2")["trace_id"] == "xyz"

@@ -15,6 +15,9 @@ Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
          [--pre-workers N] [--inflight K]
      python tools/serving_bench.py --sweep 16,64,256   # batching sweep
      python tools/serving_bench.py --smoke             # tier-1 smoke check
+     python tools/serving_bench.py --json results.json # machine-readable
+         # results document (config + per-run throughput/stage breakdown)
+         # so the serving perf trajectory is trackable across PRs
 """
 
 from __future__ import annotations
@@ -189,6 +192,10 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 smoke: tiny MLP workload, asserts the "
                          "pipeline completes with stage metrics populated")
+    ap.add_argument("--json", default=None, metavar="PATH", dest="json_path",
+                    help="also write a machine-readable results document "
+                         "(config + results list) to PATH, for tracking "
+                         "the perf trajectory across PRs")
     ap.add_argument("--compute", choices=("bf16", "f32"), default="bf16",
                     help="model compute dtype.  bf16 is the TPU protocol; "
                          "on CPU-only hosts XLA EMULATES bf16 convs (~1 s "
@@ -214,10 +221,27 @@ def main(argv=None):
         args.batch = min(args.batch, 8)
     im = _build_model(args)
 
+    def _write_json(results):
+        """The trackable results document: one file per bench invocation,
+        config + results, so BENCH-style trajectory tooling can diff runs
+        across PRs without re-parsing stdout."""
+        if not args.json_path:
+            return
+        doc = {"bench": "serving_bench",
+               "ts": time.time(),
+               "config": {k: v for k, v in vars(args).items()
+                          if k != "json_path"},
+               "results": results}
+        tmp = args.json_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.json_path)
+
     if args.sweep:
         outs = [_run_once(im, args, int(b))
                 for b in args.sweep.split(",") if b.strip()]
         print(json.dumps(outs, indent=1))
+        _write_json(outs)
         for out in outs:
             assert out["records"] == args.n, \
                 f"lost records: {out['records']}/{args.n}"
@@ -225,6 +249,7 @@ def main(argv=None):
 
     out = _run_once(im, args, args.batch)
     print(json.dumps(out))
+    _write_json([out])
     assert out["records"] == args.n, \
         f"lost records: {out['records']}/{args.n}"
     if args.smoke:

@@ -1,0 +1,206 @@
+"""Summarize a serving Chrome trace-event dump (PR 4 observability).
+
+`ClusterServing.export_trace(path)` (or `Tracer.export_chrome_trace`) writes
+the per-record pipeline spans — read / preprocess / stage_wait / predict /
+write, one span per stage per record — as Chrome trace-event JSON.  Perfetto
+and chrome://tracing render it; this tool answers the operational questions
+offline, from the same file:
+
+- **per-stage breakdown** — count / mean / p50 / p99 ms per stage, so the
+  bottleneck stage is read straight off the dump;
+- **slowest records** — per trace_id end-to-end wall time (first span start
+  to last span end) with its per-stage split and any error, so THE slow or
+  poisoned record is identifiable, not just the aggregate;
+- **gap analysis** — untracked time between consecutive spans of one record
+  (queue residency between stages, scheduler stalls): mean/max gap and the
+  records with the largest gaps;
+- **errors** — every span carrying an error (quarantined / shed records),
+  grouped by stage.
+
+Run: python tools/trace_view.py trace.json [--top 5] [--json]
+     python tools/trace_view.py --smoke          # self-test (tier-1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from analytics_zoo_tpu.common.observability import _percentile  # noqa: E402
+
+
+def _dist(vals_ms):
+    vals = sorted(vals_ms)
+    return {"count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_percentile(vals, 50), 3),
+            "p99_ms": round(_percentile(vals, 99), 3)}
+
+
+def _stage_sums(spans):
+    agg = {}
+    for e in spans:
+        agg[e["name"]] = agg.get(e["name"], 0.0) + float(e.get("dur", 0.0))
+    return {name: round(d / 1e3, 3) for name, d in agg.items()}
+
+
+def load_events(path: str):
+    """Complete ('X') events from a Chrome trace file ({"traceEvents": []}
+    document or a bare event list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def summarize(events, top: int = 5):
+    """The analysis document: per-stage distributions, slowest traces,
+    gap analysis, and error spans."""
+    if not events:
+        return {"spans": 0, "traces": 0, "stages": {}, "slowest": [],
+                "gaps": None, "errors": []}
+    stages = {}
+    traces = {}
+    errors = []
+    for e in events:
+        args = e.get("args") or {}
+        tid = args.get("trace_id") or f"untraced-{id(e)}"
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        stages.setdefault(e["name"], []).append(dur_ms)
+        traces.setdefault(tid, []).append(e)
+        if args.get("error"):
+            errors.append({"trace_id": args.get("trace_id"),
+                           "uri": args.get("uri"),
+                           "stage": e["name"],
+                           "error": args["error"]})
+    per_trace = []
+    gap_stats = []
+    for tid, spans in traces.items():
+        spans = sorted(spans, key=lambda e: float(e["ts"]))
+        t0 = float(spans[0]["ts"])
+        t1 = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in spans)
+        gaps = []
+        for prev, nxt in zip(spans, spans[1:]):
+            gap = float(nxt["ts"]) - (float(prev["ts"])
+                                      + float(prev.get("dur", 0.0)))
+            if gap > 0:
+                gaps.append(gap / 1e3)
+        gap_ms = sum(gaps)
+        gap_stats.append(gap_ms)
+        per_trace.append({
+            "trace_id": tid,
+            "uri": (spans[0].get("args") or {}).get("uri"),
+            "e2e_ms": round((t1 - t0) / 1e3, 3),
+            "untracked_gap_ms": round(gap_ms, 3),
+            # SUM per stage: a shed/quarantined record carries a zero-width
+            # error span with the same stage name as its real timing span —
+            # last-one-wins would report read=0.0 for exactly the records
+            # being diagnosed
+            "stages": _stage_sums(spans),
+            "error": next((e["args"].get("error") for e in spans
+                           if (e.get("args") or {}).get("error")), None)})
+    per_trace.sort(key=lambda t: -t["e2e_ms"])
+    by_gap = sorted(per_trace, key=lambda t: -t["untracked_gap_ms"])
+    return {
+        "spans": len(events),
+        "traces": len(traces),
+        "stages": {name: _dist(vals) for name, vals in sorted(stages.items())},
+        "slowest": per_trace[:top],
+        "gaps": {**_dist(gap_stats),
+                 "top": [{"trace_id": t["trace_id"], "uri": t["uri"],
+                          "untracked_gap_ms": t["untracked_gap_ms"]}
+                         for t in by_gap[:top]]},
+        "errors": errors,
+    }
+
+
+def _print_human(doc):
+    print(f"{doc['spans']} spans over {doc['traces']} traces")
+    print("\nper-stage breakdown:")
+    for name, d in doc["stages"].items():
+        print(f"  {name:<12} n={d['count']:<6} mean={d['mean_ms']:>9.3f}ms "
+              f"p50={d['p50_ms']:>9.3f}ms p99={d['p99_ms']:>9.3f}ms")
+    print("\nslowest records (end-to-end):")
+    for t in doc["slowest"]:
+        stages = " ".join(f"{k}={v:.2f}" for k, v in t["stages"].items())
+        err = f"  ERROR: {t['error']}" if t["error"] else ""
+        print(f"  {t['e2e_ms']:>9.3f}ms  uri={t['uri']} "
+              f"trace={t['trace_id']}  [{stages}]{err}")
+    if doc["gaps"]:
+        g = doc["gaps"]
+        print(f"\nuntracked gaps (queue residency between stages): "
+              f"mean={g['mean_ms']:.3f}ms p99={g['p99_ms']:.3f}ms")
+    if doc["errors"]:
+        print(f"\n{len(doc['errors'])} error span(s):")
+        for e in doc["errors"]:
+            print(f"  [{e['stage']}] uri={e['uri']} trace={e['trace_id']}: "
+                  f"{e['error']}")
+
+
+def _smoke() -> int:
+    """Self-test: synthesize a trace through the real Tracer, export it,
+    summarize the export, and assert the document's shape — the tier-1
+    guard that the exporter and this viewer stay in sync."""
+    from analytics_zoo_tpu.common.observability import Tracer
+    tracer = Tracer()
+    stages = ("read", "preprocess", "stage_wait", "predict", "write")
+    t = 0.0
+    for i in range(4):
+        tid = Tracer.new_trace_id()
+        t0 = t
+        for j, stage in enumerate(stages):
+            tracer.span(stage, t0 + j * 0.002, t0 + j * 0.002 + 0.001,
+                        trace_id=tid, uri=f"img-{i}")
+        t += 0.010
+    bad = Tracer.new_trace_id()
+    tracer.span("preprocess", t, t, trace_id=bad, uri="img-bad",
+                error="preprocess: ValueError: bad pixel")
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "trace.json")
+        tracer.export_chrome_trace(path)
+        doc = summarize(load_events(path), top=3)
+    assert doc["traces"] == 5, doc["traces"]
+    assert set(doc["stages"]) == set(stages), doc["stages"]
+    for d in doc["stages"].values():
+        assert d["p50_ms"] is not None and d["p99_ms"] >= 0
+    assert len(doc["errors"]) == 1 and doc["errors"][0]["uri"] == "img-bad"
+    assert doc["slowest"] and doc["slowest"][0]["e2e_ms"] > 0
+    assert doc["gaps"]["mean_ms"] >= 0
+    print(json.dumps({"smoke": "ok", "spans": doc["spans"],
+                      "traces": doc["traces"]}))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a serving Chrome trace-event dump")
+    ap.add_argument("trace", nargs="?", help="trace.json path "
+                    "(ClusterServing.export_trace output)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many slowest records / largest gaps to list")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full analysis as JSON")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test on a synthetic trace (tier-1)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return _smoke()
+    if not args.trace:
+        ap.error("pass a trace.json (or --smoke)")
+    doc = summarize(load_events(args.trace), top=args.top)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        _print_human(doc)
+    return doc
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.exit(rc if isinstance(rc, int) else 0)
