@@ -98,7 +98,7 @@ class _Metric:
     def _make_child(self):
         raise NotImplementedError
 
-    def labels(self, *values, **kv):
+    def _resolve_key(self, values, kv) -> Tuple[str, ...]:
         if kv:
             if values:
                 raise ValueError("pass label values positionally OR by name")
@@ -117,12 +117,24 @@ class _Metric:
             raise ValueError(
                 f"{self.name}: got {len(values)} label values, "
                 f"expected {len(self.labelnames)}")
-        key = tuple(str(v) for v in values)
+        return tuple(str(v) for v in values)
+
+    def labels(self, *values, **kv):
+        key = self._resolve_key(values, kv)
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = self._make_child()
             return child
+
+    def remove(self, *values, **kv) -> None:
+        """Drop one labeled child series entirely (PR 5 scale-down): a
+        removed replica's per-replica series must DISAPPEAR from the
+        exposition and snapshots, not linger with a stale or zero value.
+        No-op when the child was never created."""
+        key = self._resolve_key(values, kv)
+        with self._lock:
+            self._children.pop(key, None)
 
     def _default(self):
         if self.labelnames:

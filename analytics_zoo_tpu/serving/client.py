@@ -15,6 +15,16 @@ budgets by the skew) — keep budgets comfortably above the expected skew, or
 run producer and engine on the same host for exact semantics.  `xadd` may raise `QueueFull`/`QueueClosed`
 (admission control / graceful drain) — a typed rejection at enqueue time
 instead of unbounded queue growth.
+
+Horizontal replicas (PR 5): delivery is AT-LEAST-ONCE server-side — a
+record claimed by a replica that crashes is reclaimed and re-served by a
+survivor — but the result table stays exactly-one-result per uri (writes
+are idempotent per key and redeliveries that already have a result are
+suppressed), so nothing changes in how a client polls.  A result recovered
+through failover carries ``"deliveries": n >= 2``
+(`OutputQueue.deliveries`), and because results are keyed by uri, a
+producer that re-enqueues the SAME uri after its own crash is idempotent
+end to end.
 """
 
 from __future__ import annotations
@@ -197,6 +207,16 @@ class OutputQueue:
         client-side)."""
         return (OutputQueue.is_error(result)
                 and str(result["error"]).startswith("deadline-exceeded"))
+
+    @staticmethod
+    def deliveries(result: Optional[Dict]) -> int:
+        """How many times the record was delivered to a replica before this
+        result was produced (PR 5 at-least-once).  1 = normal first
+        delivery; >= 2 = the original replica died mid-flight and a
+        survivor reclaimed and re-served it; 0 = no result yet."""
+        if not isinstance(result, dict):
+            return 0
+        return int(result.get("deliveries", 1))
 
     def dead_letters(self) -> List[Dict]:
         """Quarantined records (uri + error + offending record when small)."""

@@ -56,6 +56,24 @@ exposition via `/metrics?format=prom` (the JSON document is unchanged).  A
 so a single slow or poisoned record is diagnosable by trace_id
 (`ClusterServing.export_trace()` dumps Chrome trace-event JSON that
 `tools/trace_view.py` summarizes).
+
+Horizontal replicas (PR 5): the engine is now one of N crash-tolerant
+replicas over a shared queue.  Reads CLAIM records under a lease instead of
+destroying them; the claim is released (`queue.ack`) only after the record's
+result — value, quarantine error, or deadline-shed marker — is written, so
+a SIGKILLed replica's in-flight records sit orphaned in the queue's pending
+store instead of vanishing.  A periodic RECLAIM sweep
+(`params.lease_s` / `params.reclaim_interval_s`) re-claims entries idle past
+the lease and feeds them back through the normal pipeline: `trace_id` and
+`deadline_ns` ride inside the record, so redelivered records shed at the
+deadline gates and correlate in traces exactly like first deliveries.
+Redelivered records that ALREADY have a result (the dead replica wrote it
+but died before acking) are suppressed — acked without a second predict —
+keeping the client contract at exactly one result per record on top of
+at-least-once delivery.  Each engine carries a `replica_id` (health doc,
+`X-Replica-Id` probe header, `serving_heartbeat_age_seconds{replica=}`
+gauge); `serving_reclaimed_total{backend=}` and
+`serving_duplicate_results_total` land in the same registry.
 """
 
 from __future__ import annotations
@@ -244,7 +262,10 @@ class ServingParams:
                  preprocess_workers: int = 1,
                  inflight_batches: int = 2,
                  trim_interval_s: float = 5.0,
-                 tracing: bool = True):
+                 tracing: bool = True,
+                 replica_id: Optional[str] = None,
+                 lease_s: float = 30.0,
+                 reclaim_interval_s: Optional[float] = None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -286,6 +307,14 @@ class ServingParams:
         # path cost, so latency-critical deployments can switch it off
         # (metrics histograms stay on; only traces go dark)
         self.tracing = bool(tracing)
+        # horizontal replicas (PR 5): stable identity for this engine (None
+        # = derived from pid), how long a claimed record may sit idle before
+        # another replica may reclaim it (must exceed the worst-case single-
+        # record service time; <= 0 disables reclaiming), and how often the
+        # reclaim sweep runs (None = lease_s / 2)
+        self.replica_id = replica_id
+        self.lease_s = lease_s
+        self.reclaim_interval_s = reclaim_interval_s
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -318,7 +347,12 @@ class ServingParams:
             preprocess_workers=int(p.get("preprocess_workers", 1)),
             inflight_batches=int(p.get("inflight_batches", 2)),
             trim_interval_s=float(p.get("trim_interval_s", 5.0)),
-            tracing=bool(p.get("tracing", True)))
+            tracing=bool(p.get("tracing", True)),
+            replica_id=(None if p.get("replica_id") is None
+                        else str(p["replica_id"])),
+            lease_s=float(p.get("lease_s", 30.0)),
+            reclaim_interval_s=(None if p.get("reclaim_interval_s") is None
+                                else float(p["reclaim_interval_s"])))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -348,6 +382,24 @@ class ClusterServing:
         self.total_records = 0
         self.dead_lettered = 0
         self.shed = 0                        # deadline-exceeded rejections
+        # horizontal replicas (PR 5): identity + reclaim/redelivery state
+        self.replica_id = self.params.replica_id or \
+            f"replica-{os.getpid()}-{new_trace_id()[:6]}"
+        self.reclaimed = 0                   # orphans re-claimed by us
+        self.duplicates = 0                  # redeliveries suppressed
+        self._last_reclaim = 0.0             # monotonic; 0 = sweep at start
+        self._redelivered: Dict[str, int] = {}   # rid -> delivery count
+        # rid -> monotonic claim ts for records currently in OUR pipeline:
+        # the reclaim sweep must not treat its own slow in-flight work (a
+        # cold jit compile, a long batch) as another replica's orphans —
+        # self-reclaim would double-serve them.  Entries clear on ack.
+        self._inflight: Dict[str, float] = {}
+        self._hb_ts = time.monotonic()       # read-loop heartbeat stamp
+        # the queue handle's claims are made under our replica identity
+        try:
+            self.queue.consumer = self.replica_id
+        except Exception:  # noqa: BLE001 — exotic custom backend
+            pass
         self._http = None                    # HealthServer when http_port set
         # unified telemetry (PR 4): per-ENGINE registry by default so
         # counters and stage percentiles stay attributable when several
@@ -399,6 +451,24 @@ class ClusterServing:
             labels=("stage",))
         self._m_shed = reg.counter(
             "serving_shed_total", "Deadline-exceeded records shed")
+        # replica telemetry (PR 5), materialized at zero so the series are
+        # scrapeable from day one, not only after the first failover
+        self._m_reclaimed = reg.counter(
+            "serving_reclaimed_total",
+            "Orphaned records re-claimed from dead replicas, by backend",
+            labels=("backend",)).labels(backend=type(queue).__name__)
+        self._m_reclaimed.inc(0)
+        self._m_duplicates = reg.counter(
+            "serving_duplicate_results_total",
+            "Redelivered records suppressed because a result already "
+            "existed")
+        self._m_duplicates.inc(0)
+        self._hb_gauge = reg.gauge(
+            "serving_heartbeat_age_seconds",
+            "Seconds since this replica's read loop last made progress",
+            labels=("replica",))
+        self._hb_gauge.labels(replica=self.replica_id).set_function(
+            self._heartbeat_age)
         # callback gauges are registered additively (engines pooling into
         # one registry each contribute to the sum) and deregistered on
         # shutdown so a stopped engine neither skews the scrape nor stays
@@ -445,6 +515,115 @@ class ClusterServing:
             for s in (self._pre_sup, self._predict_sup, self._write_sup)
             if s is not None))
 
+    def _heartbeat_age(self) -> float:
+        return time.monotonic() - self._hb_ts
+
+    # -- lease lifecycle (PR 5 horizontal replicas) --------------------------
+    def _ack(self, rids: List[str]) -> None:
+        """Release the claim on fully-handled records (result/quarantine/
+        shed marker written).  A failed ack is NOT an error path: the
+        records stay pending, some replica reclaims them after the lease,
+        and duplicate suppression keeps the result set exact."""
+        if not rids:
+            return
+        for rid in rids:
+            self._inflight.pop(rid, None)
+        try:
+            self.queue.ack(list(rids))
+        except Exception as e:  # noqa: BLE001 — backend down mid-ack
+            logger.warning(
+                "serving: ack failed for %d record(s) (%s: %s); they will "
+                "be redelivered after the lease", len(rids),
+                type(e).__name__, e)
+
+    def _maybe_reclaim(self) -> List[Tuple[str, Dict]]:
+        """Periodic reclaim sweep: re-claim records whose lease expired on
+        a dead (or wedged) replica and feed the survivors into the normal
+        pipeline.  Redelivered records that already HAVE a result — the
+        previous owner wrote it but died before acking — are suppressed:
+        acked here, counted, never re-predicted."""
+        p = self.params
+        if p.lease_s is None or p.lease_s <= 0:
+            return []
+        interval = p.reclaim_interval_s if p.reclaim_interval_s is not None \
+            else max(p.lease_s / 2.0, 0.05)
+        now = time.monotonic()
+        if now - self._last_reclaim < interval:
+            return []
+        self._last_reclaim = now
+        try:
+            entries = self.queue.reclaim(
+                p.lease_s, max_items=p.max_batch or p.batch_size)
+        except Exception as e:  # noqa: BLE001 — backend down: next sweep
+            logger.warning("serving: reclaim sweep failed (%s: %s)",
+                           type(e).__name__, e)
+            return []
+        if not entries:
+            return []
+        # self-reclaim guard: records currently in OUR pipeline (a cold jit
+        # compile, a long batch) can outlive the lease too — re-serving
+        # them here would double-predict our own in-flight work.  The
+        # queue-side reclaim already refreshed their lease under our
+        # consumer name, which is exactly a lease extension; just don't
+        # feed them back in.  Entries older than the stale bound are
+        # assumed abandoned (a worker crashed mid-pipeline and the
+        # supervisor restarted it) and become reclaimable again.
+        stale_s = max(p.lease_s * 10.0, p.lease_s + 60.0)
+        for rid, ts in list(self._inflight.items()):
+            if now - ts > stale_s:
+                self._inflight.pop(rid, None)
+        own = [e for e in entries if e[0] in self._inflight]
+        entries = [e for e in entries if e[0] not in self._inflight]
+        if own:
+            logger.debug(
+                "serving: replica %s lease-extended %d of its own "
+                "in-flight record(s) instead of self-reclaiming",
+                self.replica_id, len(own))
+        if not entries:
+            return []
+        self.reclaimed += len(entries)
+        self._m_reclaimed.inc(len(entries))
+        try:
+            existing = self.queue.get_results(
+                [rid for rid, _, _ in entries])
+        except Exception:  # noqa: BLE001 — store down: skip suppression,
+            existing = {}  # idempotent writes keep the result set exact
+        out: List[Tuple[str, Dict]] = []
+        t = time.monotonic()
+        for rid, rec, deliveries in entries:
+            tid = rec.get("trace_id") if isinstance(rec, dict) else None
+            self._span("reclaim", t, t, trace_id=tid, uri=rid)
+            if existing.get(rid) is not None:
+                self.duplicates += 1
+                self._m_duplicates.inc()
+                self._ack([rid])
+                continue
+            if isinstance(rec, dict):
+                # claim lineage rides the record: a quarantine of this
+                # record dead-letters WITH its delivery count, and the
+                # result write stamps it for the client
+                rec["deliveries"] = deliveries
+            self._redelivered[rid] = deliveries
+            out.append((rid, rec))
+        if len(self._redelivered) > 4096:
+            # fire-and-forget bound: entries are popped at write/quarantine/
+            # shed; a pathological stream of never-completing redeliveries
+            # must not grow the map without limit.  Records still in OUR
+            # pipeline keep their entry — evicting them would strip the
+            # "deliveries" lineage off results/dead-letters mid-flight.
+            for rid in list(self._redelivered):
+                if len(self._redelivered) <= 2048:
+                    break
+                if rid not in self._inflight:
+                    self._redelivered.pop(rid, None)
+        if out:
+            logger.info(
+                "serving: replica %s reclaimed %d orphaned record(s) "
+                "(lease %.3gs, %d suppressed as duplicates)",
+                self.replica_id, len(out), p.lease_s,
+                len(entries) - len(out))
+        return out
+
     # -- result write with backpressure (ClusterServing.scala:276-307) -------
     def _put_result(self, rid, value):
         """Retry with backoff (blocking: upstream reads stall), behind a
@@ -466,6 +645,9 @@ class ClusterServing:
         try:
             self._breaker.call(self._write_retry.call,
                                self.queue.put_results, pairs)
+            # results durable: release the claims (at-least-once becomes
+            # exactly-one-result here)
+            self._ack([rid for rid, _ in pairs])
             return len(pairs)
         except Exception as e:  # noqa: BLE001 — batch path down: degrade
             if not isinstance(e, CircuitBreakerOpen):
@@ -474,9 +656,11 @@ class ClusterServing:
                     "falling back to per-record writes",
                     type(e).__name__, e)
             n = 0
+            written: List[str] = []
             for rid, value in pairs:
                 try:
                     self._put_result(rid, value)
+                    written.append(rid)
                     n += 1
                 except Exception as rec_exc:  # noqa: BLE001 — record down
                     # deliberate shed-don't-block tradeoff: when the result
@@ -486,6 +670,7 @@ class ClusterServing:
                     # behind an unbounded blocking retry
                     self._quarantine(rid, "put_result", rec_exc,
                                      trace_id=(tmap or {}).get(rid))
+            self._ack(written)
             return n
 
     def _quarantine(self, rid, stage: str, exc: BaseException,
@@ -505,16 +690,30 @@ class ClusterServing:
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
                          error=msg)
         logger.warning("serving: quarantining record %r (%s)", rid, msg)
+        handled = False
         try:
             self._dead_breaker.call(self.queue.put_error, rid, msg,
                                     record=record, trace_id=trace_id)
+            handled = True
         except CircuitBreakerOpen:
-            # store is down: shed quietly instead of blocking per record on
-            # the dead backend (the counter above still records the loss)
+            # store is down: don't block per record on the dead backend
             logger.warning("serving: dead-letter write for %r skipped "
                            "(breaker open)", rid)
         except Exception:  # noqa: BLE001 — best-effort: queue may be down
             logger.exception("serving: dead-letter write for %r failed", rid)
+        self._redelivered.pop(rid, None)
+        if handled:
+            # the quarantine is HANDLED (error result + dead-letter entry
+            # are its terminal state, durably written): release the claim
+            # so no replica churns it back through the pipeline forever
+            self._ack([rid])
+        else:
+            # terminal write failed: the claim stays pending so the record
+            # is REDELIVERED after the lease instead of silently lost (the
+            # pre-lease contract shed it here).  It is no longer in OUR
+            # pipeline, so drop the self-reclaim guard — any replica,
+            # including this one, may retry it against a recovered store.
+            self._inflight.pop(rid, None)
 
     # -- end-to-end deadlines (PR 2 availability) ----------------------------
     def _shed_expired(self, rid, rec: Optional[Dict],
@@ -546,6 +745,10 @@ class ClusterServing:
             self._put_result(rid, result)
         except Exception:  # noqa: BLE001 — store down: client's own
             pass           # deadline still unblocks it
+        # shed = terminal (the budget is gone for every replica alike):
+        # release the claim even when the marker write failed
+        self._redelivered.pop(rid, None)
+        self._ack([rid])
         return True
 
     # -- adaptive micro-batching (PR 3 tentpole) -----------------------------
@@ -614,14 +817,24 @@ class ClusterServing:
 
         With ``preprocess_workers > 1`` the per-record decode fans out across
         the pool; results are gathered in submission order, so quarantine
-        attribution and shape grouping are identical to the inline path."""
+        attribution and shape grouping are identical to the inline path.
+
+        PR 5: the periodic reclaim sweep runs here, so records orphaned by
+        a dead replica enter the pipeline ahead of fresh stream reads and
+        go through the exact same shed/quarantine/trace machinery."""
         t0 = time.monotonic()
-        batch = self._read_coalesced()
+        self._hb_ts = t0      # replica heartbeat: the read loop is alive
+        batch = self._maybe_reclaim()
+        batch += self._read_coalesced()
         t_read = time.monotonic()
         if not batch:
             return None       # stream empty (drain may exit on this)
         self._stages["read"].record(t_read - t0)
         for rid, rec in batch:
+            # claim registry for the self-reclaim guard: while a record is
+            # in OUR pipeline the reclaim sweep must not mistake it for a
+            # dead replica's orphan (cleared on ack)
+            self._inflight[rid] = t_read
             # every record that enters the pipeline gets a trace: producers
             # that bypass the client (raw xadd) are stamped at read instead
             rec.setdefault("trace_id", new_trace_id())
@@ -787,8 +1000,13 @@ class ClusterServing:
                 self._span("predict", inflight.t_dispatch, t_done,
                                  trace_id=tmap.get(rid), uri=rid)
                 try:
-                    pairs.append(
-                        (rid, {"value": self.postprocess(np.asarray(row))}))
+                    value = {"value": self.postprocess(np.asarray(row))}
+                    deliveries = self._redelivered.pop(rid, None)
+                    if deliveries:
+                        # at-least-once made visible: the client can tell a
+                        # failover-recovered result from a first delivery
+                        value["deliveries"] = deliveries
+                    pairs.append((rid, value))
                 except Exception as e:  # noqa: BLE001 — per-record isolation
                     self._quarantine(rid, "postprocess", e,
                                      trace_id=tmap.get(rid))
@@ -1031,6 +1249,11 @@ class ClusterServing:
              "uptime_s": round(time.monotonic() - self._t_start, 3),
              "pid": os.getpid(),
              "snapshot_seq": next(self._snapshot_seq),
+             # replica identity + failover counters (PR 5)
+             "replica_id": self.replica_id,
+             "heartbeat_age_s": round(self._heartbeat_age(), 3),
+             "reclaimed": self.reclaimed,
+             "duplicates": self.duplicates,
              "total_records": self.total_records,
              "dead_lettered": self.dead_lettered,
              "shed": self.shed,
@@ -1139,5 +1362,9 @@ class ClusterServing:
         for gauge, fn in self._gauge_fns:
             gauge.remove_function(fn)
         self._gauge_fns = []
+        # drop this replica's heartbeat series entirely (scale-down): a
+        # stopped replica must not linger in the exposition as a frozen or
+        # zero "age", which would read as perfectly fresh
+        self._hb_gauge.remove(replica=self.replica_id)
         if self._tb is not None:
             self._tb.flush()

@@ -26,6 +26,10 @@ existing health surface on three routes:
   ``serving_stage_seconds_bucket{stage="predict",le="0.05"} ...``.  The
   default JSON document is unchanged, so PR 2/3 consumers keep working.
 
+Every response carries an ``X-Replica-Id`` header (PR 5): with N serving
+replicas behind one load balancer, a probe flip is attributable to the
+replica that answered without parsing the body.
+
 Zero dependencies: `ThreadingHTTPServer` on a daemon thread, started by
 ``ClusterServing.start()`` when ``ServingParams.http_port`` is set (0 picks
 an ephemeral port, exposed as ``HealthServer.port``) and stopped by
@@ -60,11 +64,20 @@ class HealthServer:
             def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
                 logger.debug("probe: " + fmt, *args)
 
+            def _replica_header(self) -> None:
+                # PR 5: every probe answer names the replica that served it,
+                # so a load balancer / operator can attribute a flip without
+                # parsing the body (readiness carries identity)
+                replica = getattr(serving, "replica_id", None)
+                if replica:
+                    self.send_header("X-Replica-Id", str(replica))
+
             def _reply(self, status: int, doc) -> None:
                 body = json.dumps(doc).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                self._replica_header()
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -74,6 +87,7 @@ class HealthServer:
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                self._replica_header()
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -38,9 +38,22 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       preprocess_workers: 1             # decode fan-out (>1 = thread pool)
       inflight_batches: 2               # async device pipeline depth
       trim_interval_s: 5                # amortized stream-trim period
+      lease_s: 30                       # replicas (PR 5): claimed-record
+                                        # lease before another replica may
+                                        # reclaim (> worst-case record time)
+      reclaim_interval_s: null          # reclaim sweep period (null=lease/2)
 
 CLI (used by scripts/cluster-serving/*.sh):
     python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]
+        [--replicas N]                 # N serving replica processes over the
+        # SHARED queue (file/redis), supervised: a crashed replica is
+        # respawned, its orphaned in-flight records reclaimed by survivors.
+        # Replica i gets pidfile <pidfile>.r<i> (+ its own health snapshot)
+        # and params.http_port + i when a probe port is configured.
+    python -m analytics_zoo_tpu.serving.manager scale N
+        # resize a running --replicas supervisor to N replicas (scale-up
+        # spawns, scale-down SIGTERMs the highest-numbered replicas, which
+        # drain gracefully per params.drain_s)
     python -m analytics_zoo_tpu.serving.manager stop|status|restart
     python -m analytics_zoo_tpu.serving.manager health   # worker/breaker/
         # dead-letter state from the daemon's <pidfile>.health.json snapshot
@@ -168,16 +181,38 @@ def serving_params(cfg: dict) -> ServingParams:
 
 
 def serve_from_config(config_path: str,
-                      tensorboard_dir: Optional[str] = None) -> ClusterServing:
+                      tensorboard_dir: Optional[str] = None,
+                      replica_id: Optional[str] = None,
+                      http_port_offset: int = 0) -> ClusterServing:
     cfg = load_config(config_path)
+    params = serving_params(cfg)
+    if replica_id is not None:
+        # supervisor-assigned identity (PR 5) wins over the config default
+        # so every replica of one deployment is distinguishable
+        params.replica_id = replica_id
+    if params.http_port and http_port_offset:
+        # replicas cannot share one probe port: replica i listens on
+        # http_port + i (documented in the module docstring)
+        params.http_port += http_port_offset
     serving = ClusterServing(load_model(cfg), build_queue(cfg),
-                             params=serving_params(cfg),
+                             params=params,
                              tensorboard_dir=tensorboard_dir)
     return serving
 
 
 def _health_path(pidfile: str) -> str:
     return pidfile + ".health.json"
+
+
+def _replica_pidfile(pidfile: str, index: int) -> str:
+    return f"{pidfile}.r{index}"
+
+
+def _scale_path(pidfile: str) -> str:
+    """Desired replica count, written by `manager scale N` and polled by
+    the supervisor — a file, not a signal, so the target survives a
+    supervisor restart and is inspectable."""
+    return pidfile + ".replicas"
 
 
 def _write_health(serving, path: str) -> None:
@@ -193,10 +228,13 @@ def _write_health(serving, path: str) -> None:
         pass
 
 
-def _run_foreground(config_path: str, pidfile: str):
+def _run_foreground(config_path: str, pidfile: str,
+                    replica_id: Optional[str] = None,
+                    http_port_offset: int = 0):
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
-    serving = serve_from_config(config_path)
+    serving = serve_from_config(config_path, replica_id=replica_id,
+                                http_port_offset=http_port_offset)
     health_path = _health_path(pidfile)
 
     def _terminate(signum, frame):
@@ -219,15 +257,124 @@ def _run_foreground(config_path: str, pidfile: str):
         time.sleep(1)
 
 
+def _run_supervisor(config_path: str, pidfile: str, replicas: int):
+    """Replica supervisor (PR 5 tentpole): fork one serving process per
+    replica over the SHARED queue, monitor them, respawn crashed ones (a
+    SIGKILLed replica's orphaned records are reclaimed by the survivors
+    while the respawn happens), and track the desired count in
+    `<pidfile>.replicas` so `manager scale N` can resize a live deployment.
+    SIGTERM forwards to every replica (each drains per params.drain_s) and
+    then exits."""
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    scale_path = _scale_path(pidfile)
+    with open(scale_path, "w") as f:
+        f.write(str(replicas))
+    children: dict = {}                    # index -> pid
+    last_spawn: dict = {}                  # index -> monotonic ts (backoff)
+    stopping: set = set()                  # indices already SIGTERMed
+
+    def _spawn(index: int):
+        last_spawn[index] = time.monotonic()
+        pid = os.fork()
+        if pid == 0:
+            # child: plain replica process with its own pidfile/health
+            # snapshot, default signal disposition restored so the replica
+            # installs its own graceful-drain SIGTERM handler
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            signal.signal(signal.SIGINT, signal.SIG_DFL)
+            try:
+                _run_foreground(config_path, _replica_pidfile(pidfile, index),
+                                replica_id=f"replica-{index}",
+                                http_port_offset=index)
+            finally:
+                os._exit(0)
+        children[index] = pid
+
+    def _terminate(signum, frame):
+        for pid in children.values():
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + 60        # replicas drain per their config
+        for pid in children.values():
+            while time.time() < deadline:
+                try:
+                    if os.waitpid(pid, os.WNOHANG)[0]:
+                        break
+                except ChildProcessError:
+                    break
+                time.sleep(0.1)
+        for index in list(children):
+            for p in (_replica_pidfile(pidfile, index),
+                      _health_path(_replica_pidfile(pidfile, index))):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        for p in (pidfile, scale_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+    while True:
+        try:
+            with open(scale_path) as f:
+                desired = max(0, int(f.read().strip()))
+        except (OSError, ValueError):
+            desired = replicas
+        # reap exits (crash -> respawn below; scale-down exit -> forget)
+        for index, pid in list(children.items()):
+            try:
+                done, _ = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                done = pid
+            if done:
+                children.pop(index)
+                stopping.discard(index)
+                if index < desired:
+                    print(json.dumps({"replica": index, "pid": pid,
+                                      "event": "exited; respawning"}),
+                          file=sys.stderr, flush=True)
+        # scale down: highest-numbered replicas drain and exit (SIGTERM
+        # once — a repeat would re-enter the replica's drain handler)
+        for index in sorted(children, reverse=True):
+            if index >= desired and index not in stopping:
+                stopping.add(index)
+                try:
+                    os.kill(children[index], signal.SIGTERM)
+                except OSError:
+                    pass
+        # spawn missing replicas, rate-limited to one respawn per second
+        # per slot so a crash-looping config cannot fork-bomb the host
+        now = time.monotonic()
+        for index in range(desired):
+            if index not in children and \
+                    now - last_spawn.get(index, -1e9) >= 1.0:
+                _spawn(index)
+        time.sleep(0.5)
+
+
 def main(argv=None):
     import argparse
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
-                             "replay", "metrics"])
+                             "replay", "metrics", "scale"])
+    ap.add_argument("value", nargs="?", default=None,
+                    help="scale: target replica count")
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
+    ap.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="start: run N supervised serving replicas over the "
+                         "shared queue (crashed replicas respawn; their "
+                         "in-flight records are reclaimed by survivors)")
     ap.add_argument("--filter", default=None, metavar="SUBSTR",
                     help="replay only dead letters whose uri or error "
                          "contains SUBSTR")
@@ -316,10 +463,51 @@ def main(argv=None):
                           "admission_open": bool(
                               queue.health().get("admission_open", True))}))
         return 0
+    if args.action == "scale":
+        # resize a running --replicas supervisor: write the desired count,
+        # the supervisor's poll loop spawns/drains to match
+        if args.value is None:
+            print(json.dumps({"error": "scale needs a target count: "
+                                       "manager scale N"}), file=sys.stderr)
+            return 1
+        n = int(args.value)
+        pid = read_pid()
+        if pid is None or not alive(pid):
+            print(json.dumps({"error": "serving not running"}),
+                  file=sys.stderr)
+            return 1
+        if not os.path.exists(_scale_path(args.pidfile)):
+            print(json.dumps({"error": "not running as a replica "
+                                       "supervisor (start with "
+                                       "--replicas N)"}), file=sys.stderr)
+            return 1
+        with open(_scale_path(args.pidfile), "w") as f:
+            f.write(str(n))
+        print(json.dumps({"replicas": n}))
+        return 0
     if args.action == "status":
         pid = read_pid()
         up = pid is not None and alive(pid)
         out = {"running": up, "pid": pid if up else None}
+        if os.path.exists(_scale_path(args.pidfile)):
+            # replica-supervisor deployment: per-replica liveness
+            try:
+                with open(_scale_path(args.pidfile)) as f:
+                    desired = int(f.read().strip())
+            except (OSError, ValueError):
+                desired = 0
+            replicas = {}
+            for i in range(desired):
+                rp = _replica_pidfile(args.pidfile, i)
+                try:
+                    with open(rp) as f:
+                        rpid = int(f.read().strip())
+                except (OSError, ValueError):
+                    rpid = None
+                replicas[f"r{i}"] = {
+                    "pid": rpid,
+                    "alive": rpid is not None and alive(rpid)}
+            out["replicas"] = {"desired": desired, "members": replicas}
         health = read_health()
         if health is not None:
             out["health"] = health
@@ -367,6 +555,31 @@ def main(argv=None):
         print(json.dumps({"error": f"already running (pid {pid})"}),
               file=sys.stderr)
         return 1
+    if args.replicas is not None and args.replicas >= 1:
+        # replica-supervisor deployment (PR 5) — including --replicas 1, so
+        # a single-replica start can still be resized later with `manager
+        # scale N`.  The shared-queue contract needs a CROSS-PROCESS
+        # backend: an inproc queue would give every replica its own
+        # private stream
+        src = str(load_config(args.config).get("data", {})
+                  .get("src", "redis"))
+        if src == "inproc":
+            print(json.dumps({"error": "--replicas needs a cross-process "
+                                       "queue (data.src: redis or "
+                                       "file:<dir>), not inproc"}),
+                  file=sys.stderr)
+            return 1
+        if args.foreground:
+            _run_supervisor(args.config, args.pidfile, args.replicas)
+            return 0
+        pid = os.fork()
+        if pid == 0:                       # child: detach and supervise
+            os.setsid()
+            _run_supervisor(args.config, args.pidfile, args.replicas)
+            return 0
+        print(json.dumps({"started": True, "pid": pid,
+                          "replicas": args.replicas}))
+        return 0
     if args.foreground:
         _run_foreground(args.config, args.pidfile)
         return 0

@@ -32,17 +32,45 @@ Throughput data plane (PR 3):
   InProc one lock).  The defaults loop the single-record calls so custom
   backends stay correct; writes are idempotent per key so the engine's
   per-record fallback after a failed batch write cannot duplicate results.
+
+Lease-based claiming (PR 5 tentpole — horizontal serving replicas):
+`read_batch` no longer DELETES records on consume.  Every backend now
+CLAIMS them under a lease (the Kafka consumer-group / Redis Streams
+XAUTOCLAIM shape), so N replicas can share one queue and a SIGKILLed
+replica's in-flight records are recoverable instead of silently stranded:
+
+- a delivered record moves to a per-backend PENDING store stamped with the
+  claiming ``consumer`` (the replica id) and the claim time;
+- ``ack(rids)`` — called by the engine AFTER the result is durably written
+  — removes it from pending (and, for Redis, XACK+XDELs the entry);
+- ``reclaim(min_idle_s)`` re-claims pending entries whose lease has been
+  idle past ``min_idle_s`` (their replica died, or is stuck) and
+  re-delivers them to the caller with a delivery count — InProc walks its
+  pending table, FileQueue atomically renames the claim file, Redis uses
+  ``XAUTOCLAIM``;
+- ``pending_count()`` reports in-flight claims (rides ``health()``).
+
+The contract is AT-LEAST-ONCE: a record is redelivered until some replica
+acks it.  Result writes are idempotent per key and the engine suppresses
+redelivered records that already have a result, so the client-visible
+contract stays "exactly one result per record".  The lease must exceed the
+worst-case single-record service time, or a replica's own slow in-flight
+work gets re-claimed out from under it (same caveat as any lease system).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
 import threading
 import time
 import uuid
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class QueueFull(RuntimeError):
@@ -64,11 +92,42 @@ class BaseQueue:
     max_depth: Optional[int] = None
     admission_open: bool = True
 
+    def __init__(self):
+        # per-handle consumer identity (PR 5): the engine aligns this with
+        # its replica_id so claims are attributable across replicas
+        self.consumer = f"c-{uuid.uuid4().hex[:8]}"
+
     def xadd(self, record: Dict) -> str:
         raise NotImplementedError
 
     def read_batch(self, max_items: int, timeout_s: float = 0.1) -> List[Tuple[str, Dict]]:
+        """Deliver up to ``max_items`` records, CLAIMING them under this
+        handle's ``consumer`` lease (they stay in the pending store until
+        ``ack``ed — crash-safe, at-least-once)."""
         raise NotImplementedError
+
+    # -- lease-based claiming (PR 5 horizontal replicas) ---------------------
+    def ack(self, rids: List[str]) -> None:
+        """Acknowledge processed records: their results are durably written,
+        drop them from the pending store so they are never redelivered.  The
+        default is a no-op so pre-PR-5 custom backends (destructive reads,
+        nothing pending) stay correct."""
+
+    def reclaim(self, min_idle_s: float,
+                max_items: int = 64) -> List[Tuple[str, Dict, int]]:
+        """Re-claim pending records whose lease has been idle for at least
+        ``min_idle_s`` (their replica crashed mid-flight, or wedged) and
+        re-deliver them to THIS consumer.  Returns ``(rid, record,
+        deliveries)`` triples — ``deliveries >= 2`` marks a redelivery so
+        the engine can suppress records that already have a result.  The
+        default returns nothing (destructive-read backends have no
+        pending)."""
+        return []
+
+    def pending_count(self) -> int:
+        """In-flight claims (delivered, not yet acked) — the lease-side
+        sibling of ``depth()``."""
+        return 0
 
     def put_result(self, key: str, value: Dict) -> None:
         raise NotImplementedError
@@ -158,8 +217,13 @@ class BaseQueue:
             closed_ext = self._admission_closed_externally()
         except Exception:  # noqa: BLE001 — backend down
             closed_ext = False
+        try:
+            pending = self.pending_count()
+        except Exception:  # noqa: BLE001 — backend down
+            pending = -1
         return {"backend": type(self).__name__,
                 "depth": depth,
+                "pending": pending,
                 "max_depth": self.max_depth,
                 "admission_open": self.admission_open and not closed_ext,
                 "reachable": self.reachable(),
@@ -281,9 +345,14 @@ def _error_result(error: str, record: Optional[Dict],
 
 class InProcQueue(BaseQueue):
     def __init__(self, max_depth: Optional[int] = None):
+        super().__init__()
         self._stream = deque()
         self._results: Dict[str, Dict] = {}
         self._dead: List[Dict] = []
+        # lease-based pending table (PR 5): rid -> {record, claim_ts,
+        # consumer, deliveries}.  read_batch moves records here instead of
+        # destroying them; ack() removes; reclaim() re-delivers expired ones.
+        self._pending: Dict[str, Dict] = {}
         self._lock = threading.Lock()
         self.max_depth = max_depth
 
@@ -311,11 +380,40 @@ class InProcQueue(BaseQueue):
         while len(out) < max_items:
             with self._lock:
                 while self._stream and len(out) < max_items:
-                    out.append(self._stream.popleft())
+                    rid, rec = self._stream.popleft()
+                    self._pending[rid] = {"record": rec,
+                                          "claim_ts": time.monotonic(),
+                                          "consumer": self.consumer,
+                                          "deliveries": 1}
+                    out.append((rid, rec))
             if out or time.time() > deadline:
                 break
             time.sleep(0.005)
         return out
+
+    def ack(self, rids):
+        with self._lock:
+            for rid in rids:
+                self._pending.pop(rid, None)
+
+    def reclaim(self, min_idle_s, max_items=64):
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for rid, entry in self._pending.items():
+                if len(out) >= max_items:
+                    break
+                if now - entry["claim_ts"] < min_idle_s:
+                    continue
+                entry["claim_ts"] = now
+                entry["consumer"] = self.consumer
+                entry["deliveries"] += 1
+                out.append((rid, entry["record"], entry["deliveries"]))
+        return out
+
+    def pending_count(self):
+        with self._lock:
+            return len(self._pending)
 
     def put_result(self, key, value):
         with self._lock:
@@ -372,25 +470,37 @@ class InProcQueue(BaseQueue):
 
 
 class FileQueue(BaseQueue):
-    """Spool-dir stream: records are json files named <seq>-<id>.json in stream/,
-    results live in results/<key>.json.  Safe for one consumer, many producers."""
+    """Spool-dir stream: records are json files named <seq>-<id>.json in
+    stream/, results live in results/<key>.json.  Safe for MANY consumers and
+    many producers (PR 5): consuming a record is an atomic claim-rename into
+    claims/ — the rename either succeeds (this replica owns the record until
+    it acks) or raises FileNotFoundError (another replica won the race), so
+    no record can be delivered twice inside one lease window.  The PR 3
+    cached-listing optimization is gone with the single-consumer model it
+    depended on: a stale cached name now simply loses the claim race instead
+    of papering over it, and every poll lists the spool fresh.
+
+    Claim files are named ``<claim_ns>.<deliveries>.<consumer>.<orig>`` so a
+    reclaim sweep can recover a dead replica's orphans by filename alone —
+    no shared state beyond the directory."""
 
     def __init__(self, root: str, max_depth: Optional[int] = None):
+        super().__init__()
         self.root = root
         self.stream_dir = os.path.join(root, "stream")
+        self.claim_dir = os.path.join(root, "claims")
         self.result_dir = os.path.join(root, "results")
         self.dead_dir = os.path.join(root, "dead-letter")
         os.makedirs(self.stream_dir, exist_ok=True)
+        os.makedirs(self.claim_dir, exist_ok=True)
         os.makedirs(self.result_dir, exist_ok=True)
         os.makedirs(self.dead_dir, exist_ok=True)
         self.max_depth = max_depth
-        # consumer-side read cache (PR 3): one sorted directory listing
-        # amortized across many read_batch calls — re-sorting a deep spool
-        # on EVERY poll made reads O(depth) per batch.  Safe under the
-        # documented one-consumer/many-producers model: new records sort
-        # after the snapshot (time_ns names), and a cached name deleted
-        # under us (trim/raced consumer) is skipped via FileNotFoundError.
-        self._read_cache: deque = deque()
+        # rid -> claim-file path for records THIS handle claimed (ack needs
+        # the current claim name); guarded — the engine reads on one worker
+        # thread and acks on another
+        self._claims: Dict[str, str] = {}
+        self._claims_lock = threading.Lock()
 
     def depth(self):
         return sum(1 for f in os.listdir(self.stream_dir)
@@ -429,46 +539,109 @@ class FileQueue(BaseQueue):
         os.rename(tmp, dst)
         return rid
 
+    @staticmethod
+    def _rid_of(orig_name: str) -> str:
+        return orig_name.split("-", 1)[1][:-5] if "-" in orig_name \
+            else orig_name
+
+    def _claim_name(self, orig_name: str, deliveries: int) -> str:
+        # dots delimit the claim metadata, so the consumer id must not
+        # carry any (replica ids are free-form)
+        consumer = re.sub(r"[^A-Za-z0-9_-]", "-", str(self.consumer))
+        return f"{time.time_ns()}.{deliveries}.{consumer}.{orig_name}"
+
+    def _load_claim(self, claim_path: str,
+                    orig_name: str) -> Optional[Tuple[str, Dict]]:
+        """Parse a just-claimed record; a corrupt payload (crash mid-write
+        outside the tmp/rename path, disk error) is quarantined ALONE and
+        its claim file dropped — left in place it would be re-claimed and
+        re-parsed every reclaim sweep forever."""
+        rid = self._rid_of(orig_name)
+        try:
+            with open(claim_path) as f:
+                rec = json.load(f)
+        except FileNotFoundError:
+            return None                    # raced a reclaiming replica
+        except json.JSONDecodeError as e:
+            try:
+                os.remove(claim_path)
+            except FileNotFoundError:
+                pass
+            try:
+                self.put_error(rid, f"read_batch: malformed entry: {e}")
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+            return None
+        with self._claims_lock:
+            self._claims[rid] = claim_path
+        return rid, rec
+
     def read_batch(self, max_items, timeout_s=0.1):
         deadline = time.time() + timeout_s
         out = []
         while len(out) < max_items:
-            if not self._read_cache:
-                self._read_cache.extend(sorted(
-                    f for f in os.listdir(self.stream_dir)
-                    if f.endswith(".json")))
-            while self._read_cache and len(out) < max_items:
-                fname = self._read_cache.popleft()
-                path = os.path.join(self.stream_dir, fname)
+            for fname in sorted(f for f in os.listdir(self.stream_dir)
+                                if f.endswith(".json")):
+                if len(out) >= max_items:
+                    break
+                claim_path = os.path.join(
+                    self.claim_dir, self._claim_name(fname, deliveries=1))
                 try:
-                    with open(path) as f:
-                        rec = json.load(f)
-                    os.remove(path)
+                    # the claim-rename IS the consume: atomic, exactly one
+                    # replica wins, and the record survives a crash as a
+                    # lease-stamped claim file instead of vanishing
+                    os.rename(os.path.join(self.stream_dir, fname),
+                              claim_path)
                 except FileNotFoundError:
-                    continue               # raced another consumer
-                except json.JSONDecodeError as e:
-                    # corrupt spool file (crash mid-write outside the
-                    # tmp/rename path, disk error): left in place it would
-                    # be re-parsed every poll AND count against the
-                    # max_depth admission cap forever — quarantine it alone
-                    rid = fname.split("-", 1)[1][:-5] if "-" in fname \
-                        else fname
-                    try:
-                        os.remove(path)
-                    except FileNotFoundError:
-                        pass
-                    try:
-                        self.put_error(
-                            rid, f"read_batch: malformed entry: {e}")
-                    except Exception:  # noqa: BLE001 — best-effort
-                        pass
-                    continue
-                rid = fname.split("-", 1)[1][:-5]
-                out.append((rid, rec))
+                    continue               # another replica claimed it
+                loaded = self._load_claim(claim_path, fname)
+                if loaded is not None:
+                    out.append(loaded)
             if out or time.time() > deadline:
                 break
             time.sleep(0.01)
         return out
+
+    def ack(self, rids):
+        for rid in rids:
+            with self._claims_lock:
+                path = self._claims.pop(rid, None)
+            if path:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass                   # reclaimed past our lease
+
+    def reclaim(self, min_idle_s, max_items=64):
+        now_ns = time.time_ns()
+        out = []
+        for fname in sorted(os.listdir(self.claim_dir)):
+            if len(out) >= max_items:
+                break
+            parts = fname.split(".", 3)
+            if len(parts) != 4:
+                continue                   # foreign file in the claims dir
+            try:
+                claim_ns, deliveries = int(parts[0]), int(parts[1])
+            except ValueError:
+                continue
+            if now_ns - claim_ns < min_idle_s * 1e9:
+                continue                   # lease still live
+            orig = parts[3]
+            new_path = os.path.join(
+                self.claim_dir, self._claim_name(orig, deliveries + 1))
+            try:
+                os.rename(os.path.join(self.claim_dir, fname), new_path)
+            except FileNotFoundError:
+                continue                   # another replica reclaimed first
+            loaded = self._load_claim(new_path, orig)
+            if loaded is not None:
+                out.append((loaded[0], loaded[1], deliveries + 1))
+        return out
+
+    def pending_count(self):
+        return sum(1 for f in os.listdir(self.claim_dir)
+                   if f.endswith(".json"))
 
     def put_result(self, key, value):
         tmp = os.path.join(self.result_dir, f".{key}.tmp")
@@ -603,14 +776,26 @@ class RedisQueue(BaseQueue):
     `health()`) instead of crash-looping the supervised preprocess worker;
     after `read_breaker_cooldown_s` a half-open probe reconnects
     automatically.  A malformed stream entry dead-letters ALONE: the rest of
-    the batch (already consumed past `_last_id`) is still delivered."""
+    the already-consumed batch is still delivered.
+
+    Horizontal replicas (PR 5): reads go through a CONSUMER GROUP
+    (``XGROUP CREATE`` at id 0 / ``XREADGROUP >``), so N replicas share the
+    stream with server-side fan-out, each delivered entry sits in the
+    group's pending-entries list under this handle's ``consumer`` name
+    until ``ack()`` (XACK + XDEL — served entries leave XLEN, keeping
+    depth == backlog), and ``reclaim()`` is ``XAUTOCLAIM``: entries idle
+    past the lease are re-claimed from dead replicas and redelivered."""
+
+    GROUP = "serving"
 
     def __init__(self, host="localhost", port=6379, stream="image_stream",
                  result_table="result", max_depth: Optional[int] = None,
-                 client=None, read_retries: int = 2,
+                 client=None, group: str = GROUP,
+                 read_retries: int = 2,
                  read_backoff_s: float = 0.05,
                  read_breaker_threshold: int = 5,
                  read_breaker_cooldown_s: float = 1.0):
+        super().__init__()
         if client is None:
             import redis
             client = redis.Redis(host=host, port=port)
@@ -618,7 +803,18 @@ class RedisQueue(BaseQueue):
         self.stream = stream
         self.table = result_table
         self.dead_stream = stream + ":dead-letter"
-        self._last_id = "0"
+        self.group = group
+        self._group_ready = False
+        # rid -> stream entry id for records THIS handle has claimed (XACK
+        # needs the entry id); guarded — the engine reads on one worker
+        # thread and acks on another
+        self._claimed: Dict[str, bytes] = {}
+        self._claimed_lock = threading.Lock()
+        # Redis < 6.2 has consumer groups but not XAUTOCLAIM: flip this on
+        # the first "unknown command" so reclaim degrades to a no-op once
+        # instead of repeatedly failing through the shared read breaker
+        # (which would blind XREADGROUP too)
+        self._reclaim_unsupported = False
         self.max_depth = max_depth
         from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                          RetryPolicy)
@@ -650,11 +846,52 @@ class RedisQueue(BaseQueue):
         self.r.xadd(self.stream, {"data": json.dumps(record)})
         return rid
 
-    def depth(self):
+    # -- consumer-group plumbing (PR 5) --------------------------------------
+    def _ensure_group(self):
+        if self._group_ready:
+            return
         try:
-            return int(self.r.xlen(self.stream))
+            # id "0": records enqueued before the first replica starts are
+            # still delivered (the pre-PR-5 read-from-0 semantics)
+            self.r.xgroup_create(self.stream, self.group, id="0",
+                                 mkstream=True)
+        except Exception as e:  # noqa: BLE001 — BUSYGROUP = already exists
+            if "BUSYGROUP" not in str(e):
+                raise
+        self._group_ready = True
+
+    def _with_group(self, fn):
+        """Run one group read, recovering ONCE from NOGROUP (the stream was
+        deleted/trimmed out from under the group) by re-creating it."""
+        self._ensure_group()
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — inspect for NOGROUP
+            if "NOGROUP" not in str(e):
+                raise
+            self._group_ready = False
+            self._ensure_group()
+            return fn()
+
+    def depth(self):
+        # backlog = entries on the stream minus claimed-in-flight ones: the
+        # admission cap and /readyz threshold must not count records that a
+        # replica is actively serving (acked entries are XDELed, so they
+        # leave XLEN entirely)
+        try:
+            return max(0, int(self.r.xlen(self.stream))
+                       - self.pending_count())
         except Exception:  # noqa: BLE001 — outage: admission stays open,
             return 0       # the write itself will surface the error
+
+    def pending_count(self):
+        try:
+            info = self.r.xpending(self.stream, self.group)
+            if isinstance(info, dict):
+                return int(info.get("pending", 0))
+            return int(info[0])            # raw [count, min, max, consumers]
+        except Exception:  # noqa: BLE001 — no group yet / outage
+            return 0
 
     def reachable(self):
         try:
@@ -701,51 +938,117 @@ class RedisQueue(BaseQueue):
         h["read_breaker"] = self._read_breaker.health()
         return h
 
+    def _parse_delivery(self, eid, fields,
+                        out: List[Tuple[str, Dict]]) -> Optional[str]:
+        """Parse one delivered entry into ``out``, registering its claim;
+        a malformed entry is quarantined ALONE (and acked away, so it never
+        haunts the pending list) while the rest of the batch proceeds.
+        Returns the rid on success."""
+        try:
+            rec = json.loads(fields[b"data"])
+        except (KeyError, ValueError, TypeError) as e:
+            key = self._decode(eid)
+            try:
+                self.put_error(
+                    key, f"read_batch: malformed entry: "
+                         f"{type(e).__name__}: {e}",
+                    record={"raw": self._decode(fields.get(b"data", b""))})
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+            try:
+                self.r.xack(self.stream, self.group, eid)
+                self.r.xdel(self.stream, eid)
+            except Exception:  # noqa: BLE001 — reclaim will re-land here
+                pass
+            return None
+        rid = rec.get("uri", self._decode(eid))
+        with self._claimed_lock:
+            self._claimed[rid] = eid
+        out.append((rid, rec))
+        return rid
+
     def read_batch(self, max_items, timeout_s=0.1):
         try:
             # block floor of 1 ms: Redis treats BLOCK 0 as "block forever",
-            # which a sub-millisecond coalescing remainder must NOT become
+            # which a sub-millisecond coalescing remainder must NOT become.
+            # XREADGROUP ">" delivers only never-delivered entries and puts
+            # them on this consumer's pending list (the claim)
             resp = self._guarded_read(
-                self.r.xread, {self.stream: self._last_id}, count=max_items,
-                block=max(1, int(timeout_s * 1000)))
+                lambda: self._with_group(
+                    lambda: self.r.xreadgroup(
+                        self.group, self.consumer, {self.stream: ">"},
+                        count=max_items,
+                        block=max(1, int(timeout_s * 1000)))))
         except _ReadUnavailable:
             self._last_read_failed = True
             return []                      # degrade: readiness reports it
         self._last_read_failed = False
-        out = []
-        consumed = []
-        for _, entries in resp:
+        out: List[Tuple[str, Dict]] = []
+        for _, entries in resp or []:
             for eid, fields in entries:
-                self._last_id = eid
-                consumed.append(eid)
-                try:
-                    rec = json.loads(fields[b"data"])
-                except (KeyError, ValueError, TypeError) as e:
-                    # one malformed entry must not drop the rest of the
-                    # batch (its ids are already past _last_id): quarantine
-                    # it alone and keep going
-                    key = self._decode(eid)
-                    try:
-                        self.put_error(
-                            key, f"read_batch: malformed entry: "
-                                 f"{type(e).__name__}: {e}",
-                            record={"raw": self._decode(
-                                fields.get(b"data", b""))})
-                    except Exception:  # noqa: BLE001 — best-effort
-                        pass
-                    continue
-                out.append((rec.get("uri", self._decode(eid)), rec))
-        if consumed:
-            # delete-on-consume (single-consumer model, same semantics as
-            # the File/InProc backends): XLEN then measures BACKLOG, which
-            # is what the `max_depth` admission cap and `/readyz` depth
-            # threshold must see — otherwise served records would count
-            # against admission forever
-            try:
-                self.r.xdel(self.stream, *consumed)
-            except Exception:  # noqa: BLE001 — trim() still bounds memory
-                pass
+                self._parse_delivery(eid, fields, out)
         return out
+
+    def ack(self, rids):
+        eids = []
+        with self._claimed_lock:
+            for rid in rids:
+                eid = self._claimed.pop(rid, None)
+                if eid is not None:
+                    eids.append(eid)
+        if not eids:
+            return
+        # XACK releases the claim; XDEL drops the served entry from the
+        # stream so XLEN keeps measuring backlog (the delete-on-consume
+        # depth semantics, moved to the ack side of the lease)
+        self.r.xack(self.stream, self.group, *eids)
+        try:
+            self.r.xdel(self.stream, *eids)
+        except Exception:  # noqa: BLE001 — trim() still bounds memory
+            pass
+
+    def reclaim(self, min_idle_s, max_items=64):
+        if self._reclaim_unsupported:
+            return []
+        try:
+            resp = self._guarded_read(
+                lambda: self._with_group(
+                    lambda: self.r.xautoclaim(
+                        self.stream, self.group, self.consumer,
+                        int(min_idle_s * 1000), start_id="0-0",
+                        count=max_items)))
+        except _ReadUnavailable as e:
+            # walk the cause chain (RetryExhausted wraps the original):
+            # an "unknown command" server is a capability gap, not an
+            # outage — disable reclaim on this handle rather than letting
+            # every sweep re-fail through the shared read breaker
+            msgs, cause = [str(e)], e.__cause__
+            while cause is not None:
+                msgs.append(str(cause))
+                cause = cause.__cause__
+            if any("unknown command" in m.lower() for m in msgs):
+                self._reclaim_unsupported = True
+                logger.warning(
+                    "RedisQueue: server lacks XAUTOCLAIM (Redis < 6.2); "
+                    "lease reclaim disabled on this handle — records "
+                    "orphaned by dead replicas will NOT be auto-recovered")
+            return []
+        # redis-py >= 4 returns (next_start, entries, deleted_ids); older
+        # servers omit the third element
+        entries = resp[1] if isinstance(resp, (tuple, list)) \
+            and len(resp) >= 2 else []
+        out3: List[Tuple[str, Dict, int]] = []
+        for eid, fields in entries:
+            if fields is None:
+                continue                   # entry XDELed under the claim
+            parsed: List[Tuple[str, Dict]] = []
+            rid = self._parse_delivery(eid, fields, parsed)
+            if rid is not None:
+                # XAUTOCLAIM does not return the delivery counter; 2 is the
+                # honest floor ("redelivered at least once"), which is all
+                # the engine's duplicate suppression needs
+                out3.append((rid, parsed[0][1], 2))
+        return out3
 
     def put_result(self, key, value):
         self.r.hset(self.table, key, json.dumps(value))

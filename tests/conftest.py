@@ -36,6 +36,17 @@ def pytest_configure(config):
         "markers",
         "slow: throughput sweeps / long benchmarks excluded from the "
         "tier-1 run (`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers",
+        "replicas: multi-process replica failover tests (SIGKILL + "
+        "reclaim); carry a default 300 s SIGALRM budget so a wedged "
+        "replica subprocess cannot stall tier-1")
+
+
+# replica-failover tests fork full serving processes (jax import + model
+# build each) and then wait on kill/reclaim cycles: the default budget when
+# no explicit `timeout` mark is given
+REPLICAS_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -45,10 +56,17 @@ def pytest_runtest_call(item):
     socket reads) on the main thread, which is exactly where pytest runs the
     test body; platforms without SIGALRM just skip the guard."""
     marker = item.get_closest_marker("timeout")
-    if marker is None or not hasattr(signal, "SIGALRM"):
+    if not hasattr(signal, "SIGALRM"):
         return (yield)
-    seconds = float(marker.args[0]) if marker.args \
-        else float(marker.kwargs.get("seconds", 60))
+    if marker is None:
+        # the `replicas` mark implies a budget of its own: multi-process
+        # kill tests must never hang tier-1 even without an explicit mark
+        if item.get_closest_marker("replicas") is None:
+            return (yield)
+        seconds = REPLICAS_DEFAULT_TIMEOUT_S
+    else:
+        seconds = float(marker.args[0]) if marker.args \
+            else float(marker.kwargs.get("seconds", 60))
 
     def _on_alarm(signum, frame):
         raise TimeoutError(

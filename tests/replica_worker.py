@@ -1,0 +1,104 @@
+"""Serving replica subprocess for the SIGKILL failover chaos test
+(test_serving_replicas.py): one ClusterServing engine over a shared
+FileQueue spool, short lease, periodic health snapshot.
+
+The queue handle logs every uri whose result THIS process successfully
+wrote (append after the write commits), so the parent test can assert the
+no-duplicate-write half of the exactly-one-result contract across a
+SIGKILL: a uri must appear in at most one replica's log, at most once.
+
+Usage:
+    python replica_worker.py QUEUE_DIR REPLICA_ID [--lease S]
+        [--reclaim-interval S] [--slow S] [--batch N]
+
+Runs until SIGTERM (graceful drain) — or SIGKILL, which is the point.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("queue_dir")
+    ap.add_argument("replica_id")
+    ap.add_argument("--lease", type=float, default=1.0)
+    ap.add_argument("--reclaim-interval", type=float, default=0.2)
+    ap.add_argument("--slow", type=float, default=0.0,
+                    help="per-batch predict sleep: keeps claims in flight "
+                         "long enough for the parent to SIGKILL mid-stream")
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    log_path = os.path.join(args.queue_dir, f"{args.replica_id}.writes.log")
+
+    class LoggingFileQueue(FileQueue):
+        """Append each successfully-written result uri (one O_APPEND write
+        per batch AFTER the spool commit) for the parent's duplicate
+        audit."""
+
+        def _log(self, rids):
+            with open(log_path, "a") as f:
+                f.write("".join(f"{rid}\n" for rid in rids))
+                f.flush()
+                os.fsync(f.fileno())
+
+        def put_results(self, pairs):
+            super().put_results(pairs)
+            self._log([rid for rid, _ in pairs])
+
+        def put_result(self, key, value):
+            super().put_result(key, value)
+            self._log([key])
+
+    queue = LoggingFileQueue(args.queue_dir)
+    model = Sequential()
+    model.add(Dense(4, input_shape=(3,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    serving = ClusterServing(im, queue, params=ServingParams(
+        batch_size=args.batch, poll_timeout_s=0.02, max_wait_ms=2.0,
+        worker_backoff_s=0.01, replica_id=args.replica_id,
+        lease_s=args.lease, reclaim_interval_s=args.reclaim_interval))
+    if args.slow > 0:
+        orig_predict = serving.model.do_predict
+
+        def slow_predict(*a, **kw):
+            time.sleep(args.slow)
+            return orig_predict(*a, **kw)
+
+        serving.model.do_predict = slow_predict
+
+    health_path = os.path.join(args.queue_dir,
+                               f"{args.replica_id}.health.json")
+
+    def _terminate(signum, frame):
+        serving.shutdown(drain_s=5.0)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    serving.start()
+    while True:
+        tmp = health_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(serving.health(), f)
+        os.replace(tmp, health_path)
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

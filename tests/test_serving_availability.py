@@ -66,12 +66,14 @@ def _drain_results(out_q, rids, timeout_s=30.0):
 
 class FakeRedis:
     """The slice of redis.Redis the RedisQueue uses, in-process: streams as
-    (id, {b"data": bytes}) lists, hashes as dicts.  Lets the chaos tests
-    exercise the REAL RedisQueue code path without a server."""
+    (id, {b"data": bytes}) lists, hashes as dicts, consumer groups (PR 5) as
+    {(stream, group): {"last": seq, "pending": {eid: entry}}}.  Lets the
+    chaos tests exercise the REAL RedisQueue code path without a server."""
 
     def __init__(self):
         self.streams = {}
         self.hashes = {}
+        self.groups = {}
         self._seq = 0
         self._lock = threading.Lock()
 
@@ -127,6 +129,88 @@ class FakeRedis:
             s = self.streams.get(stream, [])
             if maxlen is not None and len(s) > maxlen:
                 self.streams[stream] = s[-maxlen:]
+
+    # -- consumer groups (PR 5 horizontal replicas) --------------------------
+    def xgroup_create(self, name, groupname, id="$", mkstream=False):
+        with self._lock:
+            if (name, groupname) in self.groups:
+                raise Exception("BUSYGROUP Consumer Group name already "
+                                "exists")
+            if mkstream:
+                self.streams.setdefault(name, [])
+            last = self._seq if str(id) == "$" \
+                else int(str(id).split("-")[0])
+            self.groups[(name, groupname)] = {"last": last, "pending": {}}
+        return True
+
+    def _group(self, name, groupname):
+        g = self.groups.get((name, groupname))
+        if g is None:
+            raise Exception(f"NOGROUP No such consumer group '{groupname}' "
+                            f"for key name '{name}'")
+        return g
+
+    def xreadgroup(self, groupname, consumername, streams, count=None,
+                   block=None, noack=False):
+        out = []
+        with self._lock:
+            for name, last_id in streams.items():
+                g = self._group(name, groupname)
+                if last_id != ">":
+                    continue               # PEL re-reads not modeled
+                entries = [(eid, dict(f))
+                           for eid, f in self.streams.get(name, [])
+                           if self._seq_of(eid) > g["last"]]
+                if count:
+                    entries = entries[:count]
+                now_ms = time.time() * 1000.0
+                for eid, _ in entries:
+                    g["last"] = max(g["last"], self._seq_of(eid))
+                    if not noack:
+                        g["pending"][eid] = {"consumer": consumername,
+                                             "time_ms": now_ms,
+                                             "deliveries": 1}
+                if entries:
+                    out.append((name.encode() if isinstance(name, str)
+                                else name, entries))
+        return out
+
+    def xack(self, name, groupname, *eids):
+        with self._lock:
+            g = self._group(name, groupname)
+            return sum(1 for eid in eids
+                       if g["pending"].pop(eid, None) is not None)
+
+    def xautoclaim(self, name, groupname, consumername, min_idle_time,
+                   start_id="0-0", count=None, justid=False):
+        claimed, deleted = [], []
+        with self._lock:
+            g = self._group(name, groupname)
+            now_ms = time.time() * 1000.0
+            live = {eid: dict(f) for eid, f in self.streams.get(name, [])}
+            candidates = sorted(
+                (eid for eid, p in g["pending"].items()
+                 if now_ms - p["time_ms"] >= min_idle_time),
+                key=self._seq_of)
+            for eid in candidates[:count or 100]:
+                if eid not in live:
+                    # entry XDELed under the claim: real XAUTOCLAIM drops it
+                    # from the PEL and reports it in the third element
+                    g["pending"].pop(eid)
+                    deleted.append(eid)
+                    continue
+                p = g["pending"][eid]
+                p.update(consumer=consumername, time_ms=now_ms,
+                         deliveries=p["deliveries"] + 1)
+                claimed.append((eid, live[eid]))
+        return (b"0-0", [eid for eid, _ in claimed] if justid else claimed,
+                deleted)
+
+    def xpending(self, name, groupname):
+        with self._lock:
+            g = self._group(name, groupname)
+            return {"pending": len(g["pending"]), "min": None, "max": None,
+                    "consumers": []}
 
     def hset(self, table, key=None, value=None, mapping=None):
         with self._lock:
@@ -505,10 +589,11 @@ def test_redis_read_outage_degrades_and_heals():
                    read_breaker_cooldown_s=0.05)
     q.xadd({"uri": "r0", "data": [1.0]})
     inj = FaultInjector()
-    fake.xread = inj.wrap("xread", fake.xread)
+    # the PR 5 read path is XREADGROUP (consumer groups), not XREAD
+    fake.xreadgroup = inj.wrap("xreadgroup", fake.xreadgroup)
     fake.hget = inj.wrap("hget", fake.hget)
 
-    with inj.outage("xread", "hget", exc=ConnectionError):
+    with inj.outage("xreadgroup", "hget", exc=ConnectionError):
         # reads degrade to empty/None instead of raising
         for _ in range(3):
             assert q.read_batch(4, timeout_s=0.01) == []
@@ -530,10 +615,10 @@ def test_drain_does_not_mistake_outage_for_empty_stream(ctx):
                    read_breaker_cooldown_s=0.05)
     serving = _serving(q)
     inj = FaultInjector()
-    fake.xread = inj.wrap("xread", fake.xread)
+    fake.xreadgroup = inj.wrap("xreadgroup", fake.xreadgroup)
     serving.start()
     time.sleep(0.05)
-    with inj.outage("xread", exc=ConnectionError):
+    with inj.outage("xreadgroup", exc=ConnectionError):
         for i in range(4):
             q.xadd({"uri": f"r{i}", "data": [1.0] * DIM})
         t0 = time.time()
@@ -728,7 +813,7 @@ def test_chaos_outage_flood_and_drain_acceptance(ctx):
                    read_breaker_threshold=3, read_breaker_cooldown_s=0.1)
     serving = _serving(q, http_port=0, batch_size=4)
     inj = FaultInjector()
-    fake.xread = inj.wrap("xread", fake.xread)
+    fake.xreadgroup = inj.wrap("xreadgroup", fake.xreadgroup)
     cin, cout = InputQueue(q), OutputQueue(q)
     serving.start()
     url = serving._http.url
@@ -744,7 +829,7 @@ def test_chaos_outage_flood_and_drain_acceptance(ctx):
 
         # phase 2: backend read outage mid-stream + enqueue flood
         accepted, rejected = [], 0
-        with inj.outage("xread", exc=ConnectionError):
+        with inj.outage("xreadgroup", exc=ConnectionError):
             deadline = time.time() + 10
             flipped = False
             while time.time() < deadline and not flipped:

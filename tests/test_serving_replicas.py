@@ -1,0 +1,562 @@
+"""Horizontal serving replicas (PR 5 tentpole): lease-based claiming on all
+three queue backends, crash failover via reclaim, duplicate suppression on
+redelivery, per-replica identity/heartbeats, the manager's replica
+supervisor + `scale`, and the SIGKILL chaos acceptance scenario — every
+enqueued record gets exactly one result even when a replica dies
+mid-stream."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue, RedisQueue
+
+from test_serving_availability import FakeRedis
+
+DIM, NCLS = 3, 4
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def _serving(queue, **params):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    model = Sequential()
+    model.add(Dense(NCLS, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=4, poll_timeout_s=0.02, write_backoff_s=0.01,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue, params=ServingParams(**defaults))
+
+
+def _wait(predicate, timeout_s, step=0.02):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+def _mk_queue(kind, tmp_path, fake=None):
+    if kind == "inproc":
+        return InProcQueue()
+    if kind == "file":
+        return FileQueue(str(tmp_path / "q"))
+    return RedisQueue(client=fake if fake is not None else FakeRedis())
+
+
+# -- lease-based claiming: the queue contract ----------------------------------
+
+@pytest.mark.parametrize("kind", ["inproc", "file", "redis"])
+def test_claim_ack_reclaim_lifecycle(kind, tmp_path):
+    """read_batch CLAIMS instead of deleting: unacked records survive in the
+    pending store, a reclaim after the lease re-delivers them with a bumped
+    delivery count, and ack is terminal."""
+    q = _mk_queue(kind, tmp_path)
+    q.xadd({"uri": "a", "data": [1.0]})
+    q.xadd({"uri": "b", "data": [2.0]})
+    batch = q.read_batch(10, timeout_s=0.01)
+    assert sorted(rid for rid, _ in batch) == ["a", "b"]
+    # claimed, not destroyed: backlog empty, pending holds both
+    assert q.depth() == 0
+    assert q.pending_count() == 2
+    assert q.health()["pending"] == 2
+    # nothing to reclaim inside the lease
+    assert q.reclaim(min_idle_s=30.0) == []
+    q.ack(["a"])
+    assert q.pending_count() == 1
+    time.sleep(0.02)
+    # lease expired: the unacked record comes back, marked redelivered
+    reclaimed = q.reclaim(min_idle_s=0.01)
+    assert [(rid, d) for rid, _, d in reclaimed] == [("b", 2)]
+    assert reclaimed[0][1]["data"] == [2.0]
+    q.ack(["b"])
+    assert q.pending_count() == 0
+    assert q.reclaim(min_idle_s=0.0) == []
+
+
+@pytest.mark.parametrize("kind", ["file", "redis"])
+def test_crashed_handle_orphans_recovered_by_second_handle(kind, tmp_path):
+    """The failover shape: handle A claims and 'dies' (nothing acked); a
+    SECOND handle — a different consumer over the same backend — reclaims
+    A's orphans after the lease."""
+    fake = FakeRedis() if kind == "redis" else None
+    qa = _mk_queue(kind, tmp_path, fake)
+    qb = FileQueue(qa.root) if kind == "file" else RedisQueue(client=fake)
+    for i in range(3):
+        qa.xadd({"uri": f"r{i}", "data": [float(i)]})
+    assert len(qa.read_batch(10, timeout_s=0.01)) == 3   # A claims all
+    del qa                                               # A "crashes"
+    assert qb.read_batch(10, timeout_s=0.01) == []       # nothing unclaimed
+    time.sleep(0.03)
+    reclaimed = qb.reclaim(min_idle_s=0.02)
+    assert sorted(rid for rid, _, _ in reclaimed) == ["r0", "r1", "r2"]
+    assert all(d >= 2 for _, _, d in reclaimed)
+    qb.ack([rid for rid, _, _ in reclaimed])
+    assert qb.pending_count() == 0
+
+
+def test_file_claim_rename_is_the_only_consume_path(tmp_path):
+    """Satellite: two FileQueue consumers racing over one spool — the atomic
+    claim-rename partitions the stream exactly (no record delivered to both,
+    none lost), with no cached-listing staleness window."""
+    root = str(tmp_path / "q")
+    qa, qb = FileQueue(root), FileQueue(root)
+    n = 60
+    for i in range(n):
+        qa.xadd({"uri": f"r{i}", "data": [float(i)]})
+    got = {"a": [], "b": []}
+    import threading
+
+    def consume(name, q):
+        while True:
+            batch = q.read_batch(4, timeout_s=0.01)
+            if not batch:
+                break
+            got[name].extend(rid for rid, _ in batch)
+
+    ta = threading.Thread(target=consume, args=("a", qa))
+    tb = threading.Thread(target=consume, args=("b", qb))
+    ta.start(), tb.start()
+    ta.join(), tb.join()
+    counts = Counter(got["a"] + got["b"])
+    assert len(counts) == n, "records lost in the race"
+    assert max(counts.values()) == 1, "record delivered to both consumers"
+    assert qa.depth() == 0
+    # the old read cache is gone for good
+    assert not hasattr(qa, "_read_cache")
+
+
+# -- reclaim through the engine ------------------------------------------------
+
+def test_reclaim_preserves_trace_and_deadline(ctx):
+    """Satellite: trace_id and deadline_ns ride the record across a reclaim
+    — a redelivered expired record sheds at the deadline gate exactly like a
+    first delivery (error marker carries the ORIGINAL trace_id), and a live
+    one serves with its lineage intact."""
+    q = InProcQueue()
+    cin = InputQueue(q)
+    cin.enqueue_tensor("dead", np.ones(DIM, np.float32), timeout_s=0.05)
+    dead_trace = cin.last_trace_id
+    cin.enqueue_tensor("live", np.ones(DIM, np.float32), timeout_s=60.0)
+    live_trace = cin.last_trace_id
+    # a doomed replica claims both and vanishes without acking
+    claimed = dict(q.read_batch(10, timeout_s=0.01))
+    assert set(claimed) == {"dead", "live"}
+    assert claimed["live"]["trace_id"] == live_trace
+    assert "deadline_ns" in claimed["live"]
+
+    survivor = _serving(q, lease_s=0.06, reclaim_interval_s=0.01)
+    time.sleep(0.08)                       # lease expires; 'dead' also expires
+    while survivor.serve_once():
+        pass
+    res_dead = q.get_result("dead")
+    assert OutputQueue.is_deadline_exceeded(res_dead)
+    assert res_dead["trace_id"] == dead_trace   # lineage across the reclaim
+    res_live = q.get_result("live")
+    assert res_live is not None and not OutputQueue.is_error(res_live)
+    assert OutputQueue.deliveries(res_live) == 2
+    assert survivor.reclaimed == 2 and survivor.shed == 1
+    # both terminal: claims released, nothing left to churn
+    assert q.pending_count() == 0
+    # the reclaim + shed are correlatable in the trace
+    stages = survivor.tracer.stages_for(dead_trace)
+    assert "reclaim" in stages and "read" in stages
+
+
+def test_replay_preserves_trace_id(tmp_path):
+    """Satellite (dead-letter replay half): a replayed record keeps its
+    trace_id — the stale deadline is deliberately stripped (PR 2 contract),
+    the lineage is not."""
+    for q in (InProcQueue(), FileQueue(str(tmp_path / "q")),
+              RedisQueue(client=FakeRedis())):
+        q.put_error("fixme", "preprocess: transient",
+                    record={"uri": "fixme", "data": [1.0],
+                            "trace_id": "feedface00000001",
+                            "deadline_ns": 1})
+        out = q.replay_dead_letters()
+        assert out["replayed"] == ["fixme"], type(q).__name__
+        [(rid, rec)] = q.read_batch(5, timeout_s=0.01)
+        assert rid == "fixme"
+        assert rec["trace_id"] == "feedface00000001"
+        assert "deadline_ns" not in rec
+
+
+def test_duplicate_suppression_on_redelivery(ctx):
+    """A record whose result WAS written by the dead replica (but never
+    acked) must not be predicted again: the survivor acks it away and counts
+    a duplicate — the client keeps the original result."""
+    q = InProcQueue()
+    cin = InputQueue(q)
+    cin.enqueue_tensor("done", np.ones(DIM, np.float32))
+    cin.enqueue_tensor("lost", np.ones(DIM, np.float32))
+    claimed = q.read_batch(10, timeout_s=0.01)
+    assert len(claimed) == 2
+    # the dead replica got 'done' all the way to the result table...
+    q.put_result("done", {"value": [[0, 0.9]]})
+    # ...then died before acking either record
+    survivor = _serving(q, lease_s=0.02, reclaim_interval_s=0.01)
+    predicted = []
+    orig = survivor.model.do_predict
+
+    def counting_predict(x, *a, **kw):
+        predicted.append(len(x))
+        return orig(x, *a, **kw)
+
+    survivor.model.do_predict = counting_predict
+    time.sleep(0.03)
+    while survivor.serve_once():
+        pass
+    assert survivor.duplicates == 1 and survivor.reclaimed == 2
+    assert sum(predicted) == 1             # only 'lost' hit the device
+    assert q.get_result("done") == {"value": [[0, 0.9]]}   # untouched
+    res = q.get_result("lost")
+    assert res is not None and not OutputQueue.is_error(res)
+    assert OutputQueue.deliveries(res) >= 2
+    assert q.pending_count() == 0
+
+
+def test_quarantine_of_redelivered_record_carries_lineage(ctx):
+    """A reclaimed record that then poisons the pipeline dead-letters WITH
+    its claim lineage: delivery count and trace_id ride the entry."""
+    q = InProcQueue()
+    q.xadd({"uri": "bad", "b64": "!!!not-base64!!!", "dtype": "<f4",
+            "shape": [DIM], "trace_id": "deadbeef00000002"})
+    q.read_batch(10, timeout_s=0.01)       # doomed replica claims, dies
+    survivor = _serving(q, lease_s=0.02, reclaim_interval_s=0.01)
+    time.sleep(0.03)
+    while survivor.serve_once():
+        pass
+    [entry] = q.dead_letters()
+    assert entry["uri"] == "bad"
+    assert entry["trace_id"] == "deadbeef00000002"
+    assert entry["record"]["deliveries"] == 2
+    assert q.pending_count() == 0          # quarantine released the claim
+
+
+# -- per-replica identity, heartbeats, telemetry -------------------------------
+
+def test_replica_identity_heartbeat_and_metrics(ctx):
+    q = InProcQueue()
+    serving = _serving(q, replica_id="replica-7", http_port=0)
+    assert serving.replica_id == "replica-7"
+    assert q.consumer == "replica-7"       # claims are attributable
+    h = serving.health()
+    assert h["replica_id"] == "replica-7"
+    assert h["reclaimed"] == 0 and h["duplicates"] == 0
+    assert h["heartbeat_age_s"] >= 0
+    # day-one exposition: the failover series exist at zero
+    prom = serving.prom_metrics()
+    assert 'serving_reclaimed_total{backend="InProcQueue"} 0' in prom
+    assert "serving_duplicate_results_total 0" in prom
+    assert 'serving_heartbeat_age_seconds{replica="replica-7"}' in prom
+    serving.start()
+    try:
+        # probes name the replica that answered (readiness carries identity)
+        import urllib.request
+        url = serving._http.url
+        with urllib.request.urlopen(url + "/readyz", timeout=5) as r:
+            assert r.headers["X-Replica-Id"] == "replica-7"
+        rid = InputQueue(q).enqueue_tensor("r0", np.ones(DIM, np.float32))
+        assert OutputQueue(q).query(rid, timeout_s=15) is not None
+        # heartbeat is fresh while the read loop runs
+        age = float(serving.registry.get(
+            "serving_heartbeat_age_seconds").labels(
+                replica="replica-7").value)
+        assert age < 5.0
+    finally:
+        serving.shutdown()
+    # scale-down: the stopped replica's heartbeat series disappears instead
+    # of lingering as a frozen "perfectly fresh" age
+    assert "serving_heartbeat_age_seconds{replica=" \
+        not in serving.prom_metrics()
+
+
+def test_manager_metrics_prom_includes_reclaim_series(ctx, tmp_path, capsys):
+    """Satellite: the failover telemetry is visible via
+    `manager metrics --prom` (the daemon's own exposition endpoint)."""
+    from analytics_zoo_tpu.serving import manager
+
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    serving.start()
+    try:
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("data:\n  src: inproc\n"
+                       "params:\n  http_port: %d\n" % serving._http.port)
+        rc = manager.main(["metrics", "-c", str(cfg), "--prom",
+                           "--pidfile", str(tmp_path / "cs.pid")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "serving_reclaimed_total" in out
+        assert "serving_duplicate_results_total" in out
+        assert "serving_heartbeat_age_seconds" in out
+    finally:
+        serving.shutdown()
+
+
+# -- the failover acceptance scenario (ISSUE criteria) -------------------------
+
+def test_replica_failover_no_loss_no_duplicates(ctx):
+    """2 replicas + FakeRedis: replica A dies mid-stream (hard stop, claims
+    stranded un-acked), replica B reclaims the orphans within one lease
+    window — every record gets exactly ONE result (A+B served counts
+    partition the stream), the reclaim counter increments, A's readiness
+    flips while B stays ready."""
+    fake = FakeRedis()
+    qa, qb = RedisQueue(client=fake), RedisQueue(client=fake)
+    a = _serving(qa, replica_id="rep-a", lease_s=0.3, reclaim_interval_s=0.05)
+    b = _serving(qb, replica_id="rep-b", lease_s=0.3, reclaim_interval_s=0.05)
+    orig_predict = a.model.do_predict
+
+    def slow_predict(*args, **kw):
+        time.sleep(0.05)                   # keep claims in flight
+        return orig_predict(*args, **kw)
+
+    a.model.do_predict = slow_predict
+    client_q = RedisQueue(client=fake)
+    cin, cout = InputQueue(client_q), OutputQueue(client_q)
+    n = 24
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(n)]
+    a.start()
+    assert _wait(lambda: client_q.result_count() >= 4, 60), \
+        "replica A never started serving"
+    # SIGKILL analog: immediate stop, no drain — whatever A claimed but did
+    # not finish is stranded un-acked in the group's pending list
+    a.shutdown()
+    served_a = a.total_records
+    assert served_a < n, "A finished everything before the kill"
+    assert a.ready()["ready"] is False     # dead replica flips not-ready
+
+    b.start()
+    try:
+        got = cout.query_many(rids, timeout_s=60)
+        missing = [r for r, v in got.items() if v is None]
+        assert not missing, f"lost across failover: {missing}"
+        assert all(not OutputQueue.is_error(v) for v in got.values())
+        # exactly one result per record: the two replicas PARTITION the
+        # stream (suppressed redeliveries are counted, never re-served)
+        assert served_a + b.total_records == n
+        assert b.reclaimed >= 1, "survivor never reclaimed the orphans"
+        # failover-recovered results are visibly marked for the client
+        recovered = [r for r, v in got.items()
+                     if OutputQueue.deliveries(v) >= 2]
+        assert len(recovered) >= 1
+        assert b.reclaimed >= len(recovered)
+        assert b.ready()["ready"] is True  # survivor stayed ready
+        # claims fully released once everything is acked
+        assert _wait(lambda: qb.pending_count() == 0, 10)
+        h = b.health()
+        assert h["replica_id"] == "rep-b" and h["reclaimed"] == b.reclaimed
+    finally:
+        b.shutdown()
+
+
+# -- SIGKILL chaos over a real multi-process deployment ------------------------
+
+@pytest.mark.replicas
+def test_sigkill_replica_failover_filequeue(tmp_path):
+    """Chaos acceptance: two replica PROCESSES over one FileQueue spool,
+    SIGKILL one mid-stream.  Every enqueued record still resolves to exactly
+    one non-error result (orphans reclaimed within one lease window), no uri
+    is result-written twice (per-replica write logs), and the survivor's
+    reclaim counter incremented."""
+    qdir = str(tmp_path / "q")
+    q = FileQueue(qdir)
+    cin = InputQueue(q)
+    n = 60
+    rids = [f"r{i}" for i in range(n)]
+    for rid in rids:
+        cin.enqueue_tensor(rid, np.ones(DIM, np.float32))
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    worker = os.path.join(REPO, "tests", "replica_worker.py")
+
+    def spawn(name, slow):
+        return subprocess.Popen(
+            [sys.executable, worker, qdir, name, "--lease", "1.0",
+             "--reclaim-interval", "0.2", "--slow", str(slow)],
+            env=env, cwd=str(tmp_path))
+
+    def health(name):
+        try:
+            with open(os.path.join(qdir, f"{name}.health.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # the victim predicts slowly, so it reliably holds claims in flight;
+    # the survivor is fast enough to finish the stream afterwards
+    procs = {"victim": spawn("victim", slow=0.15),
+             "survivor": spawn("survivor", slow=0.01)}
+    try:
+        # wait until the victim is demonstrably serving mid-stream
+        assert _wait(lambda: (health("victim") or {}).get(
+            "total_records", 0) >= 1, 120, step=0.05), \
+            "victim replica never started serving"
+        assert q.result_count() < n, "stream finished before the kill"
+        os.kill(procs["victim"].pid, signal.SIGKILL)
+        procs["victim"].wait(timeout=30)
+
+        # the survivor reclaims the victim's orphans and finishes the stream
+        assert _wait(lambda: q.result_count() >= n, 120, step=0.05), \
+            f"only {q.result_count()}/{n} results after failover"
+        results = OutputQueue(q).dequeue(rids)
+        missing = [r for r in rids if results[r] is None]
+        assert not missing, f"lost: {missing}"
+        errs = [r for r in rids if OutputQueue.is_error(results[r])]
+        assert not errs, f"errored: {errs}"
+
+        # zero duplicate WRITES: each uri in at most one replica's write
+        # log, at most once (idempotent overwrite never even happened)
+        lines = []
+        for name in procs:
+            path = os.path.join(qdir, f"{name}.writes.log")
+            if os.path.exists(path):
+                with open(path) as f:
+                    lines.extend(f.read().split())
+        dupes = [u for u, c in Counter(lines).items() if c > 1]
+        assert not dupes, f"result written twice: {dupes}"
+
+        sh = health("survivor")
+        assert sh is not None and sh["reclaimed"] >= 1, \
+            f"survivor never reclaimed (health: {sh})"
+        assert sh["running"] is True
+        # all claims settled: nothing pending, nothing left in the stream
+        assert _wait(lambda: q.pending_count() == 0, 15)
+        assert q.depth() == 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+# -- manager supervisor: start --replicas / scale / respawn --------------------
+
+@pytest.mark.replicas
+def test_manager_replicas_supervisor_scale_and_respawn(tmp_path):
+    """`manager start --replicas 2` runs two supervised replica processes
+    over the shared FileQueue; SIGKILLing one gets it respawned; `manager
+    scale 1` drains the highest-numbered replica; `stop` tears everything
+    down."""
+    from test_serving_lifecycle import _write_zoo_model
+
+    weights, topo = _write_zoo_model(tmp_path)
+    qdir = tmp_path / "queue"
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"model:\n  path: {weights}\n  type: zoo\n  topology: {topo}\n"
+        f"data:\n  src: file:{qdir}\n"
+        "params:\n  batch_size: 2\n  lease_s: 1\n  reclaim_interval_s: 0.2\n")
+    pidfile = str(tmp_path / "cs.pid")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    mgr = [sys.executable, "-m", "analytics_zoo_tpu.serving.manager"]
+
+    def rpid(i):
+        try:
+            with open(f"{pidfile}.r{i}") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def alive(pid):
+        if pid is None:
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    proc = subprocess.Popen(
+        mgr + ["start", "-c", str(cfg), "--pidfile", pidfile,
+               "--replicas", "2", "--foreground"],
+        cwd=str(tmp_path), env=env)
+    try:
+        assert _wait(lambda: alive(rpid(0)) and alive(rpid(1)), 120,
+                     step=0.2), "replicas never came up"
+        # records flow through whichever replica claims them
+        client_q = FileQueue(str(qdir))
+        rid = InputQueue(client_q).enqueue_tensor("r0", np.ones(4, np.float32))
+        res = OutputQueue(client_q).query(rid, timeout_s=60)
+        assert res is not None and not OutputQueue.is_error(res)
+
+        r = subprocess.run(mgr + ["status", "--pidfile", pidfile],
+                           cwd=str(tmp_path), env=env,
+                           capture_output=True, text=True)
+        status = json.loads(r.stdout)
+        assert status["running"] is True
+        assert status["replicas"]["desired"] == 2
+        assert all(m["alive"] for m in status["replicas"]["members"].values())
+
+        # crash failover: SIGKILL replica 0 -> the supervisor respawns it
+        old = rpid(0)
+        os.kill(old, signal.SIGKILL)
+        assert _wait(lambda: alive(rpid(0)) and rpid(0) != old, 90,
+                     step=0.2), "killed replica was never respawned"
+
+        # scale down: replica 1 drains and is NOT respawned
+        r = subprocess.run(mgr + ["scale", "1", "--pidfile", pidfile],
+                           cwd=str(tmp_path), env=env,
+                           capture_output=True, text=True)
+        assert r.returncode == 0
+        assert json.loads(r.stdout) == {"replicas": 1}
+        pid1 = rpid(1)
+        assert _wait(lambda: not alive(pid1), 60, step=0.2), \
+            "scaled-down replica never exited"
+        time.sleep(2.0)                    # a respawn would land in here
+        assert not alive(rpid(1)) or rpid(1) == pid1
+        # the remaining replica still serves
+        rid2 = InputQueue(client_q).enqueue_tensor(
+            "r1", np.ones(4, np.float32))
+        res2 = OutputQueue(client_q).query(rid2, timeout_s=60)
+        assert res2 is not None and not OutputQueue.is_error(res2)
+    finally:
+        subprocess.run(mgr + ["stop", "--pidfile", pidfile],
+                       cwd=str(tmp_path), env=env, capture_output=True)
+        try:
+            proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    assert not os.path.exists(pidfile)
+
+
+# -- bench: the 1-vs-2 replica A/B harness -------------------------------------
+
+def test_bench_replicas_smoke(ctx, tmp_path):
+    """Satellite: `serving_bench.py --replicas 2` shares one queue across
+    two engines and reports per-replica served counts into --json."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serving_bench", os.path.join(REPO, "tools", "serving_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out_path = str(tmp_path / "bench.json")
+    out = mod.main(["--smoke", "--n", "48", "--replicas", "2",
+                    "--json", out_path])
+    assert out["records"] == 48 and out["errors"] == 0
+    assert out["replicas"] == 2
+    assert sum(out["served_per_replica"]) == 48
+    doc = json.load(open(out_path))
+    assert doc["results"][0]["served_per_replica"] == \
+        out["served_per_replica"]

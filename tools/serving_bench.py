@@ -12,7 +12,10 @@ inferred.
 
 Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
          [--wire f32|int8|jpeg-u8] [--max-batch N] [--max-wait-ms MS]
-         [--pre-workers N] [--inflight K]
+         [--pre-workers N] [--inflight K] [--replicas R]
+     python tools/serving_bench.py --replicas 2 --json two.json   # 1-vs-2
+         # replica A/B (PR 5): N engines share one queue via lease-based
+         # claiming; diff against a --replicas 1 run's --json document
      python tools/serving_bench.py --sweep 16,64,256   # batching sweep
      python tools/serving_bench.py --smoke             # tier-1 smoke check
      python tools/serving_bench.py --json results.json # machine-readable
@@ -99,13 +102,21 @@ def _run_once(im, args, batch_size):
     else:
         queue = InProcQueue()
     tb_dir = tempfile.mkdtemp(prefix="serving_tb_")
-    params = ServingParams(
-        batch_size=batch_size, top_n=5,
-        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        preprocess_workers=args.pre_workers,
-        inflight_batches=args.inflight)
-    serving = ClusterServing(im, queue, params=params,
-                             tensorboard_dir=tb_dir)
+
+    def _params(i):
+        return ServingParams(
+            batch_size=batch_size, top_n=5,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            preprocess_workers=args.pre_workers,
+            inflight_batches=args.inflight,
+            replica_id=f"bench-{i}")
+    # PR 5: N replica engines over ONE shared queue — the 1-vs-2 A/B that
+    # tells whether the workload scales horizontally or is queue-bound.
+    # Replicas after the first share the device but keep their own data
+    # plane (threads, batcher, registry), like N processes on one host.
+    servings = [ClusterServing(im, queue, params=_params(i),
+                               tensorboard_dir=tb_dir if i == 0 else None)
+                for i in range(max(1, args.replicas))]
     client_in, client_out = InputQueue(queue), OutputQueue(queue)
 
     # steady-state protocol: pre-fill the queue, then start the engine — a
@@ -114,7 +125,8 @@ def _run_once(im, args, batch_size):
     # relay) that has nothing to do with serving throughput
     uris = _enqueue(client_in, args, args.n)
     t0 = time.time()
-    serving.start()
+    for serving in servings:
+        serving.start()
     # PR 3 client path: one batched get_results round-trip per poll sweep
     # with backoff, instead of n per-id reads per sweep.  Quarantine error
     # markers are NOT results: a run where records failed must not report
@@ -124,8 +136,13 @@ def _run_once(im, args, batch_size):
                if r is not None and not OutputQueue.is_error(r)}
     errors = sum(1 for r in polled.values() if OutputQueue.is_error(r))
     dt = time.time() - t0
-    metrics = serving.metrics()
-    serving.shutdown()
+    # report the stage breakdown of the busiest replica (the representative
+    # hot path); per-replica served counts expose the sharing balance
+    primary = max(servings, key=lambda s: s.total_records)
+    metrics = primary.metrics()
+    served_per_replica = [s.total_records for s in servings]
+    for serving in servings:
+        serving.shutdown()
 
     scalars = read_scalars(tb_dir)
     tput = scalars.get("Serving Throughput", [])
@@ -138,6 +155,8 @@ def _run_once(im, args, batch_size):
         "queue": args.queue,
         "records": len(results),
         "errors": errors,
+        "replicas": max(1, args.replicas),
+        "served_per_replica": served_per_replica,
         "batch_size": batch_size,
         "max_batch": args.max_batch,
         "max_wait_ms": args.max_wait_ms,
@@ -182,6 +201,10 @@ def main(argv=None):
                     help="parallel preprocess pool size")
     ap.add_argument("--inflight", type=int, default=2,
                     help="async device pipeline depth (dispatched batches)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serving replicas over ONE shared queue (PR 5): "
+                         "the 1-vs-2 A/B for horizontal scaling — run once "
+                         "per count with --json and diff the documents")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
