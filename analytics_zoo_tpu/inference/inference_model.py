@@ -11,12 +11,24 @@ via the interop bridge — the TFNet analog), ONNX when available, and (b) **buc
 batching**: inputs are padded to the nearest power-of-two batch so a handful of compiled
 programs serve any request size (the serving-latency answer to the reference's per-core
 BLAS threading, SURVEY.md §7 hard-parts).
+
+Sharded multi-chip serving (PR 6): `shard()` places the parameters over a
+`data` x `model` device mesh once (ShardingPlan.shard) and commits every
+padded batch with a batch-axis NamedSharding before dispatch, so the GSPMD
+partitioner runs the SAME jitted program over all chips — batch-sharded for
+small models (replicated params), megatron tensor-sharded for large
+transformer stacks — and XLA overlaps the ICI transfers with compute.  The
+pow-2 buckets become mesh-aware: rounded up to a multiple of the batch-axis
+size so every device gets an equal slice and the compile cache stays one
+program per bucket.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -24,12 +36,25 @@ import numpy as np
 
 from analytics_zoo_tpu.nn.module import Layer
 
+logger = logging.getLogger(__name__)
 
-def _bucket(n: int, max_batch: int) -> int:
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (max(1, int(n)).bit_length() - 1)
+
+
+def _bucket(n: int, max_batch: int, multiple: int = 1) -> int:
+    """Power-of-two bucket for an n-row batch, rounded UP to a multiple of
+    `multiple` (the mesh batch-axis size) so padded batches shard evenly
+    over the data axis; `max_batch` is a pow-2 multiple of `multiple`
+    (InferenceModel clamps/validates), so buckets stay pow-2."""
     b = 1
     while b < n and b < max_batch:
         b *= 2
-    return min(b, max_batch)
+    b = min(b, max_batch)
+    if multiple > 1 and b % multiple != 0:
+        b = min(-(-b // multiple) * multiple, max(max_batch, multiple))
+    return b
 
 
 def _pad_to_bucket(xs: List[np.ndarray], scales, n: int, bucket: int):
@@ -71,8 +96,26 @@ class InferenceModel:
 
     def __init__(self, supported_concurrent_num: int = 2,
                  max_batch: int = 1024, registry=None):
-        self.max_batch = int(max_batch)
+        # the bucket ladder is pow-2 by contract: a non-pow-2 max_batch
+        # would add a non-pow-2 TERMINAL bucket (e.g. 100 after 64),
+        # silently doubling the compile-cache footprint per signature —
+        # clamp DOWN to the nearest power of two instead
+        mb = max(1, int(max_batch))
+        self.max_batch = _pow2_floor(mb)
+        if self.max_batch != mb:
+            logger.warning(
+                "InferenceModel: max_batch=%d is not a power of two; "
+                "clamping to %d so the bucket ladder stays pow-2 (a "
+                "non-pow-2 terminal bucket doubles the compile cache)",
+                mb, self.max_batch)
         self.concurrent_num = max(1, int(supported_concurrent_num))
+        # sharded multi-chip serving (PR 6): populated by shard()
+        self._mesh = None                 # jax.sharding.Mesh when sharded
+        self._plan = None                 # the params ShardingPlan in force
+        self._sharding_mode: Optional[str] = None   # batch|tensor|hybrid
+        self._batch_multiple = 1          # mesh data-axis size (bucket quantum)
+        self._sharded_calls = 0           # batches committed to the mesh
+        self._mesh_gauge = None           # (gauge, provider) registration
         self._predict_fn: Optional[Callable] = None
         self._params = None
         self._state = None
@@ -107,7 +150,8 @@ class InferenceModel:
     def _observe(self, method: str, n: int, dt_s: float) -> None:
         """Record one predict/dispatch call: wall latency and batch size,
         labeled by entry point (`do_predict` blocks on readback; `dispatch`
-        measures enqueue-to-device only)."""
+        measures enqueue-to-device only) and by the sharding mode in force
+        (`off` single-chip, `batch`/`tensor`/`hybrid` over the mesh)."""
         if self._obs is None:
             from analytics_zoo_tpu.common.observability import get_registry
             reg = self._obs_registry or get_registry()
@@ -115,24 +159,206 @@ class InferenceModel:
             self._obs = (
                 reg.histogram("inference_predict_seconds",
                               "Model predict/dispatch wall latency",
-                              labels=("method",)),
+                              labels=("method", "sharding")),
                 reg.histogram("inference_batch_size",
                               "Records per predict/dispatch call",
                               labels=("method",),
                               buckets=tuple(float(1 << i)
                                             for i in range(12))))
-        self._obs[0].labels(method=method).observe(dt_s)
+            # the mesh-devices provider holds only a WEAK ref to the model
+            # (models have no shutdown hook, and a registry — possibly the
+            # process-global one — must not keep a discarded model's params
+            # alive); the previous registration is dropped on re-bind so
+            # stale providers don't pile up in old registries
+            if self._mesh_gauge is not None:
+                old_gauge, old_fn = self._mesh_gauge
+                old_gauge.remove_function(old_fn)
+            self_ref = weakref.ref(self)
+
+            def _mesh_devices_provider() -> float:
+                model = self_ref()
+                return float(model.mesh_devices) if model is not None else 1.0
+
+            gauge = reg.gauge("inference_mesh_devices",
+                              "Devices in the serving mesh (1 = single-chip)")
+            gauge.set_function(_mesh_devices_provider)
+            self._mesh_gauge = (gauge, _mesh_devices_provider)
+        sharding = self._sharding_mode or "off"
+        self._obs[0].labels(method=method, sharding=sharding).observe(dt_s)
         self._obs[1].labels(method=method).observe(float(n))
+
+    # -- sharded multi-chip serving (PR 6 tentpole) ---------------------------
+    @property
+    def mesh_devices(self) -> int:
+        """Devices the sharded predict spans (1 = single-chip)."""
+        if self._mesh is None:
+            return 1
+        return int(np.prod(self._mesh.devices.shape))
+
+    def _mesh_matches(self, req) -> bool:
+        """Does a shard() mesh request describe the placement already in
+        force?  (int = device count, tuple = (data, model) axes, Mesh =
+        identity)."""
+        from jax.sharding import Mesh
+        if isinstance(req, Mesh):
+            return req is self._mesh
+        shape = self._mesh.shape
+        if isinstance(req, (tuple, list)):
+            return (len(req) == 2
+                    and int(req[0]) == int(shape.get("data", 1))
+                    and int(req[1]) == int(shape.get("model", 1)))
+        return int(req) == self.mesh_devices
+
+    def mesh_info(self) -> Dict:
+        """Mesh topology + structural-evidence counters (serving_bench A/B:
+        on CPU sim the win is asserted from these, not wall clock)."""
+        if self._mesh is None:
+            return {"devices": 1, "sharding": "off", "sharded_calls": 0}
+        return {"devices": self.mesh_devices,
+                "sharding": self._sharding_mode,
+                "axes": {k: int(v) for k, v in self._mesh.shape.items()},
+                "sharded_calls": self._sharded_calls}
+
+    def shard(self, mesh=None, sharding: str = "auto", plan=None):
+        """Route predict/dispatch through a sharded program over a device
+        mesh: parameters are placed ONCE (`ShardingPlan.shard`), every
+        padded batch is committed with a batch-axis `NamedSharding`, and the
+        jitted program partitions via GSPMD — batch-sharded for small models
+        (replicated params), megatron tensor-sharded for large transformer
+        stacks, `sharding="auto"` choosing by parameter count.
+
+        `mesh` may be None (all devices), an int (first N devices), a
+        `(data, model)` shape tuple (hybrid layouts), or a prebuilt
+        `jax.sharding.Mesh`.  Idempotent: a model already sharded keeps its
+        mesh (bench replicas share one model across N engines).  On CPU,
+        simulate with XLA_FLAGS=--xla_force_host_platform_device_count=N."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from analytics_zoo_tpu.parallel import sharding as shardlib
+        mode = sharding or "auto"
+        if mode == "off":
+            return self
+        if mode not in ("auto", "batch", "tensor"):
+            raise ValueError(f"sharding={mode!r}: expected one of "
+                             "auto|batch|tensor|off")
+        if self._jitted is None:
+            raise RuntimeError("load a model first")
+        if not hasattr(self._jitted, "lower"):
+            raise ValueError(
+                "sharded serving needs a jax-native model; bridge predict "
+                "functions (TF SavedModel via TFNet) cannot be partitioned")
+        if self._mesh is not None:
+            if mode not in ("auto", self._sharding_mode):
+                logger.warning(
+                    "InferenceModel: already sharded %s over %d devices; "
+                    "ignoring shard(sharding=%r) — one placement per load",
+                    self._sharding_mode, self.mesh_devices, mode)
+            elif mesh is not None and not self._mesh_matches(mesh):
+                logger.warning(
+                    "InferenceModel: already sharded over %d device(s) %s; "
+                    "ignoring the conflicting mesh=%r — one placement per "
+                    "load (re-load the model to re-shard)",
+                    self.mesh_devices, dict(self._mesh.shape), mesh)
+            return self
+        if isinstance(mesh, Mesh):
+            m = mesh
+        elif isinstance(mesh, (tuple, list)):
+            m = shardlib.serving_mesh(shape=tuple(mesh))
+        else:
+            if mode == "auto":
+                mode = shardlib.serving_mode_for(self._params)
+            m = shardlib.serving_mesh(n_devices=mesh, mode=mode)
+        dd = int(m.shape.get("data", 1))
+        mm = int(m.shape.get("model", 1))
+        if mode == "auto":
+            mode = "hybrid" if (dd > 1 and mm > 1) else \
+                ("tensor" if mm > 1 else "batch")
+        if dd > 1 and self.max_batch % dd != 0:
+            if isinstance(mesh, (Mesh, tuple, list)):
+                # the caller chose this layout explicitly: reject with an
+                # attainable fix (max_batch is pow-2 by construction, so
+                # "raise max_batch" can never make a non-pow-2 axis divide)
+                raise ValueError(
+                    f"mesh data axis {dd} does not divide max_batch="
+                    f"{self.max_batch}; choose a power-of-2 data axis")
+            # auto-built batch mesh over a non-pow-2 device count (3, 6,
+            # 12 chips): use the largest batch axis that divides the pow-2
+            # max_batch instead of refusing to shard at all
+            usable = min(_pow2_floor(dd), self.max_batch)
+            logger.warning(
+                "InferenceModel: %d visible device(s) do not divide the "
+                "pow-2 max_batch=%d; sharding over the largest usable "
+                "batch axis (%d device(s)) instead", dd, self.max_batch,
+                usable)
+            m = shardlib.serving_mesh(n_devices=usable, mode="batch")
+            dd, mm = usable, 1
+        if plan is None:
+            if mode == "batch":
+                # batch mode is an explicit contract: params replicated,
+                # ONLY the batch splits — even for models the auto
+                # heuristic would tensor-shard
+                plan = shardlib.replicated_plan()
+            else:
+                # tensor/hybrid: the caller (or auto's size gate) decided,
+                # so skip the parameter-count threshold
+                plan = shardlib.serving_plan(self._params, m,
+                                             min_tensor_params=0)
+                if not plan.rules:
+                    logger.warning(
+                        "InferenceModel: tensor sharding requested but no "
+                        "parameter leaf matches the megatron plan; params "
+                        "stay replicated (inputs still shard over the "
+                        "batch axis)")
+        self._params = plan.shard(self._params, m)
+        if self._state:
+            self._state = jax.tree.map(
+                lambda a: jax.device_put(a, NamedSharding(m, P())),
+                self._state)
+        self._mesh = m
+        self._plan = plan
+        self._sharding_mode = mode
+        self._batch_multiple = max(1, dd)
+        self._obs = None       # histogram children re-label with the mode
+        logger.info(
+            "InferenceModel: sharded predict enabled — mode=%s mesh=%dx%d "
+            "(data x model) over %d device(s)", mode, dd, mm,
+            self.mesh_devices)
+        return self
+
+    def _commit(self, xs: List, scales):
+        """Commit one padded batch (and its per-row scales) to the mesh with
+        the batch NamedSharding: device_put is asynchronous, so the ICI/PCIe
+        transfer of batch k+1 overlaps batch k's compute.  Single-chip mode
+        passes host arrays straight through (jit transfers them itself)."""
+        if self._mesh is None:
+            return xs, scales
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        m = self._mesh
+        xs = [jax.device_put(
+            a, NamedSharding(m, P("data", *([None] * (a.ndim - 1)))))
+            for a in xs]
+        if scales is not None:
+            # int8 wire path: the per-row dequant scales ride the same
+            # batch axis as their rows
+            scales = jax.device_put(scales, NamedSharding(m, P("data")))
+        self._sharded_calls += 1
+        return xs, scales
 
     # -- loaders --------------------------------------------------------------
     def do_load_model(self, model: Layer, params=None, state=None):
-        """Load an in-memory zoo layer/container (doLoadBigDL analog)."""
+        """Load an in-memory zoo layer/container (doLoadBigDL analog).
+        Re-loading resets any mesh placement — call `shard()` again for the
+        new weights."""
         self._model = model
         if params is None and hasattr(model, "_params"):
             params, state = model._params, model._state
         self._params, self._state = params, state
         self._jitted = jax.jit(
             lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        self._mesh = None
+        self._plan = None
+        self._sharding_mode = None
+        self._batch_multiple = 1
         return self
 
     def do_load(self, topology_builder: Callable[[], Layer], weights_path: str):
@@ -217,6 +443,11 @@ class InferenceModel:
         model = self._model
         self._jitted = jax.jit(
             lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        if self._mesh is not None:
+            # quantize rebuilt the params tree on host: re-place it under
+            # the plan already in force (leaves whose new shapes no longer
+            # divide fall back per _fit, with its one-time warning)
+            self._params = self._plan.shard(self._params, self._mesh)
         return self
 
     # -- async dispatch (serving hot path, PR 3) ------------------------------
@@ -256,8 +487,9 @@ class InferenceModel:
         n = xs[0].shape[0]
         if n > self.max_batch:
             return _LazyPending(lambda: self.do_predict(x, scales=scales))
-        bucket = _bucket(n, self.max_batch)
+        bucket = _bucket(n, self.max_batch, self._batch_multiple)
         xs, sc = _pad_to_bucket(xs, scales, n, bucket)
+        xs, sc = self._commit(xs, sc)
         if sc is not None:
             out = self._jitted_with_scales()(self._params, self._state,
                                              xs[0], sc)
@@ -328,11 +560,12 @@ class InferenceModel:
             i = 0
             while i < n:
                 take = min(step, n - i)
-                bucket = _bucket(take, self.max_batch)
+                bucket = _bucket(take, self.max_batch, self._batch_multiple)
                 chunk = [a[i:i + take] for a in xs]
                 chunk, schunk = _pad_to_bucket(
                     chunk, None if sc is None else sc[i:i + take],
                     take, bucket)
+                chunk, schunk = self._commit(chunk, schunk)
                 if schunk is not None:
                     pending.append((self._jitted_with_scales()(
                         self._params, self._state, chunk[0], schunk), take))

@@ -16,6 +16,7 @@ Axis names follow common/context.py: data / model / pipe / seq / expert.
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -23,7 +24,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from analytics_zoo_tpu.common.context import global_put
+from analytics_zoo_tpu.common.context import (DATA_AXIS, MODEL_AXIS,
+                                              global_put)
+
+logger = logging.getLogger(__name__)
 
 
 def leaf_paths(tree):
@@ -47,6 +51,10 @@ class ShardingPlan:
     def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         self.default = default
+        # one-time divisibility-fallback warnings (see _fit): a serving
+        # replica re-places params per engine, and a repeated warning per
+        # request would drown the log without adding information
+        self._warned: set = set()
 
     def spec_for(self, path: str, leaf=None) -> P:
         for pat, spec in self.rules:
@@ -63,7 +71,8 @@ class ShardingPlan:
         flat, treedef = jax.tree_util.tree_flatten(tree)
         placed = []
         for (path, leaf), _ in zip(pairs, flat):
-            spec = self._fit(self.spec_for(path, leaf), mesh, np.shape(leaf))
+            spec = self._fit(self.spec_for(path, leaf), mesh, np.shape(leaf),
+                             path=path)
             placed.append(global_put(leaf, NamedSharding(mesh, spec)))
         return jax.tree_util.tree_unflatten(treedef, placed)
 
@@ -72,20 +81,33 @@ class ShardingPlan:
         pairs = leaf_paths(tree)
         flat, treedef = jax.tree_util.tree_flatten(tree)
         out = [NamedSharding(mesh, self._fit(self.spec_for(p, l), mesh,
-                                             np.shape(l)))
+                                             np.shape(l), path=p))
                for (p, l) in pairs]
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    @staticmethod
-    def _fit(spec: P, mesh: Mesh, shape) -> P:
+    def _fit(self, spec: P, mesh: Mesh, shape, path: Optional[str] = None) -> P:
         """Drop axes missing from the mesh or sized 1; trim to leaf rank; drop axes
-        that don't divide the dimension evenly (GSPMD requires divisibility)."""
+        that don't divide the dimension evenly (GSPMD requires divisibility).
+
+        The divisibility fallback replicates THAT dimension and warns once
+        per (leaf, axis) instead of letting pjit raise mid-request: a plan
+        written for one model must degrade, not crash, when a leaf's
+        batch/feature dim doesn't split over the mesh axis."""
         rank = len(shape)
         parts = list(spec) + [None] * (rank - len(spec))
         fitted = []
         for dim, ax in zip(shape, parts[:rank]):
             n = mesh.shape.get(ax, 1) if ax is not None else 1
-            if ax is None or n == 1 or dim % n != 0:
+            if ax is None or n == 1:
+                fitted.append(None)
+            elif dim % n != 0:
+                key = (path, ax, dim, n)
+                if key not in self._warned:
+                    self._warned.add(key)
+                    logger.warning(
+                        "sharding plan: leaf %s dim %d is not divisible by "
+                        "mesh axis %r (size %d); replicating that dimension "
+                        "instead", path or "<unknown>", dim, ax, n)
                 fitted.append(None)
             else:
                 fitted.append(ax)
@@ -125,3 +147,75 @@ def data_parallel_batch(ctx, *arrays):
         out.append(jax.tree.map(
             lambda v: jax.device_put(v, ctx.data_sharding(np.ndim(v))), a))
     return out
+
+
+# -- serving-side plan selection (PR 6: sharded multi-chip serving) -----------
+
+# Below this many parameters, tensor parallelism costs more in per-layer
+# all-reduces than it buys in per-chip FLOPs at serving batch sizes: small
+# models replicate and shard the BATCH instead.  ~bert_base sits under it,
+# bert_large (340M) and up go tensor-parallel.
+SERVING_TP_MIN_PARAMS = 50_000_000
+
+
+def _param_count(params) -> int:
+    return int(sum(np.size(l) for l in jax.tree_util.tree_leaves(params)))
+
+
+def tensor_parallel_applicable(params) -> bool:
+    """True when at least one leaf of `params` matches a megatron_plan rule
+    (qkv/ffn/proj/embedding weights) — i.e. the model has transformer-ish
+    structure the tensor-parallel plan knows how to split."""
+    plan = megatron_plan()
+    return any(len(plan.spec_for(p, l)) > 0 for p, l in leaf_paths(params))
+
+
+def serving_mode_for(params,
+                     min_tensor_params: int = SERVING_TP_MIN_PARAMS) -> str:
+    """The `sharding=auto` heuristic: "batch" (replicated params, batch split
+    over the `data` axis) for small models, "tensor" (megatron_plan) for
+    large transformer-ish ones."""
+    if _param_count(params) >= min_tensor_params \
+            and tensor_parallel_applicable(params):
+        return "tensor"
+    return "batch"
+
+
+def serving_mesh(n_devices: Optional[int] = None, mode: str = "batch",
+                 devices: Optional[Sequence] = None,
+                 shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Build the 2-D serving mesh (axes `data` x `model`).  `mode="batch"`
+    lays all chips on the data axis, `mode="tensor"` on the model axis; an
+    explicit `shape=(dd, mm)` overrides both for hybrid layouts."""
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is not None:
+        dd, mm = int(shape[0]), int(shape[1])
+    else:
+        n = int(n_devices) if n_devices else len(devs)
+        dd, mm = (1, n) if mode == "tensor" else (n, 1)
+    need = dd * mm
+    if need > len(devs):
+        raise ValueError(
+            f"serving mesh {dd}x{mm} needs {need} devices, have {len(devs)} "
+            "(on CPU, simulate with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")
+    return Mesh(np.asarray(devs[:need]).reshape(dd, mm),
+                (DATA_AXIS, MODEL_AXIS))
+
+
+def serving_plan(model_or_params, mesh: Mesh,
+                 min_tensor_params: int = SERVING_TP_MIN_PARAMS
+                 ) -> ShardingPlan:
+    """Pick the parameter ShardingPlan for serving over `mesh`: replicate
+    small models (the engine batch-shards inputs over `data`), tensor-shard
+    large transformer blocks via megatron_plan when the mesh has a `model`
+    axis to put them on.  Accepts an InferenceModel/Layer or a raw params
+    pytree."""
+    params = getattr(model_or_params, "_params", None)
+    if params is None:
+        params = model_or_params
+    if mesh.shape.get(MODEL_AXIS, 1) > 1 \
+            and _param_count(params) >= min_tensor_params \
+            and tensor_parallel_applicable(params):
+        return megatron_plan()
+    return replicated_plan()

@@ -74,6 +74,16 @@ at-least-once delivery.  Each engine carries a `replica_id` (health doc,
 `X-Replica-Id` probe header, `serving_heartbeat_age_seconds{replica=}`
 gauge); `serving_reclaimed_total{backend=}` and
 `serving_duplicate_results_total` land in the same registry.
+
+Sharded multi-chip serving (PR 6): with `params.sharding != "off"` the
+engine shards its InferenceModel over a `data` x `model` device mesh at
+construction (`InferenceModel.shard`): params are placed once, every padded
+batch is committed with a batch-axis NamedSharding, and the SAME pipeline
+(dispatch -> writer `.result()`, drain, bisect, int8 wire with per-row
+scales) runs over all chips — the predict stage is the only thing that got
+wider.  `auto` batch-shards small models and megatron tensor-shards large
+transformer stacks; buckets round up to a multiple of the mesh batch axis
+so padded batches split evenly.
 """
 
 from __future__ import annotations
@@ -265,7 +275,9 @@ class ServingParams:
                  tracing: bool = True,
                  replica_id: Optional[str] = None,
                  lease_s: float = 30.0,
-                 reclaim_interval_s: Optional[float] = None):
+                 reclaim_interval_s: Optional[float] = None,
+                 mesh_shape=None,
+                 sharding: str = "off"):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -315,6 +327,13 @@ class ServingParams:
         self.replica_id = replica_id
         self.lease_s = lease_s
         self.reclaim_interval_s = reclaim_interval_s
+        # sharded multi-chip serving (PR 6): route predict through a pjit'd
+        # program over the ICI mesh.  `sharding`: off (single-chip, the
+        # default) | auto (batch-shard small models, tensor-shard large) |
+        # batch | tensor.  `mesh_shape`: None = all devices, int N = first
+        # N, or a (data, model) tuple for hybrid layouts.
+        self.mesh_shape = mesh_shape
+        self.sharding = str(sharding or "off")
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -352,7 +371,12 @@ class ServingParams:
                         else str(p["replica_id"])),
             lease_s=float(p.get("lease_s", 30.0)),
             reclaim_interval_s=(None if p.get("reclaim_interval_s") is None
-                                else float(p["reclaim_interval_s"])))
+                                else float(p["reclaim_interval_s"])),
+            mesh_shape=(None if p.get("mesh_shape") is None
+                        else tuple(int(v) for v in p["mesh_shape"])
+                        if isinstance(p["mesh_shape"], (list, tuple))
+                        else int(p["mesh_shape"])),
+            sharding=str(p.get("sharding", "off")))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -373,6 +397,13 @@ class ClusterServing:
         self.model = model
         self.queue = queue
         self.params = params or ServingParams()
+        # sharded multi-chip serving (PR 6): place the model over the mesh
+        # BEFORE any worker can dispatch — a bad mesh config fails
+        # construction, not a mid-stream request.  Idempotent for a model
+        # shared across engines (bench --replicas).
+        if self.params.sharding != "off" and isinstance(model, InferenceModel):
+            model.shard(mesh=self.params.mesh_shape,
+                        sharding=self.params.sharding)
         self.preprocess = preprocess
         self.postprocess = postprocess or (
             lambda p: default_postprocess(p, self.params.top_n))

@@ -42,6 +42,12 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # lease before another replica may
                                         # reclaim (> worst-case record time)
       reclaim_interval_s: null          # reclaim sweep period (null=lease/2)
+      sharding: off                     # multi-chip serving (PR 6): off |
+                                        # auto (batch-shard small models,
+                                        # tensor-shard large) | batch | tensor
+      mesh_shape: null                  # null = all devices, N = first N
+                                        # chips, [dd, mm] = hybrid data x
+                                        # model mesh layout
 
 CLI (used by scripts/cluster-serving/*.sh):
     python -m analytics_zoo_tpu.serving.manager start  [-c config.yaml]

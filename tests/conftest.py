@@ -41,12 +41,21 @@ def pytest_configure(config):
         "replicas: multi-process replica failover tests (SIGKILL + "
         "reclaim); carry a default 300 s SIGALRM budget so a wedged "
         "replica subprocess cannot stall tier-1")
+    config.addinivalue_line(
+        "markers",
+        "multichip: sharded multi-chip serving tests; self-spawn a "
+        "subprocess under XLA_FLAGS=--xla_force_host_platform_device_"
+        "count=N so the mesh path runs on CPU-only containers, with a "
+        "default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
 # build each) and then wait on kill/reclaim cycles: the default budget when
-# no explicit `timeout` mark is given
+# no explicit `timeout` mark is given.  multichip tests fork a fresh
+# interpreter that re-imports jax and compiles sharded programs — same class
+# of cost, same budget.
 REPLICAS_DEFAULT_TIMEOUT_S = 300.0
+MULTICHIP_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -59,11 +68,15 @@ def pytest_runtest_call(item):
     if not hasattr(signal, "SIGALRM"):
         return (yield)
     if marker is None:
-        # the `replicas` mark implies a budget of its own: multi-process
-        # kill tests must never hang tier-1 even without an explicit mark
-        if item.get_closest_marker("replicas") is None:
+        # the `replicas`/`multichip` marks imply a budget of their own:
+        # multi-process tests must never hang tier-1 even without an
+        # explicit mark
+        if item.get_closest_marker("replicas") is not None:
+            seconds = REPLICAS_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("multichip") is not None:
+            seconds = MULTICHIP_DEFAULT_TIMEOUT_S
+        else:
             return (yield)
-        seconds = REPLICAS_DEFAULT_TIMEOUT_S
     else:
         seconds = float(marker.args[0]) if marker.args \
             else float(marker.kwargs.get("seconds", 60))

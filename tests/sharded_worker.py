@@ -1,0 +1,120 @@
+"""Sharded-serving subprocess for the multichip equivalence tests
+(test_serving_sharded.py): runs in a FRESH interpreter so the parent can
+pin ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` in the child's
+environment — the env var must be set before the interpreter starts (this
+environment pre-imports jax at startup), which is why the test self-spawns
+instead of re-configuring in-process.
+
+Checks, on an N-device simulated CPU mesh:
+  - batch-sharded do_predict AND dispatch().result() are BITWISE equal to
+    the single-chip path for f32, including a padded (non-full) bucket;
+  - int8-wire records (per-row scales sharded alongside the batch) match
+    within quantization tolerance;
+  - tensor-sharded (megatron) transformer predict matches within float
+    tolerance (cross-chip partial-sum order differs, so not bitwise);
+  - structural evidence of the fan-out: the committed batch and the device
+    output both hold one shard per mesh device.
+
+Prints one JSON document on stdout; the parent asserts on it.
+
+Usage: python sharded_worker.py [--devices N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    doc = {"devices_visible": len(jax.devices())}
+    if len(jax.devices()) < args.devices:
+        doc["error"] = (
+            f"need {args.devices} devices, have {len(jax.devices())}; "
+            "spawn with XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{args.devices}")
+        print(json.dumps(doc))
+        return 1
+
+    from analytics_zoo_tpu.common.context import init_context
+    init_context(seed=42)
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    def mlp():
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(6,), name="swfc1"))
+        m.add(Dense(5, activation="softmax", name="swfc2"))
+        m.init_weights()
+        return m
+
+    g = np.random.default_rng(0)
+    model = mlp()
+    x = g.normal(size=(37, 6)).astype(np.float32)   # 37: padded final bucket
+    single = InferenceModel().do_load_model(model)
+    y_single = single.do_predict(x, batch_size=16)
+
+    sharded = InferenceModel().do_load_model(model)
+    sharded.shard(mesh=args.devices, sharding="batch")
+    y_sharded = sharded.do_predict(x, batch_size=16)
+    doc["f32_do_predict_bitwise"] = bool(np.array_equal(y_single, y_sharded))
+
+    handle = sharded.dispatch(x[:11])               # 11 -> padded bucket 16
+    doc["f32_dispatch_bitwise"] = bool(
+        np.array_equal(y_single[:11], handle.result()))
+
+    # structural fan-out evidence: one shard per device, batch split evenly
+    leaf = jax.tree_util.tree_leaves(handle._out)[0]
+    shard_devs = sorted(s.device.id for s in leaf.addressable_shards)
+    doc["per_device_shards"] = {
+        str(d): shard_devs.count(d) for d in set(shard_devs)}
+    doc["output_span_devices"] = len(set(shard_devs))
+    doc["mesh_info"] = sharded.mesh_info()
+
+    # int8 wire: compact rows + per-row scales sharded along the batch
+    q = g.integers(-127, 127, (9, 6)).astype(np.int8)
+    sc = g.uniform(0.01, 0.1, (9,)).astype(np.float32)
+    y_q = sharded.do_predict(q, scales=sc)
+    y_ref = single.do_predict(q.astype(np.float32) * sc[:, None])
+    doc["int8_max_err"] = float(np.abs(y_q - y_ref).max())
+    doc["int8_within_tolerance"] = bool(
+        np.allclose(y_q, y_ref, rtol=1e-5, atol=1e-6))
+
+    # tensor-sharded transformer (explicit mode: the model is small, the
+    # auto heuristic would batch-shard it)
+    from analytics_zoo_tpu.nn.layers.attention import TransformerLayer
+    t = TransformerLayer(vocab=64, hidden_size=32, n_block=2, n_head=2,
+                         seq_len=8, embedding_drop=0.0, attn_drop=0.0,
+                         resid_drop=0.0)
+    params, state = t.init(jax.random.PRNGKey(0), (8,))
+    ids = g.integers(0, 64, (6, 8)).astype(np.float32)
+    ts = InferenceModel().do_load_model(t, params, state)
+    y_t1 = ts.do_predict(ids)
+    tt = InferenceModel().do_load_model(t, params, state)
+    tt.shard(mesh=args.devices, sharding="tensor")
+    y_t2 = tt.do_predict(ids)
+    doc["tensor_mode"] = tt.mesh_info()["sharding"]
+    doc["tensor_sharded_param_leaves"] = sum(
+        1 for l in jax.tree_util.tree_leaves(tt._params)
+        if any(a is not None for a in getattr(l.sharding, "spec", ())))
+    doc["tensor_max_err"] = float(np.abs(y_t1 - y_t2).max())
+    doc["tensor_within_tolerance"] = bool(
+        np.allclose(y_t1, y_t2, rtol=2e-4, atol=2e-5))
+
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,16 @@ Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
      python tools/serving_bench.py --replicas 2 --json two.json   # 1-vs-2
          # replica A/B (PR 5): N engines share one queue via lease-based
          # claiming; diff against a --replicas 1 run's --json document
+     python tools/serving_bench.py --mesh 4 [--sharding auto|batch|tensor]
+         # sharded multi-chip A/B (PR 6): pjit predict over a 4-chip mesh
+         # vs a --mesh-less single-chip run.  On CPU the bench re-execs
+         # itself under XLA_FLAGS=--xla_force_host_platform_device_count=N
+         # when fewer devices are visible; there the win is STRUCTURAL
+         # (mesh_devices / sharded_calls / per-device split in --json) —
+         # wall-clock speedups only mean something on real multi-chip HW
+     python tools/serving_bench.py --model bert --seq 128 --mesh 4
+         # bert_large serving tokens/sec (scale down with --bert-blocks /
+         # --bert-hidden on CPU containers)
      python tools/serving_bench.py --sweep 16,64,256   # batching sweep
      python tools/serving_bench.py --smoke             # tier-1 smoke check
      python tools/serving_bench.py --json results.json # machine-readable
@@ -60,6 +70,21 @@ def _build_model(args):
                         input_shape=(args.image * args.image * 3,)))
         model.add(Dense(1000, activation="softmax"))
         model.init_weights()
+    elif args.model == "bert":
+        # bert_large serving shape (hidden 1024 / 24 blocks / 16 heads, the
+        # BENCH_r05 training config) — scale down with --bert-* on CPU
+        # containers where the full stack doesn't fit the time budget
+        import jax
+        from analytics_zoo_tpu.nn.layers.attention import BERT
+        net = BERT(vocab=30522, hidden_size=args.bert_hidden,
+                   n_block=args.bert_blocks, n_head=args.bert_heads,
+                   max_position_len=max(512, args.seq),
+                   intermediate_size=4 * args.bert_hidden,
+                   hidden_drop=0.0, attn_drop=0.0)
+        params, state = net.init(jax.random.PRNGKey(0), (args.seq,))
+        return InferenceModel(
+            supported_concurrent_num=max(2, args.inflight)) \
+            .do_load_model(net, params, state)
     else:
         from analytics_zoo_tpu.models.imageclassification import resnet
         model = resnet(args.depth, num_classes=1000)
@@ -73,6 +98,9 @@ def _enqueue(client_in, args, n):
     if args.smoke:
         x = g.random((16,), np.float32)
         return [client_in.enqueue_tensor(f"img-{i}", x) for i in range(n)]
+    if args.model == "bert":
+        ids = g.integers(0, 30522, (args.seq,)).astype(np.float32)
+        return [client_in.enqueue_tensor(f"tok-{i}", ids) for i in range(n)]
     if args.model == "mlp":
         img = g.random((args.image * args.image * 3,), np.float32)
     else:
@@ -102,6 +130,7 @@ def _run_once(im, args, batch_size):
     else:
         queue = InProcQueue()
     tb_dir = tempfile.mkdtemp(prefix="serving_tb_")
+    calls0 = im.mesh_info().get("sharded_calls", 0)   # per-run delta (sweep)
 
     def _params(i):
         return ServingParams(
@@ -109,12 +138,22 @@ def _run_once(im, args, batch_size):
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             preprocess_workers=args.pre_workers,
             inflight_batches=args.inflight,
-            replica_id=f"bench-{i}")
+            replica_id=f"bench-{i}",
+            # PR 6: sharded multi-chip predict — the engine places the
+            # model over the mesh at construction (idempotent across
+            # replicas/sweep runs sharing one model)
+            mesh_shape=args.mesh,
+            sharding=(args.sharding if args.mesh else "off"))
+    # a (T, H) sequence output has no top-N class distribution: summarize
+    # with the first token's mean activation so the result wire stays tiny
+    post = (lambda p: [[0, float(np.asarray(p)[0].mean())]]) \
+        if args.model == "bert" and not args.smoke else None
     # PR 5: N replica engines over ONE shared queue — the 1-vs-2 A/B that
     # tells whether the workload scales horizontally or is queue-bound.
     # Replicas after the first share the device but keep their own data
     # plane (threads, batcher, registry), like N processes on one host.
     servings = [ClusterServing(im, queue, params=_params(i),
+                               postprocess=post,
                                tensorboard_dir=tb_dir if i == 0 else None)
                 for i in range(max(1, args.replicas))]
     client_in, client_out = InputQueue(queue), OutputQueue(queue)
@@ -146,10 +185,13 @@ def _run_once(im, args, batch_size):
 
     scalars = read_scalars(tb_dir)
     tput = scalars.get("Serving Throughput", [])
+    minfo = im.mesh_info()
     out = {
         "model": ("mlp16-smoke" if args.smoke
                   else f"mlp-{args.image * args.image * 3}d"
                   if args.model == "mlp"
+                  else (f"bert-{args.bert_hidden}h{args.bert_blocks}L-"
+                        f"seq{args.seq}") if args.model == "bert"
                   else f"resnet{args.depth}-{args.image}px"),
         "wire": "f32" if args.smoke else args.wire,
         "queue": args.queue,
@@ -163,6 +205,18 @@ def _run_once(im, args, batch_size):
         "preprocess_workers": args.pre_workers,
         "inflight_batches": args.inflight,
         "wall_records_per_sec": round(args.n / dt, 1),
+        # sharded multi-chip A/B fields (PR 6).  On CPU sim the structural
+        # evidence (mesh_devices > 1, sharded_calls > 0, even per-device
+        # split) is the claim; wall-clock deltas only mean something on
+        # real multi-chip hardware
+        "mesh_devices": minfo["devices"],
+        "sharding": minfo["sharding"],
+        "sharded_calls": minfo.get("sharded_calls", 0) - calls0,
+        "sharded_samples_per_sec": (round(args.n / dt, 1)
+                                    if minfo["devices"] > 1 else None),
+        "tokens_per_sec": (round(args.n * args.seq / dt, 1)
+                           if args.model == "bert" and not args.smoke
+                           else None),
         "tb_throughput_mean": (round(float(np.mean([v for _, v in tput])), 1)
                                if tput else None),
         "tb_throughput_max": (round(float(np.max([v for _, v in tput])), 1)
@@ -181,11 +235,22 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--depth", type=int, default=50)
-    ap.add_argument("--model", choices=("resnet", "mlp"), default="resnet",
+    ap.add_argument("--model", choices=("resnet", "mlp", "bert"),
+                    default="resnet",
                     help="resnet: the reference protocol; mlp: a cheap "
                          "classifier over image-sized flat records, for "
                          "hosts whose device is too slow to expose the "
-                         "data plane (see --compute)")
+                         "data plane (see --compute); bert: bert_large-"
+                         "shaped encoder over token-id records (serving "
+                         "tokens/sec, the PR 6 sharded A/B workload)")
+    ap.add_argument("--seq", type=int, default=128,
+                    help="bert: tokens per record")
+    ap.add_argument("--bert-blocks", type=int, default=24,
+                    help="bert: encoder blocks (24 = bert_large)")
+    ap.add_argument("--bert-hidden", type=int, default=1024,
+                    help="bert: hidden size (1024 = bert_large)")
+    ap.add_argument("--bert-heads", type=int, default=16,
+                    help="bert: attention heads (16 = bert_large)")
     ap.add_argument("--wire", choices=("f32", "int8", "jpeg-u8"),
                     default="f32",
                     help="record wire format: raw f32 tensors, int8-"
@@ -205,6 +270,19 @@ def main(argv=None):
                     help="serving replicas over ONE shared queue (PR 5): "
                          "the 1-vs-2 A/B for horizontal scaling — run once "
                          "per count with --json and diff the documents")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="sharded multi-chip serving (PR 6): pjit predict "
+                         "over an N-device mesh; compare against a "
+                         "--mesh-less run.  On CPU with fewer visible "
+                         "devices the bench re-execs itself under "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N")
+    ap.add_argument("--sharding", choices=("auto", "batch", "tensor"),
+                    default="auto",
+                    help="plan selection when --mesh is set: auto picks "
+                         "batch-sharding (replicated params) for small "
+                         "models and megatron tensor-sharding for large "
+                         "transformer stacks")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -232,6 +310,34 @@ def main(argv=None):
         ap.error("--model mlp takes flat tensor records; the jpeg-u8 image "
                  "wire decodes to (H, W, 3) and cannot feed it — use "
                  "--wire f32|int8 or --model resnet")
+    if args.model == "bert" and args.wire != "f32":
+        ap.error("--model bert takes token-id records; only --wire f32 "
+                 "applies")
+
+    if args.mesh:
+        import jax
+        if len(jax.devices()) < args.mesh:
+            # re-exec ONLY for CLI runs (argv is None => invoked via
+            # sys.argv): a library caller passing argv must get a
+            # catchable SystemExit, not have its whole process replaced
+            if argv is None and jax.default_backend() == "cpu" \
+                    and not os.environ.get("_SERVING_BENCH_RESPAWNED"):
+                # the device-count flag must predate jax's import (this
+                # environment pre-imports jax at interpreter startup), so
+                # simulate the mesh by re-exec'ing with it in the env
+                env = dict(os.environ)
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={args.mesh}")
+                env["_SERVING_BENCH_RESPAWNED"] = "1"
+                env.setdefault("JAX_PLATFORMS", "cpu")
+                os.execve(sys.executable,
+                          [sys.executable, os.path.abspath(__file__)]
+                          + sys.argv[1:], env)
+            ap.error(f"--mesh {args.mesh} needs {args.mesh} devices, have "
+                     f"{len(jax.devices())} (on CPU, run the CLI directly "
+                     "or set XLA_FLAGS=--xla_force_host_platform_device_"
+                     f"count={args.mesh})")
 
     from analytics_zoo_tpu.common import dtypes
     if args.compute == "bf16":
