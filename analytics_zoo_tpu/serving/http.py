@@ -1,9 +1,11 @@
-"""Serving availability probes — stdlib HTTP endpoint (PR 2 tentpole).
+"""Serving HTTP layer — availability probes (PR 2) + ingestion gateway (PR 7).
 
-The reference's Cluster Serving relied on the Spark UI + lifecycle scripts
-for operational visibility; a TPU-native deployment sits behind a k8s-style
-orchestrator that speaks HTTP probes.  `HealthServer` exposes the engine's
-existing health surface on three routes:
+The reference platform ships an HTTP front-end for Cluster Serving
+(serving/http — POST a record, GET the prediction) so NON-PYTHON clients
+can submit work; until PR 7 this server only exposed probes.  `HealthServer`
+now carries both surfaces:
+
+Probes (PR 2/4/5):
 
 - ``GET /healthz``  — liveness: 200 while the engine's workers are running
   (or restarting under supervision), 503 once a worker is FAILED past its
@@ -13,22 +15,42 @@ existing health surface on three routes:
 - ``GET /readyz``   — readiness: 200 only when the engine can take traffic
   (workers alive, breakers not open, queue depth under the admission
   threshold, backend reachable, not draining).  503 with
-  ``{"ready": false, "reasons": [...]}`` otherwise — ``"draining"`` during
-  graceful shutdown so load balancers stop routing before the process exits.
-- ``GET /metrics``  — JSON counters: ``served``, ``quarantined``, ``shed``
-  (deadline-exceeded), ``restarts``, ``queue_depth``, ``dead_letters``,
-  ``breaker_trips``, plus (PR 3) ``stages`` — per-stage timing
-  (read / preprocess / stage_wait / predict / write / e2e, each with
-  count + p50/p99 ms) — and ``latency_ms`` (end-to-end p50/p99).
-  With ``?format=prom`` — or an ``Accept`` header asking for
-  ``text/plain`` and not JSON — the SAME registry renders as Prometheus
-  text exposition format v0.0.4 (PR 4), scrape-ready:
-  ``serving_stage_seconds_bucket{stage="predict",le="0.05"} ...``.  The
-  default JSON document is unchanged, so PR 2/3 consumers keep working.
+  ``{"ready": false, "reasons": [...]}`` otherwise.
+- ``GET /metrics``  — JSON counters (PR 2/3 document, unchanged); with
+  ``?format=prom`` or a text/plain Accept header, the Prometheus text
+  exposition v0.0.4 of the engine's registry (PR 4).
 
-Every response carries an ``X-Replica-Id`` header (PR 5): with N serving
-replicas behind one load balancer, a probe flip is attributable to the
-replica that answered without parsing the body.
+Ingestion gateway (PR 7 tentpole — any client, any language):
+
+- ``POST /v1/enqueue`` — submit one record.  Content-Type negotiated:
+  ``application/octet-stream`` is a BINARY FRAME (serving/wire.py layout —
+  build it in any language: magic ``AZ`` + version + flags + u32 header
+  length + header JSON + raw little-endian payload), validated at the edge
+  (malformed -> 400, never enqueued); anything JSON-ish is the legacy
+  record dict (``{"uri", "b64", "dtype", "shape"}``) for curl-from-anywhere
+  ergonomics.  The gateway issues a ``trace_id`` at ingest when the record
+  carries none, and ``?timeout_s=S`` stamps the end-to-end ``deadline_ns``
+  AT THE EDGE so deadline shedding covers HTTP traffic too.  Admission is
+  enforced here: a full queue answers **429** (`Retry-After` hint), a
+  draining queue **503** — the flood never reaches the backend unbounded.
+  Reply: ``{"uri", "trace_id", "deadline_ns"?}``.
+- ``GET /v1/result/<uri>`` — fetch the prediction.  ``?timeout_s=S`` long-
+  polls (bounded by ``LONGPOLL_CAP_S``) with backoff until the result
+  lands; a miss answers 404 ``{"ready": false}`` so pollers can
+  distinguish "not yet" from a transport error.  Error results (quarantine
+  / deadline-shed markers) return 200 with the ``{"error": ...}`` body —
+  terminal state, not a gateway failure.
+
+Per-endpoint telemetry rides the engine's PR 4 registry:
+``gateway_request_seconds{endpoint=}`` and
+``gateway_request_bytes{endpoint=}`` histograms, scrape-ready next to the
+serving stage metrics.
+
+Every response carries an ``X-Replica-Id`` header (PR 5); with N replicas
+under the manager supervisor each replica's gateway listens on
+``http_port + i``, so the ingest surface scales (and fails over) with the
+replicas themselves.  ``ServingParams.gateway=False`` strips the /v1 routes
+for deployments that want probe-only ports.
 
 Zero dependencies: `ThreadingHTTPServer` on a daemon thread, started by
 ``ClusterServing.start()`` when ``ServingParams.http_port`` is set (0 picks
@@ -41,14 +63,21 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 logger = logging.getLogger(__name__)
 
+# long-poll ceiling for GET /v1/result: bounds worker-thread occupancy per
+# hanging client (ThreadingHTTPServer spawns one thread per request)
+LONGPOLL_CAP_S = 30.0
+# largest accepted request body; a frame bigger than this answers 413
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
 
 class HealthServer:
-    """Liveness/readiness/metrics probes over a serving engine."""
+    """Probes + ingestion gateway over a serving engine."""
 
     def __init__(self, serving, host: str = "127.0.0.1", port: int = 0):
         self.serving = serving
@@ -56,11 +85,41 @@ class HealthServer:
         self.port = port                    # actual port after start()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # gateway telemetry (PR 7) in the engine's PR 4 registry; guarded —
+        # exotic servings (tests wrapping a stub) may lack a registry
+        self._lat = self._bytes = None
+        registry = getattr(serving, "registry", None)
+        if registry is not None:
+            self._lat = registry.histogram(
+                "gateway_request_seconds",
+                "Gateway request latency, by endpoint",
+                labels=("endpoint",))
+            self._bytes = registry.histogram(
+                "gateway_request_bytes",
+                "Gateway request/response body bytes, by endpoint",
+                labels=("endpoint",),
+                buckets=(64, 256, 1024, 4096, 16384, 65536, 262144,
+                         1048576, 4194304, 16777216))
+
+    def _observe(self, endpoint: str, t0: float, nbytes: int) -> None:
+        if self._lat is not None:
+            self._lat.labels(endpoint=endpoint).record(
+                time.monotonic() - t0)
+            self._bytes.labels(endpoint=endpoint).record(nbytes)
 
     def start(self) -> "HealthServer":
         serving = self.serving
+        gateway = self
+        gateway_on = bool(getattr(
+            getattr(serving, "params", None), "gateway", True))
 
         class _Handler(BaseHTTPRequestHandler):
+            # socket timeout for request-line/header/BODY reads: a client
+            # that declares Content-Length and under-sends must not pin a
+            # handler thread forever (the long-poll loop sleeps server-side
+            # and is bounded separately by LONGPOLL_CAP_S)
+            timeout = 30
+
             def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
                 logger.debug("probe: " + fmt, *args)
 
@@ -72,14 +131,17 @@ class HealthServer:
                 if replica:
                     self.send_header("X-Replica-Id", str(replica))
 
-            def _reply(self, status: int, doc) -> None:
+            def _reply(self, status: int, doc, extra_headers=()) -> int:
                 body = json.dumps(doc).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
                 self._replica_header()
                 self.end_headers()
                 self.wfile.write(body)
+                return len(body)
 
             def _reply_text(self, status: int, text: str,
                             content_type: str) -> None:
@@ -102,6 +164,29 @@ class HealthServer:
                 return ("text/plain" in accept
                         and "application/json" not in accept)
 
+            @staticmethod
+            def _uri_ok(uri: str) -> bool:
+                """Edge validation for client-controlled uris: FileQueue
+                joins the uri into filesystem paths (results/<uri>.json,
+                stream spool names), so a traversal-shaped uri must never
+                reach the backend.  Native clients are trusted code; the
+                gateway is the first surface exposing uri to REMOTE
+                callers."""
+                return (bool(uri) and len(uri) <= 256
+                        and not any(c in uri for c in "/\\\x00")
+                        and uri not in (".", ".."))
+
+            @staticmethod
+            def _query_float(query: str, key: str) -> Optional[float]:
+                from urllib.parse import parse_qs
+                raw = (parse_qs(query).get(key) or [None])[0]
+                if raw is None:
+                    return None
+                try:
+                    return float(raw)
+                except ValueError:
+                    return None
+
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 from urllib.parse import urlsplit
                 parts = urlsplit(self.path)
@@ -120,10 +205,160 @@ class HealthServer:
                                              MetricsRegistry.CONTENT_TYPE)
                         else:
                             self._reply(200, serving.metrics())
+                    elif gateway_on and \
+                            parts.path.startswith("/v1/result/"):
+                        self._get_result(parts)
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except Exception as e:  # noqa: BLE001 — probe must answer
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _get_result(self, parts) -> None:
+                """GET /v1/result/<uri>[?timeout_s=S] — long-poll the
+                result table with backoff; bounded by LONGPOLL_CAP_S."""
+                from urllib.parse import unquote
+                t0 = time.monotonic()
+                nbytes = 0
+                # every exit — hit, miss, rejection, or failure — lands in
+                # the endpoint histograms: rejected/failed traffic is
+                # exactly what they exist to attribute
+                try:
+                    uri = unquote(parts.path[len("/v1/result/"):])
+                    if not self._uri_ok(uri):
+                        nbytes = self._reply(400, {"error": "invalid uri"})
+                        return
+                    timeout_s = self._query_float(parts.query,
+                                                  "timeout_s") or 0.0
+                    deadline = t0 + min(max(timeout_s, 0.0),
+                                        LONGPOLL_CAP_S)
+                    poll = 0.01
+                    while True:
+                        res = serving.queue.get_result(uri)
+                        if res is not None:
+                            nbytes = self._reply(200, res)
+                            return
+                        now = time.monotonic()
+                        if now >= deadline:
+                            break
+                        time.sleep(min(poll, deadline - now))
+                        poll = min(poll * 1.5, 0.25)
+                    nbytes = self._reply(404, {"ready": False, "uri": uri})
+                finally:
+                    gateway._observe("result", t0, nbytes)
+
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                from urllib.parse import urlsplit
+                parts = urlsplit(self.path)
+                if not (gateway_on and parts.path == "/v1/enqueue"):
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    self._enqueue(parts)
+                except Exception as e:  # noqa: BLE001 — gateway must answer
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _enqueue(self, parts) -> None:
+                """POST /v1/enqueue[?timeout_s=S] — binary frame or JSON
+                record, edge validation + admission + trace/deadline
+                stamping."""
+                from analytics_zoo_tpu.common.observability import \
+                    new_trace_id
+                from analytics_zoo_tpu.serving import wire as _wire
+                from analytics_zoo_tpu.serving.queues import (QueueClosed,
+                                                              QueueFull)
+                t0 = time.monotonic()
+                length = 0
+                # every exit path — accept, reject, malformed, failure —
+                # lands in the endpoint histograms (rejected traffic is
+                # exactly what they exist to attribute)
+                try:
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        length = 0
+                    if length <= 0:
+                        self._reply(411,
+                                    {"error": "Content-Length required"})
+                        return
+                    if length > MAX_BODY_BYTES:
+                        self._reply(413,
+                                    {"error": f"body {length} bytes > "
+                                              f"cap {MAX_BODY_BYTES}"})
+                        return
+                    body = self.rfile.read(length)
+                    timeout_s = self._query_float(parts.query, "timeout_s")
+                    deadline_ns = (time.time_ns() + int(timeout_s * 1e9)
+                                   if timeout_s else None)
+                    ctype = (self.headers.get("Content-Type")
+                             or "").lower()
+                    binary = "octet-stream" in ctype \
+                        or _wire.is_frame(body)
+                    trace_id = new_trace_id()
+                    if binary:
+                        try:
+                            # edge validation: a malformed frame is
+                            # rejected HERE with the reason, never
+                            # enqueued to poison the stream; restamp
+                            # issues the ingest trace_id / edge deadline
+                            # without clobbering client-set ones
+                            frame, header = \
+                                _wire.restamp_frame_with_header(
+                                    body, trace_id=trace_id,
+                                    deadline_ns=deadline_ns)
+                        except _wire.FrameError as e:
+                            self._reply(400, {"error": f"malformed "
+                                                       f"frame: {e}"})
+                            return
+                        record, uri = frame, header["uri"]
+                        trace_id = header.get("trace_id", trace_id)
+                        deadline_ns = header.get("deadline_ns")
+                    else:
+                        try:
+                            record = json.loads(body)
+                        except ValueError as e:
+                            self._reply(400,
+                                        {"error": f"body is neither a "
+                                                  f"binary frame nor "
+                                                  f"JSON: {e}"})
+                            return
+                        if not isinstance(record, dict) or \
+                                not record.get("uri"):
+                            self._reply(400,
+                                        {"error": "JSON record must be "
+                                                  "an object with a "
+                                                  "'uri'"})
+                            return
+                        record.setdefault("trace_id", trace_id)
+                        trace_id = record["trace_id"]
+                        if deadline_ns is not None:
+                            record.setdefault("deadline_ns", deadline_ns)
+                        uri, deadline_ns = record["uri"], \
+                            record.get("deadline_ns")
+                    if not self._uri_ok(str(uri)):
+                        # FileQueue joins the uri into filesystem paths;
+                        # a traversal-shaped uri from an untrusted remote
+                        # client must never reach the backend
+                        self._reply(400, {"error": "invalid uri"})
+                        return
+                    try:
+                        serving.queue.xadd(record)
+                    except QueueClosed as e:
+                        # draining: mirror /readyz — stop sending here
+                        self._reply(503, {"error": str(e)},
+                                    extra_headers=(("Retry-After", "5"),))
+                    except QueueFull as e:
+                        # admission at the edge: shed the flood with
+                        # backoff advice instead of growing the queue
+                        # unboundedly
+                        self._reply(429, {"error": str(e)},
+                                    extra_headers=(("Retry-After", "1"),))
+                    else:
+                        doc = {"uri": uri, "trace_id": trace_id}
+                        if deadline_ns is not None:
+                            doc["deadline_ns"] = int(deadline_ns)
+                        self._reply(200, doc)
+                finally:
+                    gateway._observe("enqueue", t0, length)
 
         self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
         self._server.daemon_threads = True
@@ -131,8 +366,10 @@ class HealthServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="serving-probes", daemon=True)
         self._thread.start()
-        logger.info("serving probes on http://%s:%d/{healthz,readyz,metrics}",
-                    self.host, self.port)
+        logger.info(
+            "serving http on http://%s:%d/{healthz,readyz,metrics%s}",
+            self.host, self.port,
+            ",v1/enqueue,v1/result" if gateway_on else "")
         return self
 
     def stop(self, timeout: float = 2.0) -> None:

@@ -30,6 +30,16 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
       breaker_cooldown_s: 0.5
       http_port: null                   # availability (PR 2): /healthz,
       http_host: 127.0.0.1              # /readyz, /metrics probe endpoint
+      gateway: true                     # ingestion gateway (PR 7): serve
+                                        # POST /v1/enqueue + GET
+                                        # /v1/result/<uri> on the probe
+                                        # port (binary frame or JSON,
+                                        # 429/503 at the edge).  Under
+                                        # --replicas the gateway rides each
+                                        # replica (port http_port + i), so
+                                        # ingest fails over with the
+                                        # supervisor.  false = probe-only
+                                        # port
       drain_s: null                     # graceful-drain budget on SIGTERM
       ready_queue_depth: null           # /readyz depth threshold
       max_batch: null                   # throughput (PR 3): adaptive batcher

@@ -56,6 +56,21 @@ redelivered records that already have a result, so the client-visible
 contract stays "exactly one result per record".  The lease must exceed the
 worst-case single-record service time, or a replica's own slow in-flight
 work gets re-claimed out from under it (same caveat as any lease system).
+
+Binary wire format (PR 7 tentpole): ``xadd`` accepts a BINARY FRAME
+(``serving/wire.py`` — magic + version + length-prefixed header JSON + raw
+tensor payload) alongside the legacy record dict, and every backend carries
+it natively: InProcQueue passes the frame buffer by reference (the
+consumer's payload view aliases the producer's bytes), FileQueue spools the
+frame verbatim as ``<seq>-<rid>.bin`` (no JSON round-trip), RedisQueue
+ships it as raw stream-field bytes.  ``read_batch`` hands the engine a
+record DICT either way — frames are decoded at the consume boundary into
+``{uri, trace_id, deadline_ns, dtype, shape, payload: memoryview, ...}`` —
+so the lease/ack/reclaim/dead-letter machinery above is format-blind, and
+legacy base64-JSON records already sitting in a queue keep decoding
+unchanged through an upgrade.  A malformed frame (bad magic, truncation,
+payload-length mismatch) quarantines ALONE, exactly like a malformed JSON
+entry.
 """
 
 from __future__ import annotations
@@ -68,9 +83,22 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from analytics_zoo_tpu.serving import wire as _wire
 
 logger = logging.getLogger(__name__)
+
+# what xadd accepts: a legacy record dict, or a binary frame buffer
+Record = Union[Dict, bytes, bytearray, memoryview]
+
+
+def _frame_rid(frame) -> str:
+    """Record id for a binary frame: the header's uri (raises FrameError on
+    a malformed frame — producers get a typed rejection at enqueue, the
+    queue never stores a frame it cannot identify)."""
+    uri = _wire.decode_header(frame).get("uri")
+    return str(uri) if uri else str(uuid.uuid4())
 
 
 class QueueFull(RuntimeError):
@@ -327,7 +355,10 @@ def _dead_letter_entry(key: str, error: str, record: Optional[Dict],
                        trace_id: Optional[str] = None) -> Dict:
     entry = {"uri": key, "error": str(error)}
     if record is not None:
-        entry["record"] = record
+        # binary records carry a memoryview payload: re-encode it as b64 so
+        # the entry is JSON-serializable on every backend AND replayable
+        # through the legacy decode path
+        entry["record"] = _wire.sanitize_record(record)
     tid = trace_id or (record or {}).get("trace_id")
     if tid is not None:
         entry["trace_id"] = tid
@@ -357,7 +388,11 @@ class InProcQueue(BaseQueue):
         self.max_depth = max_depth
 
     def xadd(self, record):
-        rid = record.get("uri") or str(uuid.uuid4())
+        # binary frame: identified by its header uri, stored AS the buffer
+        # (passed by reference — the consumer's payload view aliases these
+        # very bytes, zero queue-side copies)
+        rid = _frame_rid(record) if not isinstance(record, dict) \
+            else (record.get("uri") or str(uuid.uuid4()))
         with self._lock:
             # admission check INSIDE the append's critical section so
             # concurrent producers cannot both pass at depth == cap - 1
@@ -378,14 +413,27 @@ class InProcQueue(BaseQueue):
         deadline = time.time() + timeout_s
         out = []
         while len(out) < max_items:
+            raw = []
             with self._lock:
-                while self._stream and len(out) < max_items:
-                    rid, rec = self._stream.popleft()
+                while self._stream and len(raw) + len(out) < max_items:
+                    raw.append(self._stream.popleft())
+            for rid, rec in raw:
+                if not isinstance(rec, dict):
+                    # binary frame: decode at the consume boundary; the
+                    # payload memoryview aliases the producer's buffer
+                    # (by-reference hand-off, no copy)
+                    try:
+                        rec = _wire.frame_to_record(rec)
+                    except _wire.FrameError as e:
+                        self.put_error(rid, f"read_batch: malformed "
+                                            f"frame: {e}")
+                        continue
+                with self._lock:
                     self._pending[rid] = {"record": rec,
                                           "claim_ts": time.monotonic(),
                                           "consumer": self.consumer,
                                           "deliveries": 1}
-                    out.append((rid, rec))
+                out.append((rid, rec))
             if out or time.time() > deadline:
                 break
             time.sleep(0.005)
@@ -502,9 +550,14 @@ class FileQueue(BaseQueue):
         self._claims: Dict[str, str] = {}
         self._claims_lock = threading.Lock()
 
+    # stream entries: legacy JSON records spool as .json, binary frames
+    # (PR 7) spool verbatim as .bin — one file either way, same claim and
+    # lease machinery
+    _STREAM_EXTS = (".json", ".bin")
+
     def depth(self):
         return sum(1 for f in os.listdir(self.stream_dir)
-                   if f.endswith(".json"))
+                   if f.endswith(self._STREAM_EXTS))
 
     def reachable(self):
         return os.path.isdir(self.stream_dir)
@@ -530,19 +583,33 @@ class FileQueue(BaseQueue):
 
     def xadd(self, record):
         self._check_admission()
-        rid = record.get("uri") or str(uuid.uuid4())
         seq = f"{time.time_ns()}"
+        if not isinstance(record, dict):
+            # binary frame: spooled verbatim — the payload bytes hit disk
+            # once, with no JSON/base64 round-trip
+            frame = bytes(record) if not isinstance(record, bytes) \
+                else record
+            rid = _frame_rid(frame)
+            tmp = os.path.join(self.stream_dir, f".{seq}-{rid}.tmp")
+            dst = os.path.join(self.stream_dir, f"{seq}-{rid}.bin")
+            with open(tmp, "wb") as f:
+                f.write(frame)
+            _wire.COPY_STATS.record("spool_write", len(frame))
+            os.rename(tmp, dst)
+            return rid
+        rid = record.get("uri") or str(uuid.uuid4())
         tmp = os.path.join(self.stream_dir, f".{seq}-{rid}.tmp")
         dst = os.path.join(self.stream_dir, f"{seq}-{rid}.json")
         with open(tmp, "w") as f:
             json.dump(record, f)
+            _wire.COPY_STATS.record("spool_write", f.tell())
         os.rename(tmp, dst)
         return rid
 
     @staticmethod
     def _rid_of(orig_name: str) -> str:
-        return orig_name.split("-", 1)[1][:-5] if "-" in orig_name \
-            else orig_name
+        stem = os.path.splitext(orig_name)[0]
+        return stem.split("-", 1)[1] if "-" in stem else stem
 
     def _claim_name(self, orig_name: str, deliveries: int) -> str:
         # dots delimit the claim metadata, so the consumer id must not
@@ -558,11 +625,19 @@ class FileQueue(BaseQueue):
         re-parsed every reclaim sweep forever."""
         rid = self._rid_of(orig_name)
         try:
-            with open(claim_path) as f:
-                rec = json.load(f)
+            if orig_name.endswith(".bin"):
+                # binary frame: one read, decoded at the consume boundary
+                with open(claim_path, "rb") as f:
+                    frame = f.read()
+                _wire.COPY_STATS.record("spool_read", len(frame))
+                rec = _wire.frame_to_record(frame)
+            else:
+                with open(claim_path) as f:
+                    rec = json.load(f)
+                    _wire.COPY_STATS.record("spool_read", f.tell())
         except FileNotFoundError:
             return None                    # raced a reclaiming replica
-        except json.JSONDecodeError as e:
+        except (json.JSONDecodeError, _wire.FrameError) as e:
             try:
                 os.remove(claim_path)
             except FileNotFoundError:
@@ -581,7 +656,7 @@ class FileQueue(BaseQueue):
         out = []
         while len(out) < max_items:
             for fname in sorted(f for f in os.listdir(self.stream_dir)
-                                if f.endswith(".json")):
+                                if f.endswith(self._STREAM_EXTS)):
                 if len(out) >= max_items:
                     break
                 claim_path = os.path.join(
@@ -641,7 +716,7 @@ class FileQueue(BaseQueue):
 
     def pending_count(self):
         return sum(1 for f in os.listdir(self.claim_dir)
-                   if f.endswith(".json"))
+                   if f.endswith(self._STREAM_EXTS))
 
     def put_result(self, key, value):
         tmp = os.path.join(self.result_dir, f".{key}.tmp")
@@ -759,7 +834,7 @@ class FileQueue(BaseQueue):
 
     def trim(self, max_len):
         files = sorted(f for f in os.listdir(self.stream_dir)
-                       if f.endswith(".json"))
+                       if f.endswith(self._STREAM_EXTS))
         for fname in files[:max(0, len(files) - max_len)]:
             try:
                 os.remove(os.path.join(self.stream_dir, fname))
@@ -842,6 +917,14 @@ class RedisQueue(BaseQueue):
 
     def xadd(self, record):
         self._check_admission()
+        if not isinstance(record, dict):
+            # binary frame: the stream field value is the raw frame bytes —
+            # Redis fields are binary-safe, so no base64/JSON inflation
+            frame = bytes(record) if not isinstance(record, bytes) \
+                else record
+            rid = _frame_rid(frame)
+            self.r.xadd(self.stream, {"data": frame})
+            return rid
         rid = record.get("uri") or str(uuid.uuid4())
         self.r.xadd(self.stream, {"data": json.dumps(record)})
         return rid
@@ -945,14 +1028,23 @@ class RedisQueue(BaseQueue):
         haunts the pending list) while the rest of the batch proceeds.
         Returns the rid on success."""
         try:
-            rec = json.loads(fields[b"data"])
+            data = fields[b"data"]
+            if _wire.is_frame(data):
+                # binary frame: decoded at the consume boundary (the
+                # payload view aliases the client library's reply buffer)
+                rec = _wire.frame_to_record(data)
+            else:
+                rec = json.loads(data)
         except (KeyError, ValueError, TypeError) as e:
             key = self._decode(eid)
+            raw = fields.get(b"data", b"")
             try:
                 self.put_error(
                     key, f"read_batch: malformed entry: "
                          f"{type(e).__name__}: {e}",
-                    record={"raw": self._decode(fields.get(b"data", b""))})
+                    record={"raw": repr(bytes(raw)[:128])
+                            if _wire.is_frame(raw)
+                            else self._decode(raw)})
             except Exception:  # noqa: BLE001 — best-effort
                 pass
             try:

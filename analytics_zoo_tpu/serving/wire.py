@@ -1,0 +1,508 @@
+"""Binary wire format + zero-copy shared-memory lane (PR 7 tentpole).
+
+PR 3's stage timers identified record decode as the preprocess-side bound:
+every tensor crossed the queue as base64-wrapped JSON — a ~33% byte
+inflation plus a full decode copy on BOTH the enqueue and the consume side.
+This module replaces that wire with a versioned **binary frame**:
+
+    offset 0   magic   b"AZ"                (2 bytes)
+    offset 2   version u8        (currently 1)
+    offset 3   flags   u8        (bit 0: payload lives in a shm slot)
+    offset 4   hlen    u32 LE    (header length in bytes)
+    offset 8   plen    u32 LE    (inline payload length; 0 for shm frames)
+    offset 12  header  JSON      (utf-8, compact separators, sorted keys)
+    offset 12+hlen     payload   (raw little-endian tensor bytes)
+
+No base64, no payload-in-JSON: the header is a small JSON document (so the
+metadata surface stays schema-free and parseable from any language) and the
+tensor bytes follow it verbatim.  The prefix's ``plen`` double-books the
+payload length so a truncated or padded frame is detected as malformed
+instead of decoded into garbage.  Header keys are SHORT on the wire and
+expanded at decode — ``u``=uri ``t``=trace_id ``d``=deadline_ns
+``dt``=dtype ``s``=shape ``sc``=scale ``sm``=shm ``m``=meta — and the
+defaults are elided (``dt`` when ``<f4``, ``s`` when 1-D): a tensor
+record's overhead is the prefix plus ~40 header bytes, which is what keeps
+the wire-byte cut vs the base64-JSON record >= 25% instead of asymptoting
+just under it.  Sorted-key compact JSON makes encoding DETERMINISTIC — the
+golden-frame test pins the exact bytes, so an accidental layout change
+cannot ship silently.
+
+Zero-copy shared-memory lane (same-host producers): ``ShmRing`` is a ring
+of fixed-size slots in one ``multiprocessing.shared_memory`` segment.  The
+frame header travels through the queue as usual, but the payload is a slot
+REFERENCE (``{"name", "slot", "gen", "len"}``); the consumer materializes
+it with ``np.frombuffer`` over the mapped segment — one copy total (the
+float32 normalization) instead of three.  Each slot carries a generation
+counter written before and after the payload: a producer lapping a slow
+consumer is DETECTED (generation mismatch -> ``FrameError`` -> per-record
+quarantine), never silently served as torn bytes.  Size the ring at least
+as deep as the queue's admission cap (``slots >= max_depth``) so a full
+queue cannot lap the ring.
+
+Copy accounting: the whole point of this wire is fewer payload-sized buffer
+materializations, so the module counts them (``COPY_STATS``) at each
+physical copy site — b64 encode/decode, frame build, spool write/read, shm
+slot write, float32 normalization.  The structural win (shm < bin < json
+copies per record) is asserted by test, not inferred from wall clock.
+
+Pure stdlib + numpy: safe to import from the client, the queues, and the
+HTTP gateway without dragging in jax.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"AZ"
+VERSION = 1
+FLAG_SHM = 0x01
+
+_PREFIX = struct.Struct("<2sBBII")         # magic, version, flags, hlen,
+PREFIX_LEN = _PREFIX.size                  # plen — 12 bytes
+
+# header keys are SHORT on the wire, expanded at decode: every byte of
+# per-record overhead eats into the 33% base64 inflation this wire removes
+_SHORT = {"uri": "u", "trace_id": "t", "deadline_ns": "d", "dtype": "dt",
+          "shape": "s", "scale": "sc", "shm": "sm", "meta": "m"}
+_LONG = {v: k for k, v in _SHORT.items()}
+
+# wire-format tags used for metrics labels and bench A/Bs
+FMT_JSON = "json"                          # legacy base64-JSON record
+FMT_BIN = "bin"                            # binary frame, payload inline
+FMT_SHM = "shm"                            # binary frame, payload in shm
+
+
+class FrameError(ValueError):
+    """Malformed binary frame (bad magic, truncated header, payload length
+    mismatch, stale shm slot).  Producers see it at encode/enqueue; the
+    engine quarantines the offending record and keeps serving."""
+
+
+# -- copy accounting -----------------------------------------------------------
+
+class _CopyStats:
+    """Counts payload-sized buffer materializations per wire path so the
+    copy-count reduction is a TESTABLE structural claim.  Sites:
+
+    - ``b64_encode`` / ``b64_decode`` — legacy JSON wire
+    - ``frame_build``                 — payload memcpy into a binary frame
+    - ``spool_write`` / ``spool_read``— FileQueue payload traversal
+    - ``shm_write``                   — payload memcpy into a ring slot
+    - ``normalize``                   — the float32 materialization copy
+                                        every path pays exactly once
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._bytes: Dict[str, int] = {}
+
+    def record(self, site: str, nbytes: int = 0) -> None:
+        with self._lock:
+            self._counts[site] = self._counts.get(site, 0) + 1
+            self._bytes[site] = self._bytes.get(site, 0) + int(nbytes)
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {site: {"count": self._counts[site],
+                           "bytes": self._bytes.get(site, 0)}
+                    for site in self._counts}
+
+    def total_copies(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._bytes.clear()
+
+
+COPY_STATS = _CopyStats()
+
+
+# -- frame codec ---------------------------------------------------------------
+
+def _header_bytes(header: Dict) -> bytes:
+    # sorted keys + compact separators: deterministic bytes for the golden
+    # fixture, and byte-for-byte stable across Python versions
+    return json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def encode_frame(header: Dict, payload=b"", flags: int = 0) -> bytes:
+    """Assemble one frame.  ``header`` uses the LONG key names (uri,
+    trace_id, ...) — they are shortened on the wire and re-expanded at
+    decode.  ``payload`` is any buffer (bytes, memoryview, contiguous
+    ndarray); it is copied exactly once, into the frame."""
+    payload = memoryview(payload).cast("B") \
+        if not isinstance(payload, (bytes, bytearray)) else payload
+    plen = len(payload) if not isinstance(payload, memoryview) \
+        else payload.nbytes
+    hbytes = _header_bytes({_SHORT.get(k, k): v for k, v in header.items()})
+    frame = bytearray(PREFIX_LEN + len(hbytes) + plen)
+    _PREFIX.pack_into(frame, 0, MAGIC, VERSION, flags, len(hbytes), plen)
+    frame[PREFIX_LEN:PREFIX_LEN + len(hbytes)] = hbytes
+    if plen:
+        frame[PREFIX_LEN + len(hbytes):] = payload      # the ONE copy
+        COPY_STATS.record("frame_build", plen)
+    return bytes(frame)
+
+
+def encode_tensor_frame(uri: str, arr: np.ndarray,
+                        scale: Optional[float] = None,
+                        deadline_ns: Optional[int] = None,
+                        trace_id: Optional[str] = None,
+                        shm_ref: Optional[Dict] = None,
+                        meta: Optional[Dict] = None) -> bytes:
+    """One tensor record as a binary frame.  ``arr`` must already be
+    contiguous little-endian (the client normalizes before calling); with
+    ``shm_ref`` the payload stays in its shm slot and the frame carries only
+    the reference."""
+    header: Dict = {"uri": str(uri)}
+    # single-byte dtypes stringify as "|i1": normalize to the "<"-prefixed
+    # tags the legacy wire (and the engine's int8 gate) already speak
+    dtype_str = arr.dtype.str
+    if dtype_str.startswith("|"):
+        dtype_str = "<" + dtype_str[1:]
+    if dtype_str != "<f4":                 # "<f4" is the decode default
+        header["dtype"] = dtype_str
+    if arr.ndim != 1:                      # a flat payload needs no reshape
+        header["shape"] = list(arr.shape)
+    if scale is not None:
+        header["scale"] = float(scale)
+    if deadline_ns is not None:
+        header["deadline_ns"] = int(deadline_ns)
+    if trace_id is not None:
+        header["trace_id"] = str(trace_id)
+    if meta:
+        header["meta"] = meta
+    if shm_ref is not None:
+        header["shm"] = dict(shm_ref)
+        return encode_frame(header, flags=FLAG_SHM)
+    return encode_frame(header, payload=arr)
+
+
+def is_frame(buf) -> bool:
+    """Cheap sniff: does this buffer start like a binary frame?"""
+    try:
+        return len(buf) >= PREFIX_LEN and bytes(buf[:2]) == MAGIC
+    except (TypeError, ValueError):
+        return False
+
+
+def decode_frame(buf) -> Tuple[int, Dict, memoryview]:
+    """Parse one frame into ``(flags, header, payload_view)``.  The payload
+    is a zero-copy memoryview over ``buf``; for shm frames it is empty and
+    the header's ``shm`` reference locates the real bytes.  Raises
+    ``FrameError`` on anything malformed — bad magic, unknown version,
+    truncated header, or a payload whose length disagrees with the header's
+    ``plen``."""
+    view = memoryview(buf).cast("B") if not isinstance(buf, memoryview) \
+        else buf.cast("B")
+    flags, hlen, plen, header = _parse_prefix_and_header(view)
+    payload = view[PREFIX_LEN + hlen:]
+    if flags & FLAG_SHM:
+        if plen or payload.nbytes:
+            raise FrameError("shm frame must carry no inline payload "
+                             f"(prefix plen {plen}, {payload.nbytes} "
+                             "trailing bytes)")
+        if not isinstance(header.get("shm"), dict):
+            raise FrameError("shm frame header lacks the 'shm' reference")
+    elif plen != payload.nbytes:
+        raise FrameError(f"payload length mismatch: prefix says {plen}, "
+                         f"frame carries {payload.nbytes}")
+    return flags, header, payload
+
+
+def _parse_prefix_and_header(view: memoryview):
+    """Shared prefix+header parse: ``(flags, hlen, plen, header)`` with the
+    short keys expanded.  Payload validation is the caller's business."""
+    if view.nbytes < PREFIX_LEN:
+        raise FrameError(f"frame truncated: {view.nbytes} bytes < "
+                         f"{PREFIX_LEN}-byte prefix")
+    magic, version, flags, hlen, plen = _PREFIX.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic!r} (expected {MAGIC!r})")
+    if version != VERSION:
+        raise FrameError(f"unsupported frame version {version} "
+                         f"(this decoder speaks {VERSION})")
+    if view.nbytes < PREFIX_LEN + hlen:
+        raise FrameError(f"frame truncated: header says {hlen} bytes, "
+                         f"only {view.nbytes - PREFIX_LEN} present")
+    try:
+        raw = json.loads(bytes(view[PREFIX_LEN:PREFIX_LEN + hlen]))
+    except ValueError as e:
+        raise FrameError(f"frame header is not valid JSON: {e}") from e
+    if not isinstance(raw, dict):
+        raise FrameError("frame header must be a JSON object")
+    header = {_LONG.get(k, k): v for k, v in raw.items()}
+    if "uri" not in header:
+        raise FrameError("frame header must carry a 'uri'")
+    return flags, hlen, plen, header
+
+
+def decode_header(buf) -> Dict:
+    """Header-only parse: prefix + header JSON, WITHOUT the payload-length
+    validation (enqueue-side, the queue only needs the uri for the record
+    id — full frame validation happens once, at the consume boundary)."""
+    view = memoryview(buf).cast("B") if not isinstance(buf, memoryview) \
+        else buf.cast("B")
+    return _parse_prefix_and_header(view)[3]
+
+
+def frame_to_record(buf) -> Dict:
+    """One decoded frame as the engine-facing record dict: header fields
+    hoisted to the top level (``uri``/``trace_id``/``deadline_ns`` keep the
+    exact keys the deadline gates and tracer already read), the payload as
+    a zero-copy ``memoryview`` under ``"payload"`` (or the shm reference
+    under ``"shm"``), plus ``wire_fmt``/``wire_bytes`` for the byte
+    accounting metrics."""
+    flags, header, payload = decode_frame(buf)
+    rec: Dict = dict(header)
+    if flags & FLAG_SHM:
+        rec["wire_fmt"] = FMT_SHM
+    else:
+        rec["payload"] = payload
+        rec["wire_fmt"] = FMT_BIN
+    rec["wire_bytes"] = memoryview(buf).nbytes \
+        if not isinstance(buf, (bytes, bytearray)) else len(buf)
+    return rec
+
+
+def restamp_frame(buf, trace_id: Optional[str] = None,
+                  deadline_ns: Optional[int] = None) -> bytes:
+    """Rewrite a frame's header (gateway ingest: issue a trace_id, stamp an
+    edge deadline) without touching fields already present.  Returns the
+    original buffer unchanged when there is nothing to add; otherwise the
+    payload is spliced behind the new header (one copy — the gateway
+    already owns the request body, so this is the only copy it pays)."""
+    return restamp_frame_with_header(buf, trace_id=trace_id,
+                                     deadline_ns=deadline_ns)[0]
+
+
+def restamp_frame_with_header(
+        buf, trace_id: Optional[str] = None,
+        deadline_ns: Optional[int] = None) -> Tuple[bytes, Dict]:
+    """``restamp_frame`` plus the (post-stamp) decoded header, so a caller
+    that needs both — the gateway reads back uri/trace_id/deadline for its
+    reply — pays ONE header parse instead of re-decoding the result."""
+    flags, header, payload = decode_frame(buf)
+    changed = False
+    if trace_id is not None and "trace_id" not in header:
+        header["trace_id"] = trace_id
+        changed = True
+    if deadline_ns is not None and "deadline_ns" not in header:
+        header["deadline_ns"] = int(deadline_ns)
+        changed = True
+    if not changed:
+        return (bytes(buf) if not isinstance(buf, bytes) else buf), header
+    return encode_frame(header, payload=payload, flags=flags), header
+
+
+def sanitize_record(record: Optional[Dict]) -> Optional[Dict]:
+    """JSON-safe copy of a record for dead-letter entries: a binary
+    payload (memoryview / bytes) is re-encoded as base64 under ``"b64"``
+    so the entry serializes AND ``replay_dead_letters`` can re-enqueue it
+    through the legacy decode path; a shm reference is dropped (the slot
+    may be reused long before any replay) with a note."""
+    if record is None or not isinstance(record, dict):
+        return record
+    if "payload" not in record and "shm" not in record:
+        return record
+    import base64
+    out = {k: v for k, v in record.items()
+           if k not in ("payload", "shm", "wire_fmt", "wire_bytes")}
+    payload = record.get("payload")
+    if payload is not None:
+        try:
+            out["b64"] = base64.b64encode(payload).decode("ascii")
+        except (TypeError, ValueError):
+            out["payload_repr"] = repr(payload)[:128]
+    elif "shm" in record:
+        out["shm_dropped"] = "payload lived in a shm slot (not retained)"
+    return out
+
+
+# -- zero-copy shared-memory lane ---------------------------------------------
+
+class ShmRing:
+    """Ring of fixed-size payload slots in one shared-memory segment.
+
+    Layout: ``slots`` control records (``gen`` u64 + ``len`` u64 + ``crc``
+    u32 of the payload), then ``slots`` payload regions of ``slot_bytes``
+    each.  The producer writes round-robin; every write invalidates the
+    slot (gen=0), copies the payload, then publishes generation + crc — a
+    consumer checks the generation before reading and, after
+    materializing, verifies BOTH the generation and the crc32 of the slot
+    bytes against the reference.  The generation catches slot reuse; the
+    crc makes torn-read detection architecture-independent (a plain
+    seqlock's store ordering is only guaranteed on TSO hardware like x86 —
+    on weaker memory models the payload stores could become visible before
+    the invalidation, and the checksum is what still catches the mix).
+    Either way: a lapped or mid-write slot raises ``FrameError`` ->
+    per-record quarantine, never torn bytes served as data.
+
+    The ring does not track consumption: a producer that laps a slot whose
+    record is still queued invalidates that record (detected at decode ->
+    quarantine).  Size ``slots`` at least as deep as the queue's admission
+    cap to make lapping impossible."""
+
+    CTRL = struct.Struct("<QQI")           # gen, len, crc32(payload)
+
+    def __init__(self, name: Optional[str] = None, slots: int = 64,
+                 slot_bytes: int = 1 << 16, create: bool = True):
+        from multiprocessing import shared_memory
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        size = self.slots * (self.CTRL.size + self.slot_bytes)
+        if create:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            # consumer-side attach: on Python <= 3.12 SharedMemory
+            # registers EVERY mapping with the resource tracker, which
+            # unlinks at process exit — a restarting consumer would
+            # destroy a segment its producer still owns.  Cleanup belongs
+            # to the creating process alone; unregister the attachment.
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(self._shm._name,
+                                            "shared_memory")
+            except Exception:  # noqa: BLE001 — tracker internals differ
+                pass           # across versions; worst case is a warning
+        self.name = self._shm.name
+        self._next = 0
+        self._lock = threading.Lock()
+        self._owner = create
+
+    def _ctrl_off(self, slot: int) -> int:
+        return slot * self.CTRL.size
+
+    def _payload_off(self, slot: int) -> int:
+        return self.slots * self.CTRL.size + slot * self.slot_bytes
+
+    def write(self, data) -> Dict:
+        """Copy one payload into the next slot; returns the reference dict
+        that travels in the frame header.  Raises ``ValueError`` when the
+        payload exceeds ``slot_bytes`` (the caller falls back to an inline
+        frame)."""
+        import zlib
+        view = memoryview(data).cast("B")
+        n = view.nbytes
+        if n > self.slot_bytes:
+            raise ValueError(f"payload {n} bytes exceeds shm slot size "
+                             f"{self.slot_bytes}")
+        crc = zlib.crc32(view)
+        with self._lock:
+            slot = self._next % self.slots
+            self._next += 1
+            gen = self._next                # monotonic, never 0
+            buf = self._shm.buf
+            # invalidate -> copy -> publish: a concurrent reader can never
+            # match `gen` against half-written bytes (and the crc catches
+            # the mix even where stores reorder)
+            self.CTRL.pack_into(buf, self._ctrl_off(slot), 0, 0, 0)
+            off = self._payload_off(slot)
+            buf[off:off + n] = view
+            COPY_STATS.record("shm_write", n)
+            self.CTRL.pack_into(buf, self._ctrl_off(slot), gen, n, crc)
+        # geometry rides in the reference so the consumer can map the
+        # segment without out-of-band coordination
+        return {"name": self.name, "slot": slot, "gen": gen, "len": n,
+                "crc": crc,
+                "slots": self.slots, "slot_bytes": self.slot_bytes}
+
+    def slot_view(self, ref: Dict) -> memoryview:
+        """Zero-copy view over a referenced slot, validated against the
+        reference's generation.  Call ``verify(ref)`` again AFTER
+        materializing the bytes — the window between view and copy is where
+        a lapping producer could overwrite."""
+        self.verify(ref, check_crc=False)   # cheap pre-check; the full
+        off = self._payload_off(int(ref["slot"]))   # crc runs post-copy
+        return self._shm.buf[off:off + int(ref["len"])]
+
+    def verify(self, ref: Dict, check_crc: bool = True) -> None:
+        gen, ln, crc = self.CTRL.unpack_from(
+            self._shm.buf, self._ctrl_off(int(ref["slot"])))
+        if gen != int(ref["gen"]) or ln != int(ref["len"]):
+            raise FrameError(
+                f"shm slot {ref['slot']} overwritten (gen {gen} != "
+                f"{ref['gen']}): producer lapped the ring — size slots >= "
+                "the queue's max_depth")
+        if check_crc and "crc" in ref:
+            # checksum the CURRENT slot bytes against the reference: on
+            # weakly-ordered hardware a lapping writer's payload stores can
+            # land before its invalidation store, which the generation
+            # alone cannot see — the crc still catches the mixed bytes
+            import zlib
+            off = self._payload_off(int(ref["slot"]))
+            if zlib.crc32(self._shm.buf[off:off + ln]) != int(ref["crc"]):
+                raise FrameError(
+                    f"shm slot {ref['slot']} overwritten mid-read "
+                    "(payload checksum mismatch): producer lapped the "
+                    "ring — size slots >= the queue's max_depth")
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+
+
+# consumer-side attachment cache: one mapping per segment name per process
+_ATTACHED: Dict[str, ShmRing] = {}
+_ATTACH_LOCK = threading.Lock()
+
+
+def attach_ring(ref: Dict) -> ShmRing:
+    """Attach (once per process) to the segment a slot reference names.
+    The control layout is self-describing only through the producer's
+    geometry, which rides in the reference."""
+    name = str(ref["name"])
+    with _ATTACH_LOCK:
+        ring = _ATTACHED.get(name)
+        if ring is None:
+            ring = ShmRing(name=name, slots=int(ref.get("slots", 64)),
+                           slot_bytes=int(ref.get("slot_bytes", 1 << 16)),
+                           create=False)
+            _ATTACHED[name] = ring
+        return ring
+
+
+def detach_all() -> None:
+    """Drop every cached attachment (tests / engine shutdown)."""
+    with _ATTACH_LOCK:
+        for ring in _ATTACHED.values():
+            ring.close()
+        _ATTACHED.clear()
+
+
+def resolve_payload(record: Dict) -> Tuple[memoryview, Optional[Dict]]:
+    """The decode seam used by the engine: returns ``(payload_view,
+    shm_ref)`` for a binary record.  For inline frames the view aliases the
+    frame bytes; for shm frames it aliases the mapped slot and the caller
+    MUST re-``verify`` the reference (via ``attach_ring(ref).verify(ref)``)
+    after materializing, to detect a producer lapping mid-copy."""
+    if "payload" in record:
+        return memoryview(record["payload"]).cast("B"), None
+    ref = record.get("shm")
+    if not isinstance(ref, dict):
+        raise FrameError("binary record has neither payload nor shm ref")
+    ring = attach_ring(ref)
+    return ring.slot_view(ref), ref

@@ -47,6 +47,11 @@ def pytest_configure(config):
         "subprocess under XLA_FLAGS=--xla_force_host_platform_device_"
         "count=N so the mesh path runs on CPU-only containers, with a "
         "default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "wire: binary wire / shm-lane / HTTP-gateway tests (shared-memory "
+        "segments + curl subprocesses); carry a default 120 s SIGALRM "
+        "budget so a wedged gateway or leaked segment cannot stall tier-1")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -56,6 +61,7 @@ def pytest_configure(config):
 # of cost, same budget.
 REPLICAS_DEFAULT_TIMEOUT_S = 300.0
 MULTICHIP_DEFAULT_TIMEOUT_S = 300.0
+WIRE_DEFAULT_TIMEOUT_S = 120.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -75,6 +81,8 @@ def pytest_runtest_call(item):
             seconds = REPLICAS_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("multichip") is not None:
             seconds = MULTICHIP_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("wire") is not None:
+            seconds = WIRE_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

@@ -85,8 +85,13 @@ class FakeRedis:
 
     def xadd(self, stream, fields):
         data = fields["data"]
+        # bytes-safe field values (PR 7): binary frames arrive as bytes /
+        # bytearray / memoryview and must round-trip VERBATIM, like real
+        # Redis; only str is encoded
         if isinstance(data, str):
             data = data.encode()
+        elif isinstance(data, (bytearray, memoryview)):
+            data = bytes(data)
         with self._lock:
             self._seq += 1
             eid = f"{self._seq}-0".encode()
@@ -212,13 +217,20 @@ class FakeRedis:
             return {"pending": len(g["pending"]), "min": None, "max": None,
                     "consumers": []}
 
+    @staticmethod
+    def _bytes_safe(v):
+        # real Redis stores values as bytes: normalize bytearray/memoryview
+        # so binary frames round-trip verbatim, leave str (encoded on read)
+        return bytes(v) if isinstance(v, (bytearray, memoryview)) else v
+
     def hset(self, table, key=None, value=None, mapping=None):
         with self._lock:
             h = self.hashes.setdefault(table, {})
             if mapping is not None:
-                h.update(mapping)
+                h.update({k: self._bytes_safe(v)
+                          for k, v in mapping.items()})
             if key is not None:
-                h[key] = value
+                h[key] = self._bytes_safe(value)
 
     def hget(self, table, key):
         with self._lock:

@@ -93,27 +93,39 @@ def _build_model(args):
         .do_load_model(model, model._params, model._state)
 
 
+def _tensor_wire(args) -> str:
+    """Map the bench --wire flag onto the client's enqueue_tensor wire:
+    ``json`` is the legacy base64-JSON record (alias of f32 — the A/B
+    baseline), ``bin``/``shm`` are the PR 7 binary-frame / shared-memory
+    lanes."""
+    return {"f32": "f32", "json": "f32", "int8": "int8",
+            "bin": "bin", "shm": "shm"}[args.wire]
+
+
 def _enqueue(client_in, args, n):
     g = np.random.default_rng(0)
     if args.smoke:
         x = g.random((16,), np.float32)
-        return [client_in.enqueue_tensor(f"img-{i}", x) for i in range(n)]
+        w = _tensor_wire(args) if args.wire != "jpeg-u8" else "f32"
+        return [client_in.enqueue_tensor(f"img-{i}", x, wire=w)
+                for i in range(n)]
     if args.model == "bert":
         ids = g.integers(0, 30522, (args.seq,)).astype(np.float32)
-        return [client_in.enqueue_tensor(f"tok-{i}", ids) for i in range(n)]
+        return [client_in.enqueue_tensor(f"tok-{i}", ids,
+                                         wire=_tensor_wire(args))
+                for i in range(n)]
     if args.model == "mlp":
         img = g.random((args.image * args.image * 3,), np.float32)
     else:
         img = g.random((args.image, args.image, 3), np.float32)
-    if args.wire == "int8":
-        return [client_in.enqueue_tensor(f"img-{i}", img, wire="int8")
-                for i in range(n)]
     if args.wire == "jpeg-u8":
         u8 = (img.reshape(args.image, args.image, 3) * 255).astype(np.uint8)
         return [client_in.enqueue_image(f"img-{i}", u8, fmt=".jpg",
                                         device_uint8=True)
                 for i in range(n)]
-    return [client_in.enqueue_tensor(f"img-{i}", img) for i in range(n)]
+    return [client_in.enqueue_tensor(f"img-{i}", img,
+                                     wire=_tensor_wire(args))
+            for i in range(n)]
 
 
 def _run_once(im, args, batch_size):
@@ -156,13 +168,24 @@ def _run_once(im, args, batch_size):
                                postprocess=post,
                                tensorboard_dir=tb_dir if i == 0 else None)
                 for i in range(max(1, args.replicas))]
-    client_in, client_out = InputQueue(queue), OutputQueue(queue)
+    # shm lane: the steady-state protocol PRE-FILLS the queue, so the ring
+    # must hold every queued payload or the producer laps it (the README
+    # shm caveat: slots >= queue depth)
+    client_in = InputQueue(queue, shm_slots=max(args.n, 1)
+                           if args.wire == "shm" else 64)
+    client_out = OutputQueue(queue)
 
     # steady-state protocol: pre-fill the queue, then start the engine — a
     # cold trickle would make the engine predict partial batches across many
     # power-of-2 buckets, each paying a fresh XLA compile (minutes via the
     # relay) that has nothing to do with serving throughput
     uris = _enqueue(client_in, args, args.n)
+    # wire-byte accounting (PR 7): exact bytes the producer put on the
+    # queue, per record — the machine-checkable half of the bin-vs-json A/B
+    wire_bytes_per_record = (
+        round(client_in.wire_bytes_enqueued
+              / max(client_in.records_enqueued, 1), 1)
+        if client_in.records_enqueued else None)
     t0 = time.time()
     for serving in servings:
         serving.start()
@@ -180,8 +203,13 @@ def _run_once(im, args, batch_size):
     primary = max(servings, key=lambda s: s.total_records)
     metrics = primary.metrics()
     served_per_replica = [s.total_records for s in servings]
+    # cumulative decode time must cover EVERY replica (each engine has its
+    # own registry): the busiest replica alone would under-count the A/B
+    decode_seconds = sum(
+        s.metrics()["stages"]["preprocess"]["total_s"] for s in servings)
     for serving in servings:
         serving.shutdown()
+    client_in.close()                      # release the shm ring, if any
 
     scalars = read_scalars(tb_dir)
     tput = scalars.get("Serving Throughput", [])
@@ -193,7 +221,11 @@ def _run_once(im, args, batch_size):
                   else (f"bert-{args.bert_hidden}h{args.bert_blocks}L-"
                         f"seq{args.seq}") if args.model == "bert"
                   else f"resnet{args.depth}-{args.image}px"),
-        "wire": "f32" if args.smoke else args.wire,
+        # --smoke with the image wire enqueues f32 tensor records (the smoke
+        # model takes flat tensors): report the wire actually used so A/B
+        # consumers never attribute f32 numbers to jpeg-u8
+        "wire": ("f32" if args.smoke and args.wire == "jpeg-u8"
+                 else args.wire),
         "queue": args.queue,
         "records": len(results),
         "errors": errors,
@@ -205,6 +237,11 @@ def _run_once(im, args, batch_size):
         "preprocess_workers": args.pre_workers,
         "inflight_batches": args.inflight,
         "wall_records_per_sec": round(args.n / dt, 1),
+        # PR 7 wire A/B fields: bytes-per-record on the queue and the
+        # cumulative decode (preprocess) seconds — run once per --wire
+        # {json,bin,shm} with --json and diff the documents
+        "wire_bytes_per_record": wire_bytes_per_record,
+        "decode_seconds": round(decode_seconds, 6),
         # sharded multi-chip A/B fields (PR 6).  On CPU sim the structural
         # evidence (mesh_devices > 1, sharded_calls > 0, even per-device
         # split) is the claim; wall-clock deltas only mean something on
@@ -251,12 +288,20 @@ def main(argv=None):
                     help="bert: hidden size (1024 = bert_large)")
     ap.add_argument("--bert-heads", type=int, default=16,
                     help="bert: attention heads (16 = bert_large)")
-    ap.add_argument("--wire", choices=("f32", "int8", "jpeg-u8"),
+    ap.add_argument("--wire",
+                    choices=("f32", "json", "int8", "jpeg-u8", "bin",
+                             "shm"),
                     default="f32",
-                    help="record wire format: raw f32 tensors, int8-"
-                         "quantized tensors (dequantized ON DEVICE, 4x "
-                         "less transfer), or JPEG images decoded to uint8 "
-                         "kept uint8 onto the device")
+                    help="record wire format.  f32/json (aliases): legacy "
+                         "base64-JSON tensor records — the A/B baseline; "
+                         "int8: quantized b64 records (dequantized ON "
+                         "DEVICE); jpeg-u8: compressed images kept uint8; "
+                         "bin (PR 7): binary frames — no base64, ~25% "
+                         "fewer wire bytes, frombuffer decode; shm "
+                         "(PR 7): zero-copy shared-memory lane (payload "
+                         "never crosses the queue).  Run once per format "
+                         "with --json and diff wire_bytes_per_record / "
+                         "decode_seconds")
     # PR 3 data-plane knobs (mirror ServingParams)
     ap.add_argument("--max-batch", type=int, default=None,
                     help="adaptive batcher ceiling (default: --batch)")
@@ -310,9 +355,9 @@ def main(argv=None):
         ap.error("--model mlp takes flat tensor records; the jpeg-u8 image "
                  "wire decodes to (H, W, 3) and cannot feed it — use "
                  "--wire f32|int8 or --model resnet")
-    if args.model == "bert" and args.wire != "f32":
-        ap.error("--model bert takes token-id records; only --wire f32 "
-                 "applies")
+    if args.model == "bert" and args.wire in ("int8", "jpeg-u8"):
+        ap.error("--model bert takes token-id records; use a tensor wire "
+                 "(--wire f32|json|bin|shm)")
 
     if args.mesh:
         import jax
