@@ -124,6 +124,16 @@ class QuantizedTensor(NamedTuple):
     scale: float
 
 
+def _wire_fmt_label(record: Dict) -> str:
+    """Metric label for a record's wire format.  The field is producer-
+    controlled (raw xadd bypasses the gateway's stripping), so anything
+    but the known binary tags folds into the json label: an unhashable
+    value would dead-letter a valid record at the labels() call, and
+    distinct strings would mint unbounded permanent metric series."""
+    fmt = record.get("wire_fmt")
+    return fmt if fmt in (_wire.FMT_BIN, _wire.FMT_SHM) else _wire.FMT_JSON
+
+
 def _decode_tensor_record(record: Dict):
     """Binary-wire decode (PR 7 tentpole): materialize a frame-decoded
     record — inline ``payload`` memoryview or shared-memory slot reference
@@ -819,7 +829,20 @@ class ClusterServing:
         shows up in its trace."""
         dl = deadline_ns if deadline_ns is not None \
             else (rec or {}).get("deadline_ns")
-        if dl is None or time.time_ns() <= int(dl):
+        if dl is None:
+            return False
+        try:
+            expired = time.time_ns() > int(dl)
+        except (TypeError, ValueError, OverflowError) as e:
+            # this gate runs OUTSIDE the per-record quarantine: a junk
+            # deadline from a raw-xadd producer would otherwise kill the
+            # read worker, which restarts, redelivers the leased record,
+            # and dies again — crash-loop, not fault isolation.  (The
+            # gateway 400s these at the edge; this covers every other
+            # producer.)  True = the record leaves the pipeline.
+            self._quarantine(rid, stage, e, record=rec, trace_id=trace_id)
+            return True
+        if not expired:
             return False
         self.shed += 1
         self._m_shed.inc()
@@ -931,12 +954,20 @@ class ClusterServing:
             # that bypass the client (raw xadd) are stamped at read instead
             rec.setdefault("trace_id", new_trace_id())
             # per-format wire-byte accounting (PR 7): frames carry their
-            # exact length; legacy records are dominated by the b64 string
+            # exact length; legacy records are dominated by the b64 string.
+            # Type-guarded — this loop runs outside the per-record
+            # quarantine, and raw-xadd producers control these fields
             nbytes = rec.get("wire_bytes")
-            if nbytes is None:
-                nbytes = len(rec.get("b64") or rec.get("image") or "")
+            if not (isinstance(nbytes, (int, float))
+                    and 0 <= nbytes < float("inf")):
+                # non-numeric, negative, inf, or NaN (NaN fails 0 <=):
+                # inc()ing any of those poisons the monotonic counter for
+                # the process lifetime
+                raw = rec.get("b64") or rec.get("image") or ""
+                nbytes = len(raw) \
+                    if isinstance(raw, (str, bytes, bytearray)) else 0
             self._m_wire_bytes.labels(
-                format=rec.get("wire_fmt") or _wire.FMT_JSON).inc(nbytes)
+                format=_wire_fmt_label(rec)).inc(nbytes)
             self._span("read", t0, t_read,
                              trace_id=rec["trace_id"], uri=rid)
         kept = []
@@ -964,8 +995,7 @@ class ClusterServing:
                 item, p0, p1 = fut.result() if fut is not None \
                     else pre_one(rec)
                 self._pre_fmt_hist.labels(
-                    format=rec.get("wire_fmt")
-                    or _wire.FMT_JSON).record(p1 - p0)
+                    format=_wire_fmt_label(rec)).record(p1 - p0)
                 self._span("preprocess", p0, p1,
                                  trace_id=rec.get("trace_id"), uri=rid)
                 items.append((rid, item, rec.get("deadline_ns"),

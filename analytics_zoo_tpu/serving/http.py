@@ -28,7 +28,11 @@ Ingestion gateway (PR 7 tentpole — any client, any language):
   length + header JSON + raw little-endian payload), validated at the edge
   (malformed -> 400, never enqueued); anything JSON-ish is the legacy
   record dict (``{"uri", "b64", "dtype", "shape"}``) for curl-from-anywhere
-  ergonomics.  The gateway issues a ``trace_id`` at ingest when the record
+  ergonomics.  The shm lane is a SAME-HOST trusted-native-client
+  transport: a frame or JSON record carrying a shm slot reference (or a
+  raw ``payload``) is rejected 400 here — honoring a remote-supplied ref
+  would make the engine attach any named shared-memory segment on the
+  host and serve bytes derived from it.  The gateway issues a ``trace_id`` at ingest when the record
   carries none, and ``?timeout_s=S`` stamps the end-to-end ``deadline_ns``
   AT THE EDGE so deadline shedding covers HTTP traffic too.  Admission is
   enforced here: a full queue answers **429** (`Retry-After` hint), a
@@ -37,7 +41,11 @@ Ingestion gateway (PR 7 tentpole — any client, any language):
 - ``GET /v1/result/<uri>`` — fetch the prediction.  ``?timeout_s=S`` long-
   polls (bounded by ``LONGPOLL_CAP_S``) with backoff until the result
   lands; a miss answers 404 ``{"ready": false}`` so pollers can
-  distinguish "not yet" from a transport error.  Error results (quarantine
+  distinguish "not yet" from a transport error.  Each parked long-poll
+  pins one handler thread, so concurrent pollers are capped at
+  ``LONGPOLL_MAX_INFLIGHT``: overflow degrades to one immediate lookup —
+  200 on a hit, else **503** with ``Retry-After`` — instead of letting a
+  client exhaust gateway threads/FDs with hanging polls.  Error results (quarantine
   / deadline-shed markers) return 200 with the ``{"error": ...}`` body —
   terminal state, not a gateway failure.
 
@@ -72,6 +80,11 @@ logger = logging.getLogger(__name__)
 # long-poll ceiling for GET /v1/result: bounds worker-thread occupancy per
 # hanging client (ThreadingHTTPServer spawns one thread per request)
 LONGPOLL_CAP_S = 30.0
+# concurrent parked long-polls per gateway: ThreadingHTTPServer is
+# unbounded, so without this a client opening many long-polls pins one
+# thread each for up to LONGPOLL_CAP_S; overflow answers an immediate
+# lookup (200 on hit, else 503 + Retry-After) instead of parking
+LONGPOLL_MAX_INFLIGHT = 64
 # largest accepted request body; a frame bigger than this answers 413
 MAX_BODY_BYTES = 64 * 1024 * 1024
 
@@ -85,6 +98,9 @@ class HealthServer:
         self.port = port                    # actual port after start()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # admission for parked long-polls (see LONGPOLL_MAX_INFLIGHT)
+        self._longpoll_slots = threading.BoundedSemaphore(
+            LONGPOLL_MAX_INFLIGHT)
         # gateway telemetry (PR 7) in the engine's PR 4 registry; guarded —
         # exotic servings (tests wrapping a stub) may lack a registry
         self._lat = self._bytes = None
@@ -177,15 +193,37 @@ class HealthServer:
                         and uri not in (".", ".."))
 
             @staticmethod
+            def _deadline_ok(dl) -> bool:
+                """A record's deadline_ns is int()ed by the engine's shed
+                gate OUTSIDE the per-record quarantine: a non-numeric
+                value from a remote client must stop at the edge."""
+                if dl is None:
+                    return True
+                try:
+                    int(dl)
+                except (TypeError, ValueError, OverflowError):
+                    # OverflowError: json.loads accepts Infinity/1e999
+                    return False
+                return True
+
+            @staticmethod
             def _query_float(query: str, key: str) -> Optional[float]:
+                import math
                 from urllib.parse import parse_qs
                 raw = (parse_qs(query).get(key) or [None])[0]
                 if raw is None:
                     return None
                 try:
-                    return float(raw)
+                    val = float(raw)
                 except ValueError:
                     return None
+                # nan poisons every comparison downstream — a long-poll
+                # deadline of nan never expires AND never parks (an
+                # uncapped 10ms spin pinning a handler thread forever).
+                # inf stays: the result path clamps it to LONGPOLL_CAP_S
+                # ("wait as long as you allow"), and the enqueue path
+                # guards the deadline int() itself.
+                return val if not math.isnan(val) else None
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 from urllib.parse import urlsplit
@@ -215,10 +253,13 @@ class HealthServer:
 
             def _get_result(self, parts) -> None:
                 """GET /v1/result/<uri>[?timeout_s=S] — long-poll the
-                result table with backoff; bounded by LONGPOLL_CAP_S."""
+                result table with backoff; bounded by LONGPOLL_CAP_S, with
+                concurrent parked pollers capped at LONGPOLL_MAX_INFLIGHT
+                (overflow degrades to one immediate lookup)."""
                 from urllib.parse import unquote
                 t0 = time.monotonic()
                 nbytes = 0
+                parked = False
                 # every exit — hit, miss, rejection, or failure — lands in
                 # the endpoint histograms: rejected/failed traffic is
                 # exactly what they exist to attribute
@@ -231,6 +272,22 @@ class HealthServer:
                                                   "timeout_s") or 0.0
                     deadline = t0 + min(max(timeout_s, 0.0),
                                         LONGPOLL_CAP_S)
+                    if deadline > t0:
+                        parked = gateway._longpoll_slots.acquire(
+                            blocking=False)
+                        if not parked:
+                            # long-poll slots exhausted: one immediate
+                            # lookup, never a parked thread
+                            res = serving.queue.get_result(uri)
+                            if res is not None:
+                                nbytes = self._reply(200, res)
+                            else:
+                                nbytes = self._reply(
+                                    503,
+                                    {"error": "long-poll capacity "
+                                              "exhausted", "uri": uri},
+                                    extra_headers=(("Retry-After", "1"),))
+                            return
                     poll = 0.01
                     while True:
                         res = serving.queue.get_result(uri)
@@ -244,6 +301,8 @@ class HealthServer:
                         poll = min(poll * 1.5, 0.25)
                     nbytes = self._reply(404, {"ready": False, "uri": uri})
                 finally:
+                    if parked:
+                        gateway._longpoll_slots.release()
                     gateway._observe("result", t0, nbytes)
 
             def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
@@ -286,9 +345,13 @@ class HealthServer:
                                               f"cap {MAX_BODY_BYTES}"})
                         return
                     body = self.rfile.read(length)
+                    import math
                     timeout_s = self._query_float(parts.query, "timeout_s")
+                    # inf = "no budget": no deadline stamped (int(inf)
+                    # would overflow; the result path clamps instead)
                     deadline_ns = (time.time_ns() + int(timeout_s * 1e9)
-                                   if timeout_s else None)
+                                   if timeout_s and math.isfinite(timeout_s)
+                                   else None)
                     ctype = (self.headers.get("Content-Type")
                              or "").lower()
                     binary = "octet-stream" in ctype \
@@ -309,9 +372,35 @@ class HealthServer:
                             self._reply(400, {"error": f"malformed "
                                                        f"frame: {e}"})
                             return
+                        if "shm" in header:
+                            # the shm lane is same-host trusted-client
+                            # only: a remote ref would have the engine
+                            # attach ANY named /dev/shm segment (and one
+                            # spoofed geometry poisons the per-name
+                            # attachment cache for legitimate producers)
+                            self._reply(400,
+                                        {"error": "shm frames are not "
+                                                  "accepted over HTTP"})
+                            return
+                        if not isinstance(header["uri"], str):
+                            # the frame carries the uri verbatim to the
+                            # engine, which keys results by it: a non-str
+                            # uri would serve under a key GET /v1/result
+                            # can never look up
+                            self._reply(400, {"error": "frame uri must "
+                                                       "be a string"})
+                            return
                         record, uri = frame, header["uri"]
                         trace_id = header.get("trace_id", trace_id)
                         deadline_ns = header.get("deadline_ns")
+                        if not self._deadline_ok(deadline_ns):
+                            # the junk value is INSIDE the enqueued frame:
+                            # the engine's shed gate int()s it outside the
+                            # per-record quarantine, so it must not pass
+                            self._reply(400,
+                                        {"error": "frame deadline_ns "
+                                                  "must be numeric"})
+                            return
                     else:
                         try:
                             record = json.loads(body)
@@ -328,6 +417,42 @@ class HealthServer:
                                                   "an object with a "
                                                   "'uri'"})
                             return
+                        if "shm" in record or "payload" in record:
+                            # same edge stance as the frame path: 'shm'
+                            # routes the engine into attaching arbitrary
+                            # host segments, 'payload' is the internal
+                            # frame-decoded form — neither is a remote-
+                            # client surface
+                            self._reply(400,
+                                        {"error": "'shm'/'payload' "
+                                                  "records are not "
+                                                  "accepted over HTTP"})
+                            return
+                        # typed edge validation: the engine's read loop
+                        # runs OUTSIDE the per-record quarantine, so a
+                        # junk-typed field here would crash-loop the
+                        # preprocess worker (restart -> redelivery ->
+                        # crash again), not quarantine one record
+                        for key in ("b64", "image"):
+                            if key in record and \
+                                    not isinstance(record[key], str):
+                                self._reply(400,
+                                            {"error": f"'{key}' must be "
+                                                      f"a base64 string"})
+                                return
+                        if not self._deadline_ok(
+                                record.get("deadline_ns")):
+                            self._reply(400,
+                                        {"error": "deadline_ns must be "
+                                                  "numeric"})
+                            return
+                        # engine-derived bookkeeping, never client input
+                        record.pop("wire_bytes", None)
+                        record.pop("wire_fmt", None)
+                        # results are keyed by the queue rid (the uri):
+                        # coerce to str so InProc dict lookups from
+                        # GET /v1/result/<uri> find what the engine wrote
+                        record["uri"] = str(record["uri"])
                         record.setdefault("trace_id", trace_id)
                         trace_id = record["trace_id"]
                         if deadline_ns is not None:

@@ -416,7 +416,16 @@ class InProcQueue(BaseQueue):
             raw = []
             with self._lock:
                 while self._stream and len(raw) + len(out) < max_items:
-                    raw.append(self._stream.popleft())
+                    rid, rec = self._stream.popleft()
+                    # claim in the SAME critical section as the pop:
+                    # stream + pending counts stay conserved, so a
+                    # concurrent observer (health snapshot, drain check)
+                    # never sees records vanish into an in-flight decode
+                    self._pending[rid] = {"record": rec,
+                                          "claim_ts": time.monotonic(),
+                                          "consumer": self.consumer,
+                                          "deliveries": 1}
+                    raw.append((rid, rec))
             for rid, rec in raw:
                 if not isinstance(rec, dict):
                     # binary frame: decode at the consume boundary; the
@@ -425,14 +434,15 @@ class InProcQueue(BaseQueue):
                     try:
                         rec = _wire.frame_to_record(rec)
                     except _wire.FrameError as e:
+                        with self._lock:
+                            self._pending.pop(rid, None)
                         self.put_error(rid, f"read_batch: malformed "
                                             f"frame: {e}")
                         continue
-                with self._lock:
-                    self._pending[rid] = {"record": rec,
-                                          "claim_ts": time.monotonic(),
-                                          "consumer": self.consumer,
-                                          "deliveries": 1}
+                    with self._lock:
+                        entry = self._pending.get(rid)
+                        if entry is not None:
+                            entry["record"] = rec
                 out.append((rid, rec))
             if out or time.time() > deadline:
                 break
@@ -446,17 +456,33 @@ class InProcQueue(BaseQueue):
 
     def reclaim(self, min_idle_s, max_items=64):
         now = time.monotonic()
-        out = []
+        out, bad = [], []
         with self._lock:
-            for rid, entry in self._pending.items():
+            for rid, entry in list(self._pending.items()):
                 if len(out) >= max_items:
                     break
                 if now - entry["claim_ts"] < min_idle_s:
                     continue
+                rec = entry["record"]
+                if not isinstance(rec, dict):
+                    # a raw frame claimed by a reader that died between
+                    # the claim and its decode (read_batch claims first
+                    # so stream+pending stay conserved): decode at THIS
+                    # consume boundary — the engine's read loop assumes
+                    # dict records
+                    try:
+                        rec = _wire.frame_to_record(rec)
+                    except _wire.FrameError as e:
+                        bad.append((rid, str(e)))
+                        del self._pending[rid]
+                        continue
+                    entry["record"] = rec
                 entry["claim_ts"] = now
                 entry["consumer"] = self.consumer
                 entry["deliveries"] += 1
-                out.append((rid, entry["record"], entry["deliveries"]))
+                out.append((rid, rec, entry["deliveries"]))
+        for rid, err in bad:     # put_error takes the lock: outside it
+            self.put_error(rid, f"reclaim: malformed frame: {err}")
         return out
 
     def pending_count(self):

@@ -379,6 +379,17 @@ class ShmRing:
                                             "shared_memory")
             except Exception:  # noqa: BLE001 — tracker internals differ
                 pass           # across versions; worst case is a warning
+            if self._shm.size < size:
+                # a reference whose geometry exceeds the real segment
+                # would compute control/payload offsets past the mapping
+                # — and, cached, poison every later decode for this
+                # segment name: refuse the attach instead
+                actual = self._shm.size
+                self.close()
+                raise FrameError(
+                    f"shm ref geometry ({self.slots} slots x "
+                    f"{self.slot_bytes} bytes -> {size} bytes) exceeds "
+                    f"segment {name!r} ({actual} bytes)")
         self.name = self._shm.name
         self._next = 0
         self._lock = threading.Lock()
@@ -438,7 +449,15 @@ class ShmRing:
                 f"shm slot {ref['slot']} overwritten (gen {gen} != "
                 f"{ref['gen']}): producer lapped the ring — size slots >= "
                 "the queue's max_depth")
-        if check_crc and "crc" in ref:
+        if check_crc and "crc" not in ref:
+            # the crc is MANDATORY for the full check: every write()
+            # stamps one, so a ref without it is hand-built — and gen/len
+            # alone can collide under a mismatched geometry (a spoofed
+            # layout reading the honest ring's slot-0 control record),
+            # which would serve arbitrary in-segment bytes as tensor data
+            raise FrameError(
+                f"shm ref for slot {ref['slot']} lacks the payload crc")
+        if check_crc:
             # checksum the CURRENT slot bytes against the reference: on
             # weakly-ordered hardware a lapping writer's payload stores can
             # land before its invalidation store, which the generation
@@ -465,24 +484,75 @@ class ShmRing:
                 pass
 
 
-# consumer-side attachment cache: one mapping per segment name per process
-_ATTACHED: Dict[str, ShmRing] = {}
+# consumer-side attachment cache: one mapping per (segment name, geometry)
+# per process — keyed on geometry so a ref with a bogus layout attaches its
+# OWN (self-quarantining) mapping and can never poison the mapping a
+# legitimate producer's records decode through
+_ATTACHED: Dict[Tuple[str, int, int], ShmRing] = {}
 _ATTACH_LOCK = threading.Lock()
+# honest producers use one geometry per segment; a flood of DISTINCT
+# spoofed geometries must not accumulate live mappings on a long-lived
+# engine (eviction is unsafe — another thread may hold a slot view into
+# an evicted mapping — so past the cap new attachments quarantine instead)
+_MAX_ATTACHED = 32
 
 
 def attach_ring(ref: Dict) -> ShmRing:
-    """Attach (once per process) to the segment a slot reference names.
-    The control layout is self-describing only through the producer's
-    geometry, which rides in the reference."""
+    """Attach (once per process per geometry) to the segment a slot
+    reference names.  The control layout is self-describing only through
+    the producer's geometry, which rides in the reference — so the attach
+    validates that geometry against the real segment size (``FrameError``
+    on a ref that overstates it, nothing cached) and caches per
+    (name, slots, slot_bytes): a ref that UNDERSTATES the geometry maps a
+    layout whose gen/crc checks fail only for its own records, while the
+    honest producer's refs keep decoding through their own mapping."""
     name = str(ref["name"])
+    slots = int(ref.get("slots", 64))
+    slot_bytes = int(ref.get("slot_bytes", 1 << 16))
+    key = (name, slots, slot_bytes)
     with _ATTACH_LOCK:
-        ring = _ATTACHED.get(name)
+        ring = _ATTACHED.get(key)
         if ring is None:
-            ring = ShmRing(name=name, slots=int(ref.get("slots", 64)),
-                           slot_bytes=int(ref.get("slot_bytes", 1 << 16)),
+            if len(_ATTACHED) >= _MAX_ATTACHED:
+                _evict_dead_attachments()
+            if len(_ATTACHED) >= _MAX_ATTACHED:
+                raise FrameError(
+                    f"shm attachment cache full ({_MAX_ATTACHED} live "
+                    "mappings): refusing a new (name, geometry) "
+                    "attachment — distinct-geometry ref flood, or "
+                    "detach_all() overdue")
+            ring = ShmRing(name=name, slots=slots, slot_bytes=slot_bytes,
                            create=False)
-            _ATTACHED[name] = ring
+            _ATTACHED[key] = ring
         return ring
+
+
+def _evict_dead_attachments() -> None:
+    """Called with ``_ATTACH_LOCK`` held when the cache is at cap: drop
+    mappings whose segment has been UNLINKED.  Every producer restart
+    creates a fresh segment name (`InputQueue` -> new ``ShmRing``), so on
+    a long-lived engine dead mappings would otherwise fill the cap and
+    permanently quarantine the 33rd producer's traffic.  An unlinked
+    segment's in-flight records are already doomed to quarantine (the
+    producer must outlive consumption — README caveat), so evicting its
+    mapping under pressure costs nothing that was not already lost."""
+    from multiprocessing import shared_memory
+    for key in list(_ATTACHED):
+        try:
+            probe = shared_memory.SharedMemory(name=key[0])
+        except FileNotFoundError:
+            _ATTACHED.pop(key).close()
+            continue
+        except OSError:
+            continue                   # transient: keep the mapping
+        # still live: release the probe (and keep it off the resource
+        # tracker's exit-time unlink list, same as the ShmRing attach)
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(probe._name, "shared_memory")
+        except Exception:  # noqa: BLE001 — tracker internals differ
+            pass
+        probe.close()
 
 
 def detach_all() -> None:

@@ -99,6 +99,13 @@ def test_poisoned_stream_completes_with_quarantine(queue_kind, tmp_path, ctx):
             assert OutputQueue.is_error(got[rid]), got[rid]
         # dead letters visible from the client side
         assert sorted(d["uri"] for d in cout.dead_letters()) == sorted(bad)
+        # served/dead-letter counters bump AFTER the result flush the
+        # drain just observed: give the writer stage a beat instead of
+        # racing it
+        deadline = time.time() + 5
+        while (serving.total_records, serving.dead_lettered) != (17, 3) \
+                and time.time() < deadline:
+            time.sleep(0.02)
         # both workers still alive and healthy
         h = serving.health()
         assert h["running"] is True

@@ -182,6 +182,62 @@ def test_inproc_passes_frame_buffer_by_reference():
                             np.frombuffer(frame, np.uint8))
 
 
+def test_inproc_read_batch_claims_before_decode(monkeypatch):
+    """Stream + pending counts are CONSERVED across read_batch: a record
+    is moved into the pending table in the same critical section as the
+    pop, so a concurrent observer (health snapshot, drain check) never
+    sees it in neither structure while its frame decodes; a malformed
+    frame is claimed, then quarantined back OUT of pending."""
+    q = InProcQueue()
+    frame = wire.encode_tensor_frame("c-1", np.arange(DIM, dtype="<f4"))
+    q.xadd(frame)
+    seen = {}
+    real = wire.frame_to_record
+
+    def spy(buf):
+        seen["depth"], seen["pending"] = q.depth(), q.pending_count()
+        return real(buf)
+
+    monkeypatch.setattr(wire, "frame_to_record", spy)
+    ((rid, rec),) = q.read_batch(8)
+    assert rid == "c-1"
+    assert seen == {"depth": 0, "pending": 1}    # claimed mid-decode
+    with q._lock:                                # pending holds the
+        assert q._pending[rid]["record"] is rec  # DECODED record
+    q.ack([rid])
+    # malformed frame (valid header, truncated payload): quarantined, not
+    # left claimed
+    q.xadd(frame[:-2])
+    assert q.read_batch(8) == []
+    assert q.pending_count() == 0 and q.dead_letter_count() == 1
+    assert "malformed" in q.get_result("c-1")["error"]
+
+
+def test_inproc_reclaim_decodes_orphaned_raw_claims():
+    """read_batch claims the RAW frame before decoding (count
+    conservation), so a reader dying in that window leaves undecoded
+    bytes in the pending table: reclaim must decode them at ITS consume
+    boundary — the engine's read loop assumes dict records — and
+    quarantine malformed orphans instead of redelivering bytes."""
+    q = InProcQueue()
+    good = wire.encode_tensor_frame("o-1", np.arange(DIM, dtype="<f4"))
+    bad = wire.encode_tensor_frame("o-2", np.ones(DIM, dtype="<f4"))[:-2]
+    for frame in (good, bad):
+        q.xadd(frame)
+        with q._lock:                    # reader died claim-but-not-decode
+            rid, raw = q._stream.popleft()
+            q._pending[rid] = {"record": raw,
+                               "claim_ts": time.monotonic() - 99,
+                               "consumer": "dead", "deliveries": 1}
+    ((rid, rec, deliveries),) = q.reclaim(min_idle_s=1)
+    assert rid == "o-1" and isinstance(rec, dict) and deliveries == 2
+    np.testing.assert_allclose(default_preprocess(rec),
+                               np.arange(DIM, dtype=np.float32))
+    assert q.pending_count() == 1        # the malformed orphan left
+    assert q.dead_letter_count() == 1
+    assert "malformed" in q.get_result("o-2")["error"]
+
+
 def test_filequeue_spools_frames_directly(tmp_path):
     q = FileQueue(str(tmp_path / "q"))
     arr = np.arange(4, dtype="<f4")
@@ -217,7 +273,42 @@ def test_mixed_format_stream_all_served(kind, tmp_path, ctx):
         got = cout.query_many(rids, timeout_s=20)
         assert all(got[r] is not None and not OutputQueue.is_error(got[r])
                    for r in rids), got
+        # the served counter bumps AFTER the result flush the client just
+        # observed: give the writer stage a beat instead of racing it
+        deadline = time.time() + 5
+        while serving.total_records < 12 and time.time() < deadline:
+            time.sleep(0.02)
         assert serving.total_records == 12 and serving.dead_lettered == 0
+    finally:
+        serving.shutdown()
+
+
+def test_engine_quarantines_junk_deadline_from_raw_producer(ctx):
+    """The deadline shed gate runs OUTSIDE the per-record quarantine: a
+    raw-xadd producer's junk deadline_ns must dead-letter that record
+    alone (error result, claim released), not crash-loop the read worker
+    via restart + lease redelivery.  The gateway 400s these at the edge;
+    this covers every other producer."""
+    q = InProcQueue()
+    serving = _serving(q)
+    serving.start()
+    try:
+        q.xadd({"uri": "bad-dl", "data": [0.1] * DIM,
+                "deadline_ns": "abc"})
+        q.xadd({"uri": "good", "data": [0.2] * DIM})
+        out = OutputQueue(q)
+        res = {}
+        deadline = time.time() + 20
+        while time.time() < deadline and len(res) < 2:
+            for uri, r in out.query_many(["bad-dl", "good"]).items():
+                if r is not None:
+                    res[uri] = r
+            time.sleep(0.05)
+        assert "value" in res.get("good", {}), res
+        assert "ValueError" in res.get("bad-dl", {}).get("error", ""), res
+        assert q.dead_letter_count() == 1
+        assert q.pending_count() == 0            # claim released, no
+        assert serving.health()["running"]       # redelivery churn
     finally:
         serving.shutdown()
 
@@ -433,6 +524,129 @@ def test_shm_oversized_payload_falls_back_to_bin(ctx):
     cin.close()
 
 
+def test_attach_ring_rejects_overstated_geometry():
+    """A ref whose geometry exceeds the real segment would compute
+    offsets past the mapping — and, first-seen-cached, poison every later
+    decode for that segment name: the attach validates geometry against
+    the segment size, raises FrameError, and caches NOTHING, so the
+    honest producer's refs still decode afterwards."""
+    ring = wire.ShmRing(slots=2, slot_bytes=256)
+    try:
+        payload = np.arange(8, dtype="<f4").tobytes()
+        ref = ring.write(payload)
+        spoof = dict(ref, slots=1024, slot_bytes=1 << 20)
+        with pytest.raises(wire.FrameError, match="geometry"):
+            wire.attach_ring(spoof)
+        # the failed attach cached nothing for this segment
+        assert not any(k[0] == ring.name for k in wire._ATTACHED)
+        honest = wire.attach_ring(ref)
+        assert bytes(honest.slot_view(ref)) == payload
+        honest.verify(ref)
+    finally:
+        wire.detach_all()
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_ref_without_crc_is_rejected():
+    """gen/len alone can collide under a spoofed geometry (a bogus layout
+    reading the honest ring's slot-0 control record), which would serve
+    arbitrary in-segment bytes as tensor data: the full verify REQUIRES
+    the crc every write() stamps, so a hand-built ref without one
+    quarantines instead of decoding."""
+    ring = wire.ShmRing(slots=4, slot_bytes=256)
+    try:
+        ref = ring.write(np.arange(8, dtype="<f4").tobytes())
+        bare = {k: v for k, v in ref.items() if k != "crc"}
+        consumer = wire.attach_ring(bare)
+        consumer.slot_view(bare)             # cheap pre-check alone passes
+        with pytest.raises(wire.FrameError, match="crc"):
+            consumer.verify(bare)            # the post-copy gate refuses
+    finally:
+        wire.detach_all()
+        ring.close()
+        ring.unlink()
+
+
+def test_attach_understated_geometry_quarantines_alone():
+    """A ref that UNDERSTATES the geometry fits inside the segment, so it
+    cannot be rejected by size — but the cache is keyed per
+    (name, slots, slot_bytes), so the bogus layout gets its OWN mapping
+    whose gen/crc checks fail only for its own records; the honest
+    producer's refs keep decoding through theirs (no first-seen cache
+    poisoning, no persistent quarantine of good traffic)."""
+    ring = wire.ShmRing(slots=4, slot_bytes=256)
+    try:
+        payload = np.arange(8, dtype="<f4").tobytes()
+        ref = ring.write(payload)
+        spoof = dict(ref, slots=1, slot_bytes=16)    # size-compatible lie
+        bogus = wire.attach_ring(spoof)
+        # spoofed slot-0 ctrl offset collides with the honest one, so the
+        # cheap gen/len pre-check passes — over the WRONG payload bytes
+        view = bogus.slot_view(spoof)
+        assert bytes(view) != payload
+        with pytest.raises(wire.FrameError):
+            bogus.verify(spoof)                      # crc gate catches it
+        # honest refs are untouched by the bogus mapping
+        honest = wire.attach_ring(ref)
+        assert honest is not bogus
+        assert bytes(honest.slot_view(ref)) == payload
+        honest.verify(ref)
+    finally:
+        wire.detach_all()
+        ring.close()
+        ring.unlink()
+
+
+def test_attach_cache_capped_against_geometry_flood():
+    """Every distinct (name, geometry) caches a live mapping: a flood of
+    spoofed geometries must hit a cap (FrameError -> per-record
+    quarantine) instead of accumulating mmaps for the engine lifetime —
+    and the honest producer's mapping survives the flood."""
+    ring = wire.ShmRing(slots=2, slot_bytes=64)
+    try:
+        ref = ring.write(b"\x01" * 8)
+        honest = wire.attach_ring(ref)
+        with pytest.raises(wire.FrameError, match="cache full"):
+            for sb in range(1, wire._MAX_ATTACHED + 2):
+                wire.attach_ring(dict(ref, slots=1, slot_bytes=sb))
+        assert wire.attach_ring(ref) is honest       # still cached
+    finally:
+        wire.detach_all()
+        ring.close()
+        ring.unlink()
+
+
+def test_attach_cache_evicts_dead_segments_under_pressure(monkeypatch):
+    """The cap must not starve legitimate traffic: every producer restart
+    leaves a dead (unlinked) segment's mapping behind, and under cap
+    pressure those are evicted — only refs to LIVE segments keep their
+    mappings, and a flood against live segments still quarantines."""
+    monkeypatch.setattr(wire, "_MAX_ATTACHED", 2)
+    dead = wire.ShmRing(slots=1, slot_bytes=32)
+    live = wire.ShmRing(slots=1, slot_bytes=32)
+    newer = wire.ShmRing(slots=1, slot_bytes=32)
+    dead_ref = dead.write(b"x" * 4)
+    live_ref = live.write(b"y" * 4)
+    newer_ref = newer.write(b"z" * 4)
+    try:
+        wire.attach_ring(dead_ref)
+        wire.attach_ring(live_ref)           # cache at cap
+        dead.close()
+        dead.unlink()                        # producer restarted
+        ring = wire.attach_ring(newer_ref)   # evicts the dead mapping
+        assert bytes(ring.slot_view(newer_ref)) == b"z" * 4
+        assert len(wire._ATTACHED) == 2
+        # live segments are never evicted: a flood still hits the cap
+        with pytest.raises(wire.FrameError, match="cache full"):
+            wire.attach_ring(dict(live_ref, slots=1, slot_bytes=8))
+    finally:
+        wire.detach_all()
+        for r in (live, newer):
+            r.close()
+            r.unlink()
+
+
 # -- HTTP ingestion gateway ----------------------------------------------------
 
 def _curl(args, body=None):
@@ -579,6 +793,171 @@ def test_gateway_rejects_traversal_uris(tmp_path, ctx):
         assert q.depth() == 0
     finally:
         serving.shutdown()
+
+
+def test_gateway_rejects_shm_records(ctx):
+    """The shm lane is a same-host trusted-native-client transport: a
+    remote ref would have the engine attach ANY named /dev/shm segment on
+    the host (and a spoofed geometry would poison the per-name attachment
+    cache).  Both carriers — a FLAG_SHM binary frame and a JSON record
+    with a 'shm' (or internal 'payload') key — are rejected 400 at the
+    edge, never enqueued."""
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    from analytics_zoo_tpu.serving.http import HealthServer
+    server = HealthServer(serving, port=0).start()
+    try:
+        port = server.port
+        spoof = {"name": "any_host_segment", "slot": 0, "gen": 1,
+                 "len": 16, "slots": 4, "slot_bytes": 64}
+        frame = wire.encode_tensor_frame(
+            "shm-1", np.ones(DIM, "<f4"), shm_ref=spoof)
+        code, body = _curl(
+            [f"http://127.0.0.1:{port}/v1/enqueue",
+             "-X", "POST", "-H", "Content-Type: application/octet-stream",
+             "--data-binary", "@-"], body=frame)
+        assert code == 400 and "shm" in json.loads(body)["error"]
+        for key, val in (("shm", spoof), ("payload", [1, 2, 3])):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/enqueue",
+                data=json.dumps({"uri": "shm-2", key: val}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+        assert q.depth() == 0                # nothing reached the stream
+        assert wire._ATTACHED == {}          # nothing attached or cached
+    finally:
+        server.stop()
+
+
+def test_gateway_rejects_untyped_fields(ctx):
+    """The engine's read loop (deadline shed gate, wire-byte accounting)
+    runs OUTSIDE the per-record quarantine: a junk-typed field in a remote
+    record would crash-loop the preprocess worker via redelivery, so types
+    are enforced at the edge — and a non-string uri is coerced, since
+    results are keyed by the rid and GET /v1/result looks up by string."""
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    from analytics_zoo_tpu.serving.http import HealthServer
+    server = HealthServer(serving, port=0).start()
+    try:
+        port = server.port
+
+        def post_json(rec):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/enqueue",
+                data=json.dumps(rec).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                return json.loads(urllib.request.urlopen(req).read()), 200
+            except urllib.error.HTTPError as e:
+                return json.loads(e.read()), e.code
+
+        for bad in ({"uri": "x", "data": [1.0], "deadline_ns": "abc"},
+                    # json accepts Infinity; int(inf) is OverflowError
+                    {"uri": "x", "data": [1.0], "deadline_ns": 1e999},
+                    {"uri": "x", "b64": 123},
+                    {"uri": "x", "image": ["not", "a", "str"]}):
+            body, code = post_json(bad)
+            assert code == 400, (bad, body)
+        # junk deadline INSIDE a binary frame is rejected too (the frame
+        # is enqueued verbatim, so a local restamp could not fix it)
+        frame = wire.encode_frame(
+            {"uri": "x", "deadline_ns": "abc"},
+            payload=np.ones(DIM, "<f4"))
+        code, body = _curl(
+            [f"http://127.0.0.1:{port}/v1/enqueue",
+             "-X", "POST", "-H", "Content-Type: application/octet-stream",
+             "--data-binary", "@-"], body=frame)
+        assert code == 400 and "deadline_ns" in json.loads(body)["error"]
+        frame = wire.encode_frame({"uri": 123}, payload=np.ones(DIM, "<f4"))
+        code, body = _curl(
+            [f"http://127.0.0.1:{port}/v1/enqueue",
+             "-X", "POST", "-H", "Content-Type: application/octet-stream",
+             "--data-binary", "@-"], body=frame)
+        assert code == 400 and "uri" in json.loads(body)["error"]
+        assert q.depth() == 0
+        # accepted records: int uri coerced to str, engine-internal
+        # bookkeeping keys stripped
+        body, code = post_json({"uri": 123, "data": [1.0] * DIM,
+                                "wire_bytes": "z", "wire_fmt": "spoof"})
+        assert code == 200 and body["uri"] == "123"
+        ((rid, rec),) = q.read_batch(1)
+        assert rid == "123" and rec["uri"] == "123"
+        assert "wire_bytes" not in rec and "wire_fmt" not in rec
+    finally:
+        server.stop()
+
+
+def test_gateway_longpoll_inflight_cap(ctx, monkeypatch):
+    """Parked long-polls pin one handler thread each: past
+    LONGPOLL_MAX_INFLIGHT the gateway answers one immediate lookup (200 on
+    a hit, 503 + Retry-After on a miss) instead of parking, and no-timeout
+    GETs are unaffected by the cap."""
+    import threading as _threading
+
+    from analytics_zoo_tpu.serving import http as http_mod
+    monkeypatch.setattr(http_mod, "LONGPOLL_MAX_INFLIGHT", 1)
+    q = InProcQueue()
+    serving = _serving(q, http_port=0)
+    server = http_mod.HealthServer(serving, port=0).start()
+    try:
+        port = server.port
+        # timeout_s=inf means "wait as long as you allow": clamped to the
+        # long-poll cap, NOT degraded to an instant 404
+        _threading.Timer(0.3, q.put_result,
+                         args=("late", {"value": [3.0]})).start()
+        res = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/result/late?timeout_s=inf").read())
+        assert res["value"] == [3.0]
+        parked = _threading.Thread(
+            target=urllib.request.urlopen,
+            args=(f"http://127.0.0.1:{port}/v1/result/parked?timeout_s=10",),
+            daemon=True)
+        parked.start()
+        deadline = time.time() + 5
+        while server._longpoll_slots._value and time.time() < deadline:
+            time.sleep(0.01)                 # wait until the slot is held
+        assert server._longpoll_slots._value == 0
+        # overflow long-poll on a miss: 503 with backoff advice, instantly
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/result/other?timeout_s=10")
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1"
+        assert time.time() - t0 < 5          # did not park
+        # overflow long-poll on a hit still serves the result
+        q.put_result("ready", {"value": [1.0]})
+        res = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/result/ready?timeout_s=10").read())
+        assert res["value"] == [1.0]
+        # a plain (no-timeout) GET needs no slot: clean 404 miss
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/result/other")
+        assert ei.value.code == 404
+        # timeout_s=nan must not become an UNCOUNTED never-expiring poll
+        # loop (nan deadline comparisons are all False): treated as no
+        # timeout — an immediate miss, no thread pinned
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/result/other?timeout_s=nan")
+        assert ei.value.code == 404 and time.time() - t0 < 5
+        # inf on the enqueue side must not 500 on the deadline int()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/enqueue?timeout_s=inf",
+            data=json.dumps({"uri": "inf-1", "data": [0.1]}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.loads(urllib.request.urlopen(req).read())
+        assert doc["uri"] == "inf-1" and "deadline_ns" not in doc
+        q.put_result("parked", {"value": [2.0]})     # unpark the holder
+        parked.join(timeout=5)
+        assert not parked.is_alive()
+    finally:
+        server.stop()
 
 
 def test_gateway_off_keeps_probe_only_port(ctx):
