@@ -410,7 +410,11 @@ class Estimator:
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, l
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        # per-leaf donation: params leaves XLA cannot alias (embedding
+        # gather operands under layout assignment — the bert_large warning)
+        # are excluded instead of warning on every compile
+        from analytics_zoo_tpu.utils.donation import donation_safe_jit
+        return donation_safe_jit(step, donate_argnums=(0, 1, 2))
 
     def _build_scanned_train_step(self):
         """k steps fused into one XLA program via lax.scan over stacked batches —
@@ -440,7 +444,8 @@ class Estimator:
                 one, (params, opt_state, state), (xs, ys, ws, rngs))
             return params, opt_state, state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        from analytics_zoo_tpu.utils.donation import donation_safe_jit
+        return donation_safe_jit(multi, donate_argnums=(0, 1, 2))
 
     def _build_eval_step(self):
         model, loss_fn, metric_objs = self.model, self.loss, self.metrics

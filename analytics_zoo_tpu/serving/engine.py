@@ -325,6 +325,7 @@ class ServingParams:
                  replica_id: Optional[str] = None,
                  lease_s: float = 30.0,
                  reclaim_interval_s: Optional[float] = None,
+                 max_deliveries: int = 5,
                  mesh_shape=None,
                  sharding: str = "off",
                  gateway: bool = True):
@@ -377,6 +378,11 @@ class ServingParams:
         self.replica_id = replica_id
         self.lease_s = lease_s
         self.reclaim_interval_s = reclaim_interval_s
+        # poison-pill parking (PR 10): a record delivered more than this
+        # many times (first delivery counts) is parked to the dead-letter
+        # queue with a `max-deliveries-exceeded` error instead of looping
+        # through reclaim -> crash -> reclaim forever.  <= 0 disables.
+        self.max_deliveries = int(max_deliveries)
         # sharded multi-chip serving (PR 6): route predict through a pjit'd
         # program over the ICI mesh.  `sharding`: off (single-chip, the
         # default) | auto (batch-shard small models, tensor-shard large) |
@@ -426,6 +432,7 @@ class ServingParams:
             lease_s=float(p.get("lease_s", 30.0)),
             reclaim_interval_s=(None if p.get("reclaim_interval_s") is None
                                 else float(p["reclaim_interval_s"])),
+            max_deliveries=int(p.get("max_deliveries", 5)),
             mesh_shape=(None if p.get("mesh_shape") is None
                         else tuple(int(v) for v in p["mesh_shape"])
                         if isinstance(p["mesh_shape"], (list, tuple))
@@ -464,6 +471,12 @@ class ClusterServing:
             lambda p: default_postprocess(p, self.params.top_n))
         self._stop = threading.Event()
         self._draining = threading.Event()   # graceful drain in progress
+        # decommission drain (PR 10): this replica stops CLAIMING new work
+        # and flushes what it holds, while the shared queue stays open for
+        # the surviving replicas — the scale-down shape.  The PR 2 whole-
+        # deployment drain (admission closed) is the close_admission=True
+        # path of shutdown().
+        self._retiring = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.total_records = 0
         self.dead_lettered = 0
@@ -516,6 +529,13 @@ class ClusterServing:
         self._predict_sup: Optional[SupervisedThread] = None
         self._write_sup: Optional[SupervisedThread] = None
         self._pre_pool = None                # lazy preprocess thread pool
+        self._pre_pool_size = 0              # workers in the live pool
+        # live retune (PR 10 autoscaler): validated knob targets staged by
+        # retune() and APPLIED at the preprocess loop's batch boundary —
+        # the one thread that owns the batcher/pool — so a mid-batch nudge
+        # can never tear the pipeline
+        self._knob_lock = threading.Lock()
+        self._pending_knobs: Dict[str, float] = {}
         self._last_trim = time.monotonic()   # amortized trim schedule
         # per-stage timers + end-to-end (read -> result written) latency,
         # now registry histograms: same .record()/.snapshot() surface as the
@@ -705,6 +725,23 @@ class ClusterServing:
                 # record dead-letters WITH its delivery count, and the
                 # result write stamps it for the client
                 rec["deliveries"] = deliveries
+            if 0 < p.max_deliveries < deliveries:
+                # poison-pill parking (PR 10): a record that keeps getting
+                # redelivered — e.g. it crashes every replica that claims
+                # it, or its terminal write keeps failing — must not loop
+                # through reclaim forever, burning a predict slot per lease.
+                # Park it in the dead-letter queue (error result + entry,
+                # claim released) where `manager replay` can resurrect it
+                # after a fix.
+                self._quarantine(
+                    rid, "reclaim",
+                    RuntimeError(
+                        f"max-deliveries-exceeded: delivery "
+                        f"{deliveries} > max_deliveries="
+                        f"{p.max_deliveries}"),
+                    record=rec if isinstance(rec, dict) else None,
+                    trace_id=tid)
+                continue
             self._redelivered[rid] = deliveries
             out.append((rid, rec))
         if len(self._redelivered) > 4096:
@@ -916,10 +953,103 @@ class ClusterServing:
             return None
         if self._pre_pool is None:
             from concurrent.futures import ThreadPoolExecutor
+            self._pre_pool_size = self.params.preprocess_workers
             self._pre_pool = ThreadPoolExecutor(
-                max_workers=self.params.preprocess_workers,
+                max_workers=self._pre_pool_size,
                 thread_name_prefix="serving-pre")
         return self._pre_pool
+
+    # -- live retune (PR 10 closed-loop autoscaling) -------------------------
+    MAX_PREPROCESS_WORKERS = 32
+
+    def retune(self, max_batch: Optional[int] = None,
+               max_wait_ms: Optional[float] = None,
+               preprocess_workers: Optional[int] = None,
+               inflight_batches: Optional[int] = None) -> Dict:
+        """Stage a live data-plane retune (the autoscaler's FAST actuator
+        tier).  Values are validated/clamped HERE — ``max_batch`` to the
+        pow-2 bucket ladder within [mesh batch axis, model max_batch],
+        ``inflight_batches`` to the model's concurrency contract,
+        ``preprocess_workers`` to [1, MAX_PREPROCESS_WORKERS] — and applied
+        by the preprocess worker at its next batch boundary, so a mid-batch
+        nudge can never tear the pipeline (pool swap and write-queue resize
+        happen between micro-batches, on the threads that own them).
+        Returns the clamped targets that will take effect.  Safe to call
+        before ``start()`` (targets land in params directly at start)."""
+        from analytics_zoo_tpu.inference.inference_model import _pow2_floor
+        staged: Dict[str, float] = {}
+        if max_batch is not None:
+            mb = _pow2_floor(max(1, int(max_batch)))
+            multiple = getattr(self.model, "_batch_multiple", 1) or 1
+            cap = getattr(self.model, "max_batch", None)
+            mb = max(mb, int(multiple))      # pow-2 >= multiple divides it
+            if cap is not None:
+                mb = min(mb, int(cap))
+            staged["max_batch"] = mb
+        if max_wait_ms is not None:
+            staged["max_wait_ms"] = max(0.0, float(max_wait_ms))
+        if preprocess_workers is not None:
+            staged["preprocess_workers"] = min(
+                max(1, int(preprocess_workers)), self.MAX_PREPROCESS_WORKERS)
+        if inflight_batches is not None:
+            inflight = max(1, int(inflight_batches))
+            model_cap = getattr(self.model, "concurrent_num", None)
+            if model_cap is not None:
+                inflight = min(inflight, int(model_cap))
+            staged["inflight_batches"] = inflight
+        with self._knob_lock:
+            self._pending_knobs.update(staged)
+        return staged
+
+    def knobs(self) -> Dict:
+        """Current data-plane knob targets (pending retunes win over the
+        applied params) — the autoscaler's view of where the fast tier is."""
+        p = self.params
+        doc = {"max_batch": p.max_batch or p.batch_size,
+               "max_wait_ms": p.max_wait_ms,
+               "preprocess_workers": p.preprocess_workers,
+               "inflight_batches": p.inflight_batches,
+               "max_batch_ceiling": int(getattr(self.model, "max_batch",
+                                                1024) or 1024),
+               "inflight_ceiling": int(getattr(self.model, "concurrent_num",
+                                               None) or 64)}
+        with self._knob_lock:
+            doc.update(self._pending_knobs)
+        return doc
+
+    def _apply_pending_knobs(self) -> None:
+        """Apply staged retunes.  Runs on the preprocess worker between
+        micro-batches: `params.max_batch`/`max_wait_ms` are read per batch
+        by `_read_coalesced`, the pool swap happens while no decode is in
+        flight, and the write-queue resize mutates `maxsize` under the
+        queue's own mutex (blocked putters poll on a 0.1 s timeout, so a
+        grown queue is picked up promptly either way)."""
+        with self._knob_lock:
+            if not self._pending_knobs:
+                return
+            staged, self._pending_knobs = self._pending_knobs, {}
+        p = self.params
+        if "max_batch" in staged:
+            p.max_batch = int(staged["max_batch"])
+        if "max_wait_ms" in staged:
+            p.max_wait_ms = float(staged["max_wait_ms"])
+        if "preprocess_workers" in staged:
+            p.preprocess_workers = int(staged["preprocess_workers"])
+            if self._pre_pool is not None and \
+                    self._pre_pool_size != p.preprocess_workers:
+                # no decode in flight at the batch boundary: the old pool
+                # has nothing queued, so the swap is clean
+                self._pre_pool.shutdown(wait=False)
+                self._pre_pool = None
+        if "inflight_batches" in staged:
+            p.inflight_batches = int(staged["inflight_batches"])
+            q = getattr(self, "_writeq", None)
+            if q is not None:
+                with q.mutex:
+                    q.maxsize = p.inflight_batches
+                    q.not_full.notify_all()
+        logger.info("serving: replica %s retuned %s", self.replica_id,
+                    staged)
 
     def _read_and_preprocess(self):
         """Read one micro-batch and preprocess it record-by-record, returning
@@ -939,6 +1069,12 @@ class ClusterServing:
         go through the exact same shed/quarantine/trace machinery."""
         t0 = time.monotonic()
         self._hb_ts = t0      # replica heartbeat: the read loop is alive
+        self._apply_pending_knobs()
+        if self._retiring.is_set():
+            # decommissioning: claim NOTHING new (no reads, no reclaims) so
+            # the pipeline flushes and the drain exit fires; the backlog
+            # belongs to the surviving replicas
+            return None
         batch = self._maybe_reclaim()
         batch += self._read_coalesced()
         t_read = time.monotonic()
@@ -1218,6 +1354,7 @@ class ClusterServing:
         p = self.params
         self._stop.clear()
         self._draining.clear()
+        self._retiring.clear()
         self._t_start = time.monotonic()
         try:
             # a prior drained shutdown closed admission; serving again means
@@ -1296,7 +1433,11 @@ class ClusterServing:
                         self._staged.put(group, timeout=0.1)
                         break
                     except _FULL:
-                        continue       # buffer full: backpressure
+                        # buffer full: backpressure.  Still alive — stamp
+                        # the heartbeat so a saturated replica doesn't read
+                        # as dead to the autoscaler's stale-replica check
+                        self._hb_ts = time.monotonic()
+                        continue
 
     def _predict_loop(self):
         import queue as _q
@@ -1391,6 +1532,9 @@ class ClusterServing:
              "shed": self.shed,
              "breaker": self._breaker.health(),
              "dead_letter_breaker": self._dead_breaker.health(),
+             # live data-plane knob targets (PR 10): the autoscaler's
+             # fleet aggregation reads the fast tier's position from here
+             "knobs": self.knobs(),
              "workers": workers,
              "stages": self.stage_metrics(),
              "queue": queue_health}
@@ -1457,23 +1601,33 @@ class ClusterServing:
         `tools/trace_view.py`)."""
         return self.tracer.export_chrome_trace(path)
 
-    def shutdown(self, drain_s: Optional[float] = None):
+    def shutdown(self, drain_s: Optional[float] = None,
+                 close_admission: bool = True):
         """Stop serving.  With ``drain_s`` (graceful drain, PR 2): close
         admission on the queue, flip `/readyz` to ``draining`` so probes
         stop routing traffic, let the workers finish the stream backlog and
         flush every staged AND dispatched in-flight batch, then join —
         falling back to a hard stop when the budget runs out.  Without it:
-        immediate stop (the PR 1 behaviour)."""
+        immediate stop (the PR 1 behaviour).
+
+        ``close_admission=False`` (PR 10) is the SCALE-DOWN drain: this
+        replica stops claiming new work and flushes what it holds, but the
+        shared queue stays open — N-replica deployments must not have one
+        retiring replica cut off ingest for the survivors (the autoscaler
+        and ``manager scale N`` retire replicas this way)."""
         if drain_s is None:
             drain_s = 0.0
         sups = (self._pre_sup, self._predict_sup, self._write_sup)
         started = any(s is not None for s in sups)
         if drain_s > 0 and started:
             self._draining.set()
-            try:
-                self.queue.close_admission()
-            except Exception:  # noqa: BLE001 — backend down: drain anyway
-                pass
+            if close_admission:
+                try:
+                    self.queue.close_admission()
+                except Exception:  # noqa: BLE001 — backend down: drain
+                    pass           # anyway
+            else:
+                self._retiring.set()
             wait_until(lambda: not any(
                 s is not None and s.is_alive() for s in sups), drain_s)
         # the compat aliases (_pre_thread/_thread) point at the SAME thread

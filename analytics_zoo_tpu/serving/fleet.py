@@ -1,0 +1,316 @@
+"""Cross-replica metrics aggregation (PR 10).
+
+One replica's registry answers "how is THIS engine doing"; an elastic
+deployment needs the FLEET view — the same numbers the autoscaler feeds
+its policy and the operator asks ``manager metrics --all-replicas`` for.
+This module is that aggregation, shared by both consumers:
+
+- ``replica_docs(pidfile, ...)`` — one health document per replica slot:
+  scraped over HTTP from the replica's probe port (``http_port + i``, the
+  exact document ``/healthz`` serves) with the ``<pidfile>.r<i>.health.json``
+  snapshot as the fallback when the port is unreachable (gateway off, or
+  the replica just died — the snapshot then reports a stale heartbeat
+  instead of vanishing silently).
+- ``aggregate_health(docs)`` — the fleet snapshot: cumulative counters
+  SUMMED across replicas, queue depth/pending taken as the MAX (every
+  replica reports the same shared queue — summing would multiply it by N),
+  per-replica heartbeat ages, and the conservative (max) cross-replica
+  stage p99s.
+- ``fleet_metrics(docs)`` — the ``manager metrics --all-replicas`` JSON
+  document: the PR 2/3 per-engine metrics shape, fleet-wide, with a
+  per-replica breakdown.
+- ``scrape_prometheus(...)`` / ``merge_prometheus(texts)`` — fleet-wide
+  Prometheus exposition: per-series SUM across replicas (counters and
+  histogram ``_bucket``/``_sum``/``_count`` series add correctly), with
+  the shared-queue gauges (``serving_queue_depth``,
+  ``serving_dead_letters``) merged as MAX for the same reason as above.
+
+Pure stdlib: importable from the manager CLI and the autoscaler without
+dragging in jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# gauges that report a SHARED resource (the one queue every replica reads):
+# summing them across replicas would multiply the truth by the fleet size
+SHARED_MAX_METRICS = frozenset({"serving_queue_depth",
+                                "serving_dead_letters"})
+
+
+def read_scale(pidfile: str, default: int = 0) -> int:
+    """The supervisor's desired replica count from ``<pidfile>.replicas``
+    (what ``manager scale N`` writes) — the one reader every consumer
+    (fleet scrape, LB membership, ManagerFleet, the metrics CLI) shares."""
+    try:
+        with open(pidfile + ".replicas") as f:
+            return max(0, int(f.read().strip()))
+    except (OSError, ValueError):
+        return default
+
+
+def _http_json(url: str, timeout: float = 2.0) -> Optional[Dict]:
+    """GET a JSON document; non-2xx responses that still carry a JSON body
+    (``/healthz`` answers 503 with the full health doc while draining or
+    failed) are parsed too."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except (ValueError, OSError):
+            return None
+    except Exception:  # noqa: BLE001 — unreachable / refused / timeout
+        return None
+
+
+def replica_docs(pidfile: str, http_host: str = "127.0.0.1",
+                 http_port: Optional[int] = None,
+                 count: Optional[int] = None) -> Dict[int, Dict]:
+    """Health documents per replica slot.  ``count`` bounds the slots
+    probed (defaults to the supervisor's ``<pidfile>.replicas`` target);
+    slots with neither a reachable probe port nor a health snapshot are
+    simply absent from the result.  Snapshot-sourced docs get their
+    ``heartbeat_age_s`` aged by the snapshot's own staleness, so a replica
+    that died between snapshots reads as stale, not frozen-fresh."""
+    if count is None:
+        count = read_scale(pidfile)
+    docs: Dict[int, Dict] = {}
+    for i in range(max(0, int(count))):
+        doc = None
+        if http_port:
+            doc = _http_json(f"http://{http_host}:{http_port + i}/healthz")
+        if doc is None:
+            try:
+                with open(f"{pidfile}.r{i}.health.json") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = None
+            if isinstance(doc, dict):
+                staleness = max(0.0, time.time() - float(doc.get("ts", 0)))
+                doc["heartbeat_age_s"] = max(
+                    float(doc.get("heartbeat_age_s", 0.0)), staleness)
+                doc["snapshot_stale_s"] = round(staleness, 3)
+        if isinstance(doc, dict):
+            docs[i] = doc
+    return docs
+
+
+def _stage_p99(doc: Dict, stage: str) -> Optional[float]:
+    try:
+        v = doc["stages"][stage]["p99_ms"]
+        return None if v is None else float(v)
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _opt_max(values: Iterable[Optional[float]]) -> Optional[float]:
+    vals = [v for v in values if v is not None]
+    return max(vals) if vals else None
+
+
+def aggregate_health(docs: Dict[int, Dict]) -> Dict:
+    """The fleet snapshot the autoscaler consumes (see module docstring
+    for the sum-vs-max rules)."""
+    served = shed = quarantined = reclaimed = duplicates = restarts = 0
+    depth = pending = dead_letters = 0
+    hb: Dict[str, float] = {}
+    knobs: Optional[Dict] = None
+    alive = 0
+    for i, doc in sorted(docs.items()):
+        served += int(doc.get("total_records", 0))
+        shed += int(doc.get("shed", 0))
+        quarantined += int(doc.get("dead_lettered", 0))
+        reclaimed += int(doc.get("reclaimed", 0))
+        duplicates += int(doc.get("duplicates", 0))
+        restarts += sum(w.get("restart_count", 0)
+                        for w in (doc.get("workers") or {}).values())
+        if doc.get("running"):
+            alive += 1
+        q = doc.get("queue") or {}
+        depth = max(depth, int(q.get("depth", 0) or 0))
+        pending = max(pending, int(q.get("pending", 0) or 0))
+        dead_letters = max(dead_letters, int(q.get("dead_letters", 0) or 0))
+        rid = doc.get("replica_id") or f"replica-{i}"
+        try:
+            hb[rid] = float(doc.get("heartbeat_age_s", float("inf")))
+        except (TypeError, ValueError):
+            hb[rid] = float("inf")
+        if knobs is None and isinstance(doc.get("knobs"), dict):
+            knobs = doc["knobs"]
+    return {"replicas_total": len(docs),
+            "replicas_alive": alive,
+            "served": served, "shed": shed, "quarantined": quarantined,
+            "reclaimed": reclaimed, "duplicates": duplicates,
+            "restarts": restarts,
+            "queue_depth": depth, "pending": pending,
+            "dead_letters": dead_letters,
+            "heartbeat_ages": hb,
+            "e2e_p99_ms": _opt_max(_stage_p99(d, "e2e")
+                                   for d in docs.values()),
+            "preprocess_p99_ms": _opt_max(_stage_p99(d, "preprocess")
+                                          for d in docs.values()),
+            "predict_p99_ms": _opt_max(_stage_p99(d, "predict")
+                                       for d in docs.values()),
+            "knobs": knobs}
+
+
+def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
+    """``manager metrics --all-replicas`` JSON: the familiar per-engine
+    metrics document shape, fleet-wide, plus a per-replica breakdown so an
+    imbalanced fleet is visible at a glance."""
+    agg = aggregate_health(docs)
+    per_replica = {}
+    for i, doc in sorted(docs.items()):
+        e2e = (doc.get("stages") or {}).get("e2e") or {}
+        per_replica[doc.get("replica_id") or f"replica-{i}"] = {
+            "served": doc.get("total_records", 0),
+            "shed": doc.get("shed", 0),
+            "quarantined": doc.get("dead_lettered", 0),
+            "reclaimed": doc.get("reclaimed", 0),
+            "running": bool(doc.get("running")),
+            "heartbeat_age_s": doc.get("heartbeat_age_s"),
+            "p99_ms": e2e.get("p99_ms")}
+    return {"replicas": {"total": agg["replicas_total"],
+                         "alive": agg["replicas_alive"]},
+            "served": agg["served"],
+            "quarantined": agg["quarantined"],
+            "shed": agg["shed"],
+            "reclaimed": agg["reclaimed"],
+            "duplicates": agg["duplicates"],
+            "restarts": agg["restarts"],
+            "queue_depth": agg["queue_depth"],
+            "pending": agg["pending"],
+            "dead_letters": agg["dead_letters"],
+            "latency_ms": {"p50": _opt_max(
+                (d.get("stages", {}).get("e2e") or {}).get("p50_ms")
+                for d in docs.values()),
+                "p99": agg["e2e_p99_ms"]},
+            "per_replica": per_replica}
+
+
+# -- Prometheus exposition merge ------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$")
+
+
+def scrape_prometheus(count: int, http_host: str = "127.0.0.1",
+                      http_port: Optional[int] = None,
+                      timeout: float = 2.0) -> List[str]:
+    """One Prometheus text exposition per reachable replica probe port."""
+    texts: List[str] = []
+    if not http_port:
+        return texts
+    for i in range(max(0, int(count))):
+        url = f"http://{http_host}:{http_port + i}/metrics?format=prom"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                texts.append(resp.read().decode())
+        except Exception:  # noqa: BLE001 — dead slot: skip
+            continue
+    return texts
+
+
+def merge_prometheus(texts: Iterable[str],
+                     max_names: frozenset = SHARED_MAX_METRICS) -> str:
+    """Merge N replicas' text expositions into one fleet exposition:
+    identical series (same name + label set) SUM — counters add, histogram
+    ``_bucket``/``_sum``/``_count`` series add into a valid fleet
+    histogram — except the shared-resource gauges in ``max_names``, which
+    take the MAX (every replica reports the same queue).  Series unique to
+    one replica (e.g. per-replica heartbeat gauges) pass through.  HELP /
+    TYPE lines keep their first-seen text; series keep first-seen order."""
+    help_type: Dict[str, List[str]] = {}
+    family_order: List[str] = []
+    series: Dict[Tuple[str, str], float] = {}
+    series_order: Dict[str, List[Tuple[str, str]]] = {}
+    series_family: Dict[Tuple[str, str], str] = {}
+
+    def family_of(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix) and \
+                    sample_name[: -len(suffix)] in help_type:
+                return sample_name[: -len(suffix)]
+        return sample_name
+
+    for text in texts:
+        for line in (text or "").splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    name = parts[2]
+                    if name not in help_type:
+                        help_type[name] = []
+                        family_order.append(name)
+                        series_order[name] = []
+                    if len(help_type[name]) < 2:
+                        # first replica's HELP+TYPE pair wins
+                        prefix = f"# {parts[1]} {name}"
+                        if not any(h.startswith(prefix)
+                                   for h in help_type[name]):
+                            help_type[name].append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                continue
+            name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+            try:
+                value = float(raw)
+            except ValueError:
+                continue
+            fam = family_of(name)
+            if fam not in series_order:
+                family_order.append(fam)
+                series_order[fam] = []
+                help_type.setdefault(fam, [])
+            key = (name, labels)
+            if key not in series:
+                series[key] = value
+                series_order[fam].append(key)
+                series_family[key] = fam
+            elif value == value:           # skip NaN contributions
+                if series[key] != series[key]:
+                    series[key] = value
+                elif fam in max_names:
+                    series[key] = max(series[key], value)
+                else:
+                    series[key] += value
+    out: List[str] = []
+    for fam in family_order:
+        out.extend(help_type.get(fam, []))
+        for name, labels in series_order.get(fam, []):
+            v = series[(name, labels)]
+            if v != v:
+                sval = "NaN"
+            elif v in (float("inf"), float("-inf")):
+                sval = "+Inf" if v > 0 else "-Inf"
+            elif float(v) == int(v):
+                sval = str(int(v))
+            else:
+                sval = repr(float(v))
+            out.append(f"{name}{labels} {sval}")
+    return "\n".join(out) + "\n"
+
+
+def autoscaler_snapshot(pidfile: str) -> Optional[Dict]:
+    """The controller snapshot the supervisor persists each tick
+    (``<pidfile>.autoscaler.json``) — decision counters, target gauges and
+    the decision log — so ``manager metrics`` can surface controller
+    activity without reaching into the supervisor process."""
+    try:
+        with open(pidfile + ".autoscaler.json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

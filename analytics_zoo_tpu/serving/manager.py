@@ -52,6 +52,21 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # lease before another replica may
                                         # reclaim (> worst-case record time)
       reclaim_interval_s: null          # reclaim sweep period (null=lease/2)
+      max_deliveries: 5                 # poison-pill parking (PR 10): a
+                                        # record delivered more than this
+                                        # many times is parked to the
+                                        # dead-letter queue
+                                        # (max-deliveries-exceeded) instead
+                                        # of looping through reclaim; <= 0
+                                        # disables
+    autoscaler:                         # closed-loop autoscaling (PR 10),
+      slo_p99_ms: 500                   # used with `start --replicas N
+      min_replicas: 1                   # --autoscale`; every
+      max_replicas: 8                   # AutoscalerParams field is accepted
+      dwell_up_s: 2                     # (serving/autoscaler.py)
+      dwell_down_s: 10
+      scale_down_cooldown_s: 30
+      max_step: 2
       sharding: off                     # multi-chip serving (PR 6): off |
                                         # auto (batch-shard small models,
                                         # tensor-shard large) | batch | tensor
@@ -66,6 +81,18 @@ CLI (used by scripts/cluster-serving/*.sh):
         # respawned, its orphaned in-flight records reclaimed by survivors.
         # Replica i gets pidfile <pidfile>.r<i> (+ its own health snapshot)
         # and params.http_port + i when a probe port is configured.
+        [--autoscale]                  # PR 10: run the closed-loop
+        # autoscaler in the supervisor — fleet signals from the per-replica
+        # health docs, topology through the scale file (same path as
+        # `manager scale N`), fast knob nudges through <pidfile>.knobs.json
+        # (each replica polls + ClusterServing.retune()s), controller
+        # metrics snapshotted to <pidfile>.autoscaler.json.  Tuned by the
+        # config's `autoscaler:` section.
+        [--lb-port P]                  # PR 10: single-port load-balancing
+        # front door (serving/lb.py) in the supervisor: proxies
+        # /v1/enqueue + /v1/result across the live replica gateways with
+        # least-inflight pick + /readyz health-out, tracking membership as
+        # the fleet resizes — clients never see a scale event.
     python -m analytics_zoo_tpu.serving.manager scale N
         # resize a running --replicas supervisor to N replicas (scale-up
         # spawns, scale-down SIGTERMs the highest-numbered replicas, which
@@ -80,6 +107,15 @@ CLI (used by scripts/cluster-serving/*.sh):
         # params.http_port is configured (--prom asks for the Prometheus
         # text exposition), else derive the same JSON document from the
         # health.json snapshot
+    python -m analytics_zoo_tpu.serving.manager metrics --all-replicas
+        [--prom]
+        # PR 10: ONE fleet-wide snapshot summed across the per-replica
+        # registries (HTTP scrape per replica, health.json fallback) — the
+        # same aggregation the autoscaler consumes (serving/fleet.py).
+        # --prom merges the per-replica text expositions (counters and
+        # histogram series sum; shared-queue gauges take the max) and
+        # appends the controller's own exposition when the autoscaler is
+        # running.
 """
 
 from __future__ import annotations
@@ -231,6 +267,17 @@ def _scale_path(pidfile: str) -> str:
     return pidfile + ".replicas"
 
 
+def _knobs_path(pidfile: str) -> str:
+    """Fast-tier knob targets (PR 10): written by the supervisor's
+    autoscaler, polled by every replica (same file-not-signal rationale as
+    the scale file)."""
+    return pidfile + ".knobs.json"
+
+
+def _autoscaler_path(pidfile: str) -> str:
+    return pidfile + ".autoscaler.json"
+
+
 def _write_health(serving, path: str) -> None:
     """Atomic health snapshot (ClusterServing.health()) next to the pidfile —
     the `status`/`health` CLI actions read it from outside the daemon."""
@@ -246,12 +293,16 @@ def _write_health(serving, path: str) -> None:
 
 def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
-                    http_port_offset: int = 0):
+                    http_port_offset: int = 0,
+                    knobs_path: Optional[str] = None):
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     serving = serve_from_config(config_path, replica_id=replica_id,
                                 http_port_offset=http_port_offset)
     health_path = _health_path(pidfile)
+    if knobs_path is None:
+        knobs_path = _knobs_path(pidfile)
+    knobs_seen = 0
 
     def _terminate(signum, frame):
         # ClusterServingManager.listenTermination analog: graceful drain
@@ -265,22 +316,69 @@ def _run_foreground(config_path: str, pidfile: str,
                 pass
         sys.exit(0)
 
+    def _retire(signum, frame):
+        # scale-down decommission (PR 10): flush in-flight work and exit
+        # WITHOUT closing the shared queue's admission — one retiring
+        # replica must not cut off ingest for the survivors.  (This was a
+        # live bug in the PR 5 scale path: `manager scale N-1` SIGTERMed a
+        # replica, whose drain closed admission on the shared backend and
+        # left the whole fleet rejecting enqueues.)
+        serving.shutdown(drain_s=serving.params.drain_s,
+                         close_admission=False)
+        for p in (pidfile, health_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        sys.exit(0)
+
     signal.signal(signal.SIGTERM, _terminate)
     signal.signal(signal.SIGINT, _terminate)
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _retire)
     serving.start()
     while True:
         _write_health(serving, health_path)
+        # live knob nudges (PR 10 autoscaler fast tier): the supervisor's
+        # autoscaler writes <base pidfile>.knobs.json; every replica polls
+        # it once a second and applies via retune() — validated, and taken
+        # up at the engine's next batch boundary
+        try:
+            st = os.stat(knobs_path)
+            if st.st_mtime_ns != knobs_seen:
+                knobs_seen = st.st_mtime_ns
+                with open(knobs_path) as f:
+                    knobs = json.load(f)
+                if isinstance(knobs, dict):
+                    serving.retune(**{
+                        k: knobs[k] for k in
+                        ("max_batch", "max_wait_ms",
+                         "preprocess_workers", "inflight_batches")
+                        if k in knobs})
+        except (OSError, ValueError, TypeError):
+            pass                           # no/garbled knobs file: keep as-is
         time.sleep(1)
 
 
-def _run_supervisor(config_path: str, pidfile: str, replicas: int):
+def _run_supervisor(config_path: str, pidfile: str, replicas: int,
+                    autoscale: bool = False,
+                    lb_port: Optional[int] = None):
     """Replica supervisor (PR 5 tentpole): fork one serving process per
     replica over the SHARED queue, monitor them, respawn crashed ones (a
     SIGKILLed replica's orphaned records are reclaimed by the survivors
     while the respawn happens), and track the desired count in
     `<pidfile>.replicas` so `manager scale N` can resize a live deployment.
     SIGTERM forwards to every replica (each drains per params.drain_s) and
-    then exits."""
+    then exits.
+
+    PR 10: with ``autoscale`` the closed-loop controller runs here too —
+    fleet signals from the per-replica health docs, topology through the
+    SAME scale file `manager scale N` writes (the supervisor poll loop is
+    the actuator either way), knob nudges through `<pidfile>.knobs.json`,
+    controller metrics snapshotted to `<pidfile>.autoscaler.json` each
+    pass.  With ``lb_port`` the single-port load-balancing front door
+    (serving/lb.py) serves next to the supervisor, tracking membership as
+    the fleet resizes."""
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     scale_path = _scale_path(pidfile)
@@ -289,6 +387,27 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
     children: dict = {}                    # index -> pid
     last_spawn: dict = {}                  # index -> monotonic ts (backoff)
     stopping: set = set()                  # indices already SIGTERMed
+
+    cfg = load_config(config_path)
+    params = serving_params(cfg)
+    scaler = None
+    balancer = None
+    if autoscale:
+        from analytics_zoo_tpu.serving.autoscaler import (Autoscaler,
+                                                          AutoscalerParams,
+                                                          ManagerFleet)
+        as_params = AutoscalerParams.from_dict(cfg.get("autoscaler") or {})
+        fleet = ManagerFleet(pidfile, http_host=params.http_host,
+                             http_port=params.http_port,
+                             max_replicas=as_params.max_replicas)
+        scaler = Autoscaler(fleet, params=as_params).start()
+    if lb_port is not None:
+        from analytics_zoo_tpu.serving.lb import (LoadBalancer,
+                                                  manager_members)
+        balancer = LoadBalancer(
+            manager_members(pidfile, http_host=params.http_host,
+                            http_port=params.http_port),
+            host=params.http_host, port=lb_port).start()
 
     def _spawn(index: int):
         last_spawn[index] = time.monotonic()
@@ -302,7 +421,8 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
             try:
                 _run_foreground(config_path, _replica_pidfile(pidfile, index),
                                 replica_id=f"replica-{index}",
-                                http_port_offset=index)
+                                http_port_offset=index,
+                                knobs_path=_knobs_path(pidfile))
             finally:
                 os._exit(0)
         children[index] = pid
@@ -322,6 +442,10 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
                 except ChildProcessError:
                     break
                 time.sleep(0.1)
+        if scaler is not None:
+            scaler.stop()
+        if balancer is not None:
+            balancer.stop()
         for index in list(children):
             for p in (_replica_pidfile(pidfile, index),
                       _health_path(_replica_pidfile(pidfile, index))):
@@ -329,7 +453,8 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
                     os.unlink(p)
                 except OSError:
                     pass
-        for p in (pidfile, scale_path):
+        for p in (pidfile, scale_path, _knobs_path(pidfile),
+                  _autoscaler_path(pidfile)):
             try:
                 os.unlink(p)
             except OSError:
@@ -357,13 +482,16 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
                     print(json.dumps({"replica": index, "pid": pid,
                                       "event": "exited; respawning"}),
                           file=sys.stderr, flush=True)
-        # scale down: highest-numbered replicas drain and exit (SIGTERM
-        # once — a repeat would re-enter the replica's drain handler)
+        # scale down: highest-numbered replicas RETIRE (SIGUSR1: drain
+        # their in-flight work, shared admission stays open for the
+        # survivors) and exit; signalled once — a repeat would re-enter
+        # the replica's drain handler
+        retire_sig = getattr(signal, "SIGUSR1", signal.SIGTERM)
         for index in sorted(children, reverse=True):
             if index >= desired and index not in stopping:
                 stopping.add(index)
                 try:
-                    os.kill(children[index], signal.SIGTERM)
+                    os.kill(children[index], retire_sig)
                 except OSError:
                     pass
         # spawn missing replicas, rate-limited to one respawn per second
@@ -373,6 +501,17 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int):
             if index not in children and \
                     now - last_spawn.get(index, -1e9) >= 1.0:
                 _spawn(index)
+        if scaler is not None:
+            # controller observability through `manager metrics`: persist
+            # the decision counters / target gauges / decision log next to
+            # the pidfile (atomic, same pattern as the health snapshots)
+            try:
+                snap_path = _autoscaler_path(pidfile)
+                with open(snap_path + ".tmp", "w") as f:
+                    json.dump(scaler.snapshot(), f)
+                os.replace(snap_path + ".tmp", snap_path)
+            except OSError:
+                pass
         time.sleep(0.5)
 
 
@@ -391,6 +530,21 @@ def main(argv=None):
                     help="start: run N supervised serving replicas over the "
                          "shared queue (crashed replicas respawn; their "
                          "in-flight records are reclaimed by survivors)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="start --replicas: run the closed-loop autoscaler "
+                         "in the supervisor (config `autoscaler:` section "
+                         "tunes it); topology via the scale file, knob "
+                         "nudges via <pidfile>.knobs.json")
+    ap.add_argument("--lb-port", type=int, default=None, metavar="P",
+                    help="start --replicas: serve the single-port "
+                         "load-balancing front door on P (proxies "
+                         "/v1/enqueue + /v1/result across the live replica "
+                         "gateways)")
+    ap.add_argument("--all-replicas", action="store_true",
+                    help="metrics: one fleet-wide snapshot summed across "
+                         "the per-replica registries (HTTP scrape with "
+                         "health.json fallback); with --prom, the merged "
+                         "text exposition")
     ap.add_argument("--filter", default=None, metavar="SUBSTR",
                     help="replay only dead letters whose uri or error "
                          "contains SUBSTR")
@@ -429,6 +583,47 @@ def main(argv=None):
             params = serving_params(load_config(args.config))
         except OSError:
             params = ServingParams()       # no config: snapshot-only path
+        if args.all_replicas:
+            # fleet-wide aggregation (PR 10): sum the per-replica
+            # registries — the same serving/fleet.py path the autoscaler's
+            # ManagerFleet collector consumes
+            from analytics_zoo_tpu.serving import fleet as _fleet
+            count = _fleet.read_scale(args.pidfile)
+            if args.prom:
+                texts = _fleet.scrape_prometheus(
+                    count, http_host=params.http_host,
+                    http_port=params.http_port)
+                if not texts:
+                    print(json.dumps(
+                        {"error": "--all-replicas --prom needs reachable "
+                                  "replica probe ports (params.http_port "
+                                  "+ a running --replicas deployment)"}),
+                        file=sys.stderr)
+                    return 1
+                out = _fleet.merge_prometheus(texts)
+                asnap = _fleet.autoscaler_snapshot(args.pidfile)
+                if asnap and asnap.get("prom"):
+                    out += asnap["prom"]   # controller series ride along
+                print(out, end="")
+                return 0
+            docs = _fleet.replica_docs(args.pidfile,
+                                       http_host=params.http_host,
+                                       http_port=params.http_port,
+                                       count=count)
+            if not docs:
+                print(json.dumps(
+                    {"error": "no replica health docs (not running as a "
+                              "--replicas deployment, or none written "
+                              "yet)"}), file=sys.stderr)
+                return 1
+            doc = _fleet.fleet_metrics(docs)
+            asnap = _fleet.autoscaler_snapshot(args.pidfile)
+            if asnap:
+                doc["autoscaler"] = {
+                    "decisions": asnap.get("decisions", [])[-20:],
+                    "metrics": asnap.get("metrics", {})}
+            print(json.dumps(doc))
+            return 0
         if params.http_port:
             import urllib.request
             url = (f"http://{params.http_host}:{params.http_port}/metrics"
@@ -586,12 +781,14 @@ def main(argv=None):
                   file=sys.stderr)
             return 1
         if args.foreground:
-            _run_supervisor(args.config, args.pidfile, args.replicas)
+            _run_supervisor(args.config, args.pidfile, args.replicas,
+                            autoscale=args.autoscale, lb_port=args.lb_port)
             return 0
         pid = os.fork()
         if pid == 0:                       # child: detach and supervise
             os.setsid()
-            _run_supervisor(args.config, args.pidfile, args.replicas)
+            _run_supervisor(args.config, args.pidfile, args.replicas,
+                            autoscale=args.autoscale, lb_port=args.lb_port)
             return 0
         print(json.dumps({"started": True, "pid": pid,
                           "replicas": args.replicas}))

@@ -1162,11 +1162,27 @@ class RedisQueue(BaseQueue):
             parsed: List[Tuple[str, Dict]] = []
             rid = self._parse_delivery(eid, fields, parsed)
             if rid is not None:
-                # XAUTOCLAIM does not return the delivery counter; 2 is the
-                # honest floor ("redelivered at least once"), which is all
-                # the engine's duplicate suppression needs
-                out3.append((rid, parsed[0][1], 2))
+                # XAUTOCLAIM does not return the delivery counter, but the
+                # PEL does: one XPENDING range probe per reclaimed entry
+                # (reclaims are rare) recovers the TRUE count so the
+                # engine's max_deliveries poison parking (PR 10) can trip.
+                # 2 stays the honest floor when the probe fails.
+                out3.append((rid, parsed[0][1],
+                             max(2, self._delivery_count(eid))))
         return out3
+
+    def _delivery_count(self, eid) -> int:
+        """times_delivered for one PEL entry (already bumped by the
+        XAUTOCLAIM that just reclaimed it); 0 when unavailable — callers
+        floor it themselves."""
+        try:
+            rows = self.r.xpending_range(self.stream, self.group,
+                                         min=eid, max=eid, count=1)
+            if rows:
+                return int(rows[0].get("times_delivered", 0))
+        except Exception:  # noqa: BLE001 — old server/library: floor wins
+            pass
+        return 0
 
     def put_result(self, key, value):
         self.r.hset(self.table, key, json.dumps(value))

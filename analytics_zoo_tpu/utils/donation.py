@@ -1,0 +1,145 @@
+"""Per-leaf buffer donation for jitted train steps.
+
+``jax.jit(step, donate_argnums=...)`` donates WHOLE arguments, but XLA
+decides aliasing per BUFFER: when a donated leaf cannot be aliased to any
+output (the classic case is an embedding table whose gather operand wants
+a different layout than the scatter-add that updates it — exactly the
+bert_large ``bf16[30522,1024]`` / ``bf16[2,1024]`` pair in the BENCH_r05
+tail), jax emits
+
+    Some donated buffers were not usable: ...
+
+on every compile, and the unusable donations buy nothing.  Which leaves
+are unusable is a COMPILER decision (layout assignment), so it cannot be
+predicted statically — but it can be observed: ``donation_safe_jit``
+compiles with full donation once, catches that warning, and when it
+fires rebuilds the jit with the offending leaves moved to a second,
+NON-donated argument (the donated remainder is passed as one flat list
+donated wholesale).  The result:
+
+- the warning disappears — every buffer still marked donated is one XLA
+  actually uses;
+- the usable donations (the big transformer blocks) are kept — dropping
+  ``donate_argnums`` entirely would double peak memory on the params;
+- numerics are untouched (the split wrapper reassembles the original
+  pytrees and calls the same ``fn``).
+
+Leaves are matched to the warning by (dtype, shape) signature: leaves
+sharing a signature with an unusable buffer are all excluded — over-
+exclusion only forgoes donation on (typically tiny) twins, never breaks
+anything.  The probe costs one extra compile for models that warn and
+nothing for models that don't.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import warnings
+from typing import Callable, Dict, Sequence, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+_DONATION_WARNING = re.compile(r"donated buffers were not usable", re.I)
+# both spellings seen in the wild: jax's "ShapedArray(bfloat16[2,1024])"
+# and XLA's "bf16[2,1024]{1,0}"
+_AVAL = re.compile(r"([A-Za-z0-9_]+)\[([0-9,]*)\]")
+_XLA_DTYPES = {
+    "pred": "bool", "bf16": "bfloat16", "f16": "float16", "f32": "float32",
+    "f64": "float64", "s8": "int8", "s16": "int16", "s32": "int32",
+    "s64": "int64", "u8": "uint8", "u16": "uint16", "u32": "uint32",
+    "u64": "uint64"}
+
+Sig = Tuple[str, Tuple[int, ...]]
+
+
+def _parse_unusable(message: str) -> Set[Sig]:
+    sigs: Set[Sig] = set()
+    for dt, shape in _AVAL.findall(message):
+        dt = _XLA_DTYPES.get(dt, dt)
+        sigs.add((dt, tuple(int(s) for s in shape.split(",") if s)))
+    return sigs
+
+
+def _sig(leaf) -> Sig:
+    return (str(getattr(leaf, "dtype", type(leaf).__name__)),
+            tuple(getattr(leaf, "shape", ())))
+
+
+def donation_safe_jit(fn: Callable, donate_argnums: Sequence[int] = (),
+                      **jit_kwargs) -> Callable:
+    """``jax.jit(fn, donate_argnums=...)`` that self-corrects to per-leaf
+    donation when XLA reports unusable donated buffers (see module
+    docstring).  Calls keep being probed (warnings captured) until one
+    compiles clean; the common no-warning case pays one ``catch_warnings``
+    per call until then and a plain dict hit afterwards."""
+    import jax
+
+    donate_set = frozenset(int(i) for i in donate_argnums)
+    full = jax.jit(fn, donate_argnums=tuple(sorted(donate_set)),
+                   **jit_kwargs)
+    state = {"bad": set(), "clean": False}
+    split_cache: Dict[tuple, Callable] = {}
+    lock = threading.Lock()
+
+    def _split_call(args):
+        donated = tuple(a for i, a in enumerate(args) if i in donate_set)
+        rest = tuple(a for i, a in enumerate(args) if i not in donate_set)
+        leaves, treedef = jax.tree.flatten(donated)
+        mask = tuple(_sig(leaf) not in state["bad"] for leaf in leaves)
+        key = (treedef, mask, len(args))
+        with lock:
+            inner = split_cache.get(key)
+        if inner is None:
+            n_args = len(args)
+
+            def rebuilt(donate_leaves, keep_leaves, *rest_args):
+                it_d, it_k = iter(donate_leaves), iter(keep_leaves)
+                merged = [next(it_d) if m else next(it_k) for m in mask]
+                donated_args = iter(jax.tree.unflatten(treedef, merged))
+                others = iter(rest_args)
+                return fn(*(next(donated_args) if i in donate_set
+                            else next(others) for i in range(n_args)))
+
+            inner = jax.jit(rebuilt, donate_argnums=(0,), **jit_kwargs)
+            with lock:
+                split_cache[key] = inner
+        return inner([l for l, m in zip(leaves, mask) if m],
+                     [l for l, m in zip(leaves, mask) if not m],
+                     *rest)
+
+    def wrapper(*args):
+        if state["clean"]:
+            # settled: either full donation compiled silently, or the
+            # split version did — no more warning bookkeeping on the path
+            return _split_call(args) if state["bad"] else full(*args)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            out = _split_call(args) if state["bad"] else full(*args)
+        unusable: Set[Sig] = set()
+        for w in caught:
+            msg = str(w.message)
+            if _DONATION_WARNING.search(msg):
+                unusable |= _parse_unusable(msg)
+            else:
+                warnings.warn_explicit(w.message, w.category, w.filename,
+                                       w.lineno)
+        if unusable:
+            grew = not (unusable <= state["bad"])
+            state["bad"] |= unusable
+            if grew:
+                logger.info(
+                    "donation_safe_jit(%s): excluding %d unusable leaf "
+                    "signature(s) from donation: %s",
+                    getattr(fn, "__name__", fn), len(state["bad"]),
+                    sorted(state["bad"]))
+                with lock:
+                    split_cache.clear()   # masks depend on the bad set
+        else:
+            state["clean"] = True
+        return out
+
+    wrapper.__name__ = getattr(fn, "__name__", "donation_safe_jit")
+    wrapper.__wrapped__ = fn
+    return wrapper

@@ -326,7 +326,15 @@ def bench_bert(trials=3, batch=64, seq=128):
         opt = SGD(lr=0.01, momentum=0.9)
         opt_state = opt.init(params)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        from analytics_zoo_tpu.utils.donation import donation_safe_jit
+
+        # donation_safe_jit: the embedding tables (word [30522,1024] and
+        # token-type [2,1024]) are gather operands whose layout XLA cannot
+        # alias to their scatter-add updates — donating them warned on
+        # every compile ("Some donated buffers were not usable", the
+        # BENCH_r05 tail) and bought nothing; the probe re-jits with only
+        # the usable leaves donated, keeping donation on the block params
+        @functools.partial(donation_safe_jit, donate_argnums=(0, 1))
         def loop(params, opt_state, n, seed):
             r1, r2 = jax.random.split(jax.random.PRNGKey(seed))
             ids = jax.random.randint(r1, (batch, seq), 0, V)

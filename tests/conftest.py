@@ -52,6 +52,12 @@ def pytest_configure(config):
         "wire: binary wire / shm-lane / HTTP-gateway tests (shared-memory "
         "segments + curl subprocesses); carry a default 120 s SIGALRM "
         "budget so a wedged gateway or leaked segment cannot stall tier-1")
+    config.addinivalue_line(
+        "markers",
+        "autoscale: closed-loop autoscaler / load-balancer tests (engine "
+        "fleets, front-door sockets; the chaos A/B additionally carries "
+        "`slow` because it spawns live replica subprocesses); default "
+        "300 s SIGALRM budget so a wedged fleet cannot stall tier-1")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -62,6 +68,7 @@ def pytest_configure(config):
 REPLICAS_DEFAULT_TIMEOUT_S = 300.0
 MULTICHIP_DEFAULT_TIMEOUT_S = 300.0
 WIRE_DEFAULT_TIMEOUT_S = 120.0
+AUTOSCALE_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -83,6 +90,8 @@ def pytest_runtest_call(item):
             seconds = MULTICHIP_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("wire") is not None:
             seconds = WIRE_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("autoscale") is not None:
+            seconds = AUTOSCALE_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

@@ -217,6 +217,33 @@ class FakeRedis:
             return {"pending": len(g["pending"]), "min": None, "max": None,
                     "consumers": []}
 
+    def xpending_range(self, name, groupname, min="-", max="+", count=10,
+                       consumername=None):
+        # the redis-py parsed shape: RedisQueue.reclaim reads
+        # times_delivered from here so poison-pill parking (PR 10) sees
+        # TRUE delivery counts, not the XAUTOCLAIM floor of 2
+        with self._lock:
+            g = self._group(name, groupname)
+            now_ms = time.time() * 1000.0
+            lo = -1 if min in ("-", b"-") else self._seq_of(min)
+            hi = float("inf") if max in ("+", b"+") else self._seq_of(max)
+            rows = []
+            for eid, p in sorted(g["pending"].items(),
+                                 key=lambda kv: self._seq_of(kv[0])):
+                s = self._seq_of(eid)
+                if s < lo or s > hi:
+                    continue
+                if consumername is not None and \
+                        p["consumer"] != consumername:
+                    continue
+                rows.append({"message_id": eid, "consumer": p["consumer"],
+                             "time_since_delivered":
+                                 int(now_ms - p["time_ms"]),
+                             "times_delivered": p["deliveries"]})
+                if count is not None and len(rows) >= count:
+                    break
+            return rows
+
     @staticmethod
     def _bytes_safe(v):
         # real Redis stores values as bytes: normalize bytearray/memoryview

@@ -31,6 +31,16 @@ Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
      python tools/serving_bench.py --json results.json # machine-readable
          # results document (config + per-run throughput/stage breakdown)
          # so the serving perf trajectory is trackable across PRs
+     python tools/serving_bench.py --load-profile swing --autoscale on \
+         [--chaos sigkill] [--slo-ms 1500] --json on.json
+         # PR 10 elastic-serving A/B: a 10x offered-load swing
+         # (low -> 10x -> low) over a shared FileQueue fleet, optionally
+         # SIGKILLing a real replica subprocess mid-swing.  --autoscale on
+         # runs the closed-loop controller (EngineFleet actuator: knob
+         # nudges + replica scale + stale-heartbeat replacement);
+         # --autoscale off holds the initial fleet.  Emits the
+         # p50/p99/shed/replica trajectory in --json; diff the on/off
+         # documents (RUNLOG_serving.md records the acceptance A/B)
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -266,6 +277,325 @@ def _run_once(im, args, batch_size):
     return out
 
 
+# -- elastic-serving load-swing A/B (PR 10) -----------------------------------
+
+def _swing_model(max_batch):
+    """The chaos-bench workload: the SAME tiny Dense(3 -> 4) classifier the
+    subprocess replica worker (tests/replica_worker.py) serves, so a
+    SIGKILLed worker's reclaimed records decode in the in-process
+    survivors.  Device time is SIMULATED (see _attach_service_time): the
+    A/B measures the CONTROL plane — capacity vs offered load — not this
+    container's device speed, and a deterministic service-time model makes
+    the on/off comparison reproducible on CPU."""
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    model = Sequential()
+    model.add(Dense(4, input_shape=(3,), activation="softmax"))
+    model.init_weights()
+    # concurrent_num=2: the semaphore only brackets the (sub-ms) real
+    # predict, but it also CAPS the autoscaler's inflight ladder at 2 —
+    # parked batches are bounded, so a record's in-engine dwell stays
+    # under the lease and loaded engines never reclaim each other's live
+    # work (cross-replica churn)
+    return InferenceModel(supported_concurrent_num=2,
+                          max_batch=max_batch) \
+        .do_load_model(model, model._params, model._state)
+
+
+def _attach_service_time(im, base_ms, per_record_ms):
+    """Deterministic device-time model: predict costs base_ms + n *
+    per_record_ms — batching amortizes the base (so the autoscaler's
+    max_batch nudges buy real capacity) and the sleep releases the GIL (so
+    in-process replicas overlap like N processes on one host)."""
+    orig = im.do_predict
+
+    def timed_predict(tensors, scales=None):
+        import numpy as _np
+        n = int(_np.shape(tensors)[0]) if _np.ndim(tensors) else 1
+        time.sleep((base_ms + per_record_ms * n) / 1000.0)
+        return orig(tensors, scales=scales)
+
+    im.do_predict = timed_predict
+    return im
+
+
+def _run_swing(args):
+    """10x load swing (low -> high -> low) over a shared FileQueue fleet,
+    optionally SIGKILLing a real replica subprocess mid-swing; autoscale
+    on runs the closed-loop controller, off holds the initial fleet.
+    Returns the A/B document (trajectory + client-observed latency)."""
+    import signal as _signal
+    import subprocess
+
+    from analytics_zoo_tpu.serving.autoscaler import (Autoscaler,
+                                                      AutoscalerParams,
+                                                      EngineFleet)
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    qdir = tempfile.mkdtemp(prefix="serving_swing_")
+    queue = FileQueue(qdir)
+    im = _swing_model(args.swing_max_batch)
+    # pre-compile every pow-2 bucket BEFORE attaching the service-time
+    # model: cold XLA compiles (100-300 ms each on CPU) during the low
+    # phase would read as SLO violations and make the controller scale on
+    # compile noise instead of load
+    b = 1
+    while b <= args.swing_max_batch:
+        im.do_predict(np.zeros((b, 3), np.float32))
+        b *= 2
+    im = _attach_service_time(im, args.service_ms,
+                              args.service_per_record_ms)
+
+    def factory(rid):
+        # max_wait_ms=100: N replicas racing over one spool would otherwise
+        # shred the backlog into 1-record batches (each eager read claims
+        # whatever trickled in since the last poll), and per-batch overhead
+        # then caps fleet capacity regardless of replica count.  A real
+        # coalescing budget lets device-sized batches form under load while
+        # costing only ~100 ms of floor latency when idle.
+        return ClusterServing(im, queue, params=ServingParams(
+            batch_size=args.swing_batch, max_batch=args.swing_batch,
+            poll_timeout_s=0.02, max_wait_ms=100.0, worker_backoff_s=0.01,
+            pipeline_depth=1,
+            replica_id=rid, lease_s=args.swing_lease_s,
+            reclaim_interval_s=args.swing_lease_s / 2,
+            trim_interval_s=3600.0)).start()
+
+    chaos_proc = None
+    n_engines = max(1, args.initial_replicas)
+    if args.chaos == "sigkill":
+        # one REAL replica process in the initial fleet — the SIGKILL
+        # victim.  Shares the spool; its health file doubles as heartbeat.
+        n_engines -= 1
+        worker = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tests", "replica_worker.py")
+        chaos_proc = subprocess.Popen(
+            [sys.executable, worker, qdir, "victim-0",
+             "--lease", str(args.swing_lease_s),
+             "--reclaim-interval", str(args.swing_lease_s / 2),
+             "--batch", str(args.swing_batch),
+             "--slow", str(args.service_ms / 1000.0)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    fleet = EngineFleet(factory, queue, initial=n_engines,
+                        name_prefix="swing", drain_s=5.0)
+    victim_health = os.path.join(qdir, "victim-0.health.json")
+    if chaos_proc is not None:
+        deadline = time.time() + 180
+        while not os.path.exists(victim_health):
+            if time.time() > deadline or chaos_proc.poll() is not None:
+                raise RuntimeError("chaos replica worker never came up")
+            time.sleep(0.2)
+
+        def victim_heartbeat():
+            try:
+                return max(0.0, time.time()
+                           - os.path.getmtime(victim_health))
+            except OSError:
+                return None
+
+        def victim_stats():
+            try:
+                with open(victim_health) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+
+        fleet.add_external("victim-0", victim_heartbeat, victim_stats)
+
+    scaler = None
+    if args.autoscale == "on":
+        # min_replicas = the initial fleet: the A/B measures elasticity
+        # ABOVE the provisioned floor (and a dip below it right before the
+        # swing would conflate scale-down latency with scale-up latency)
+        scaler = Autoscaler(fleet, params=AutoscalerParams(
+            slo_p99_ms=args.slo_ms,
+            min_replicas=max(1, args.initial_replicas),
+            max_replicas=args.max_replicas,
+            interval_s=0.25, dwell_up_s=0.5, dwell_down_s=4.0,
+            scale_down_cooldown_s=6.0, max_step=3, knob_dwell_s=0.5,
+            heartbeat_stale_s=1.5, replace_cooldown_s=3.0)).start()
+
+    cin = InputQueue(queue)
+    g = np.random.default_rng(0)
+    # warm-up stream (uncounted): lets the subprocess victim pay ITS cold
+    # compiles before the measured profile starts
+    warm = [cin.enqueue_tensor(f"warm-{i}", g.random(3, np.float32))
+            for i in range(4 * args.swing_batch)]
+    warm_deadline = time.time() + 60
+    while time.time() < warm_deadline:
+        if all(r is not None
+               for r in queue.get_results(warm).values()):
+            break
+        time.sleep(0.1)
+    enq_ts = {}
+    arrived = {}
+    errors = {}
+    state = {"enqueued": 0, "stop": False}
+    lock = threading.Lock()
+
+    phases = [(args.base_rps, args.phase_s),
+              (args.base_rps * args.swing_factor, args.phase_s),
+              (args.base_rps, args.phase_s)]
+    kill_at = args.phase_s * 1.5           # mid-swing
+    trajectory = []
+
+    def driver():
+        i = 0
+        t0 = time.monotonic()
+        killed = False
+        for rps, dur in phases:
+            period = 1.0 / max(rps, 0.001)
+            phase_end = time.monotonic() + dur
+            next_t = time.monotonic()
+            while time.monotonic() < phase_end:
+                if chaos_proc is not None and not killed \
+                        and time.monotonic() - t0 >= kill_at:
+                    os.kill(chaos_proc.pid, _signal.SIGKILL)
+                    killed = True
+                uri = f"sw-{i}"
+                x = g.random(3, np.float32)
+                try:
+                    cin.enqueue_tensor(uri, x, timeout_s=args.deadline_s)
+                    with lock:
+                        enq_ts[uri] = time.monotonic()
+                        state["enqueued"] += 1
+                except Exception:  # noqa: BLE001 — admission shed at edge
+                    with lock:
+                        errors[uri] = "enqueue-rejected"
+                i += 1
+                next_t += period
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+
+    def poller():
+        from analytics_zoo_tpu.serving.client import OutputQueue
+        while True:
+            with lock:
+                outstanding = [u for u in enq_ts
+                               if u not in arrived and u not in errors]
+                done = state["stop"]
+            if done:
+                # the drain budget already gave up on whatever is left
+                return
+            for chunk_at in range(0, len(outstanding), 512):
+                chunk = outstanding[chunk_at:chunk_at + 512]
+                try:
+                    res = queue.get_results(chunk)
+                except Exception:  # noqa: BLE001 — transient FS race
+                    continue
+                now = time.monotonic()
+                with lock:
+                    for u, r in res.items():
+                        if r is None:
+                            continue
+                        if OutputQueue.is_error(r):
+                            errors[u] = str(r.get("error"))
+                        else:
+                            arrived[u] = now - enq_ts[u]
+            time.sleep(0.05)
+
+    # daemon: a record that somehow never resolves must not leave the
+    # poller blocking interpreter exit after the drain budget gives up
+    drv = threading.Thread(target=driver, name="swing-driver", daemon=True)
+    pol = threading.Thread(target=poller, name="swing-poller", daemon=True)
+    t_start = time.monotonic()
+    drv.start()
+    pol.start()
+
+    # sampler: the replica/latency trajectory the acceptance A/B plots
+    offered = [(t, r) for (r, d), t in zip(
+        phases, np.cumsum([0] + [d for _, d in phases[:-1]]))]
+    while drv.is_alive():
+        sig = fleet.signals()
+        alive = sum(1 for age in sig.heartbeat_ages.values() if age < 2.0)
+        t = time.monotonic() - t_start
+        rps = next((r for tt, r in reversed(offered) if t >= tt), 0)
+        with lock:
+            n_arr = len(arrived)
+            n_err = len(errors)
+            p99 = None
+            if n_arr:
+                lat = sorted(arrived.values())
+                p99 = round(lat[min(n_arr - 1,
+                                    int(0.99 * n_arr))] * 1e3, 1)
+        trajectory.append({
+            "t_s": round(t, 2), "offered_rps": rps,
+            "queue_depth": sig.queue_depth, "pending": sig.pending,
+            "replicas_alive": alive, "desired": sig.desired,
+            "max_batch": sig.max_batch, "shed": int(sig.shed_total),
+            "served": n_arr, "errors": n_err, "p99_ms_sofar": p99})
+        time.sleep(0.5)
+    drv.join()
+    # drain: every enqueued record must resolve (result or error) within
+    # the budget; the deadline_s stamp guarantees forward progress
+    drain_deadline = time.monotonic() + args.drain_timeout_s
+    while time.monotonic() < drain_deadline:
+        with lock:
+            if len(arrived) + len(errors) >= state["enqueued"]:
+                break
+        time.sleep(0.2)
+    state["stop"] = True
+    pol.join(timeout=10)
+    if scaler is not None:
+        scaler.stop()
+    decisions = scaler.decisions() if scaler is not None else []
+    final_sig = fleet.signals()
+    fleet.shutdown()
+    if chaos_proc is not None:
+        try:
+            os.kill(chaos_proc.pid, _signal.SIGKILL)
+        except OSError:
+            pass
+        chaos_proc.wait(timeout=10)
+
+    lat_sorted = sorted(arrived.values())
+    shed = sum(1 for e in errors.values() if "deadline-exceeded" in e
+               or "enqueue-rejected" in e)
+
+    def pct(q):
+        if not lat_sorted:
+            return None
+        return round(lat_sorted[min(len(lat_sorted) - 1,
+                                    int(q / 100 * len(lat_sorted)))]
+                     * 1e3, 1)
+
+    doc = {
+        "profile": "swing",
+        "autoscale": args.autoscale,
+        "chaos": args.chaos,
+        "slo_ms": args.slo_ms,
+        "base_rps": args.base_rps,
+        "swing_factor": args.swing_factor,
+        "phase_s": args.phase_s,
+        "deadline_s": args.deadline_s,
+        "enqueued": state["enqueued"],
+        "served": len(lat_sorted),
+        "shed": shed,
+        "other_errors": len(errors) - shed,
+        "client_p50_ms": pct(50),
+        "client_p99_ms": pct(99),
+        "slo_violated": (pct(99) is None or pct(99) > args.slo_ms
+                         or shed > 0.02 * max(state["enqueued"], 1)),
+        "initial_replicas": max(1, args.initial_replicas),
+        "final_desired": final_sig.desired,
+        "final_alive": sum(1 for a in final_sig.heartbeat_ages.values()
+                           if a < 2.0),
+        "max_replicas_seen": max((s["desired"] for s in trajectory),
+                                 default=max(1, args.initial_replicas)),
+        "decisions": decisions,
+        "decision_counts": {
+            k: sum(1 for d in decisions if d["action"] == k)
+            for k in ("scale_up", "scale_down", "replace_replica",
+                      "retune_up", "retune_down")},
+        "trajectory": trajectory,
+    }
+    return doc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2048)
@@ -328,6 +658,57 @@ def main(argv=None):
                          "batch-sharding (replicated params) for small "
                          "models and megatron tensor-sharding for large "
                          "transformer stacks")
+    # PR 10 elastic-serving A/B (--load-profile swing)
+    ap.add_argument("--load-profile", choices=("steady", "swing"),
+                    default="steady",
+                    help="steady: the classic pre-fill benchmark; swing: "
+                         "a low -> 10x -> low offered-load profile over a "
+                         "shared FileQueue fleet driven in real time — the "
+                         "PR 10 autoscaler acceptance A/B (run once with "
+                         "--autoscale on and once with off, diff --json)")
+    ap.add_argument("--autoscale", choices=("on", "off"), default="off",
+                    help="swing: run the closed-loop controller "
+                         "(serving/autoscaler.py) over the fleet, or hold "
+                         "the initial replica count")
+    ap.add_argument("--chaos", choices=("none", "sigkill"), default="none",
+                    help="swing: SIGKILL a REAL replica subprocess "
+                         "(tests/replica_worker.py over the shared spool) "
+                         "mid-swing; its leases redeliver to survivors and "
+                         "autoscale-on replaces it via the stale-heartbeat "
+                         "path")
+    ap.add_argument("--slo-ms", type=float, default=3000.0,
+                    help="swing: the e2e p99 objective the A/B is judged "
+                         "against")
+    ap.add_argument("--base-rps", type=float, default=6.0,
+                    help="swing: offered load in the low phases")
+    ap.add_argument("--swing-factor", type=float, default=10.0,
+                    help="swing: high-phase multiplier")
+    ap.add_argument("--phase-s", type=float, default=6.0,
+                    help="swing: seconds per phase (low/high/low)")
+    ap.add_argument("--deadline-s", type=float, default=8.0,
+                    help="swing: per-record e2e budget (expired records "
+                         "shed — the off-run's failure mode)")
+    ap.add_argument("--initial-replicas", type=int, default=2,
+                    help="swing: fleet size at t=0 (with --chaos sigkill "
+                         "one of them is the subprocess victim)")
+    ap.add_argument("--max-replicas", type=int, default=8,
+                    help="swing: autoscaler topology ceiling")
+    ap.add_argument("--swing-batch", type=int, default=8,
+                    help="swing: initial max_batch knob")
+    ap.add_argument("--swing-max-batch", type=int, default=8,
+                    help="swing: model bucket ceiling (the knob ladder's "
+                         "max_batch ceiling)")
+    ap.add_argument("--service-ms", type=float, default=20.0,
+                    help="swing: simulated per-batch device time (base)")
+    ap.add_argument("--service-per-record-ms", type=float, default=60.0,
+                    help="swing: simulated per-record device time (batching "
+                         "amortizes --service-ms against this)")
+    ap.add_argument("--swing-lease-s", type=float, default=2.0,
+                    help="swing: record lease (SIGKILLed claims redeliver "
+                         "after this)")
+    ap.add_argument("--drain-timeout-s", type=float, default=60.0,
+                    help="swing: post-profile wait for every record to "
+                         "resolve")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -351,6 +732,25 @@ def main(argv=None):
                          "plane, the regime serving actually runs in on "
                          "TPU")
     args = ap.parse_args(argv)
+
+    if args.load_profile == "swing":
+        # the elastic-serving A/B is self-contained: tiny fixed model,
+        # FileQueue fleet, simulated device time — none of the steady-mode
+        # model/wire knobs apply
+        out = _run_swing(args)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("trajectory", "decisions")}))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
     if args.model == "mlp" and args.wire == "jpeg-u8":
         ap.error("--model mlp takes flat tensor records; the jpeg-u8 image "
                  "wire decodes to (H, W, 3) and cannot feed it — use "
