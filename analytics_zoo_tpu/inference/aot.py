@@ -1,0 +1,319 @@
+"""Ahead-of-time compile warm-up for serving replicas (PR 11 tentpole).
+
+A fresh replica used to pay a full XLA trace+compile the first time each
+power-of-two bucket arrived — the PR 10 chaos bench had to pre-warm buckets
+by hand so cold compiles would not read as SLO violations, and the
+autoscaler's scale-up decisions actuated a compile-time late.  This module
+makes cold start a *derived, measured* path:
+
+- ``warmup_manifest(model, ...)`` enumerates every program a deployment can
+  hit — one entry per ``(bucket, dtype, scales-variant)`` over the mesh
+  placement in force — straight from the same ``_bucket`` ladder
+  ``do_predict``/``dispatch`` use (including the non-pow-2 ``max_batch``
+  clamp and the PR 6 mesh-multiple rounding), so the warm-up set is exactly
+  the serve-time compile set, not a guess.
+- ``warm_up(model, manifest)`` compiles each entry via
+  ``jax.jit(...).lower().compile()`` and parks the executable in the
+  model's AOT cache, which ``do_predict``/``dispatch``/
+  ``_jitted_with_scales`` consult BEFORE tracing — a warmed bucket is never
+  traced again, and a ``_jitted_scaled_base`` rebuild cannot invalidate it
+  (the cache is keyed by load epoch + signature, not wrapper identity).
+- ``enable_persistent_cache(dir)`` wires jax's persistent compilation cache
+  at a per-deployment directory (the manager points every replica of one
+  deployment at ``<pidfile>.xla_cache``): the *second* replica of a
+  topology loads executables from disk instead of compiling at all.
+- ``COMPILE_STATS`` counts what actually happened via jax's monitoring
+  events: compile REQUESTS (fired whether the persistent cache answers or
+  not) and persistent-cache hits/misses — with every program cacheable,
+  ``cache_misses`` is the true backend-compile count, so "the warm path
+  performs zero XLA compiles" is a tested number, not a hope.
+
+Single-input models only (the serving engine stacks one tensor per record);
+multi-input ``do_predict`` callers still go through the same AOT cache,
+they just warm lazily on first use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class WarmupEntry(NamedTuple):
+    """One compiled program of the warm-up set.  ``shape`` is the
+    per-record tail shape (the batch axis is ``bucket``); ``scales`` marks
+    the int8-wire variant that dequantizes on device with per-row scales;
+    ``mesh``/``sharding`` record the placement the program is lowered
+    against (informational — the model's live mesh is what the compile
+    actually uses)."""
+
+    bucket: int
+    shape: Tuple[int, ...]
+    dtype: str                       # numpy dtype str of the wire batch
+    scales: bool
+    mesh: Optional[Tuple[int, int]]  # (data, model) axes, None = single-chip
+    sharding: str                    # off | batch | tensor | hybrid
+
+
+class CompileStats:
+    """Process-wide XLA compile accounting, fed by jax's monitoring
+    events.  ``compile_requests`` counts trips into
+    ``compile_or_get_cached`` (the ``backend_compile_duration`` event
+    wraps the whole call on this jax, so it fires even when the
+    persistent cache serves the binary — it measures how often the
+    tracing layer ASKED for an executable, and its seconds include cache
+    retrieval).  ``cache_hits``/``cache_misses`` count persistent-cache
+    traffic once a cache dir is configured: with every program cacheable
+    (see ``enable_persistent_cache``), **``cache_misses`` IS the true
+    backend-compile count** — the warm path asserts it stays zero."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compile_requests = 0
+        self.compile_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"compile_requests": self.compile_requests,
+                    "compile_seconds": round(self.compile_seconds, 3),
+                    "cache_hits": self.cache_hits,
+                    "cache_misses": self.cache_misses}
+
+    def _event(self, key: str, **kw) -> None:
+        if key == "/jax/compilation_cache/cache_hits":
+            with self._lock:
+                self.cache_hits += 1
+        elif key == "/jax/compilation_cache/cache_misses":
+            with self._lock:
+                self.cache_misses += 1
+
+    def _duration(self, key: str, dur: float, **kw) -> None:
+        if key == "/jax/core/compile/backend_compile_duration":
+            with self._lock:
+                self.compile_requests += 1
+                self.compile_seconds += float(dur)
+
+
+COMPILE_STATS = CompileStats()
+_LISTENERS_INSTALLED = False
+_INSTALL_LOCK = threading.Lock()
+
+
+def install_compile_listeners() -> CompileStats:
+    """Register the monitoring listeners feeding ``COMPILE_STATS``
+    (idempotent; jax keeps listeners for the process lifetime)."""
+    global _LISTENERS_INSTALLED
+    with _INSTALL_LOCK:
+        if _LISTENERS_INSTALLED:
+            return COMPILE_STATS
+        from jax._src import monitoring
+        monitoring.register_event_listener(COMPILE_STATS._event)
+        monitoring.register_event_duration_secs_listener(
+            COMPILE_STATS._duration)
+        _LISTENERS_INSTALLED = True
+    return COMPILE_STATS
+
+
+def enable_persistent_cache(path: str) -> str:
+    """Point jax's persistent compilation cache at ``path`` (created if
+    missing) and drop the min-compile-time/min-entry-size thresholds so
+    EVERY serving program lands in it — the serving bucket programs are
+    individually small and fast to compile, exactly what the default
+    thresholds skip.  Process-global (jax.config); every replica of one
+    deployment shares the same directory, so the second replica of a
+    topology reads executables instead of compiling.  Returns the path."""
+    import jax
+    if getattr(jax.config, "jax_compilation_cache_dir", None) == path:
+        # already wired (a replica boot enables before model load AND at
+        # engine start): skip the config churn and the repeat log line
+        install_compile_listeners()
+        return path
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:  # older jax without the size threshold
+        pass
+    install_compile_listeners()
+    logger.info("aot: persistent XLA compilation cache at %s", path)
+    return path
+
+
+def bucket_ladder(max_batch: int, multiple: int = 1,
+                  model_cap: Optional[int] = None) -> List[int]:
+    """Every bucket ``_bucket(n, cap, multiple)`` can produce for
+    ``1 <= n <= max_batch`` — the exact compile set a deployment serving
+    batches up to ``max_batch`` walks through.  ``model_cap`` is the
+    model's (pow-2-clamped) ``max_batch`` ceiling; the engine's adaptive
+    batcher never reads more than its own ``max_batch`` records, so the
+    ladder stops at the smaller of the two."""
+    from analytics_zoo_tpu.inference.inference_model import _bucket
+    cap = int(model_cap) if model_cap is not None else int(max_batch)
+    seen = []
+    n = 1
+    while n <= max(1, int(max_batch)):
+        b = _bucket(n, cap, multiple)
+        if b not in seen:
+            seen.append(b)
+        if n >= max_batch:
+            break
+        n = min(n * 2, int(max_batch))
+    return sorted(seen)
+
+
+def infer_input_spec(model) -> Optional[Tuple[Tuple[int, ...], str]]:
+    """Best-effort per-record input spec ``(tail_shape, dtype)`` from the
+    loaded topology's declared input shape (Sequential/Model builders
+    carry it); None when the model does not declare one — the caller must
+    then supply an explicit spec."""
+    inner = getattr(model, "_model", None)
+    shape = getattr(inner, "_declared_input_shape", None)
+    if shape is None:
+        return None
+    try:
+        return tuple(int(s) for s in shape), "<f4"
+    except (TypeError, ValueError):
+        return None
+
+
+def warmup_manifest(model, input_shape=None, dtype: str = "<f4",
+                    max_batch: Optional[int] = None,
+                    scales: str = "auto",
+                    scale_dtypes: Sequence[str] = ("|i1",)
+                    ) -> List[WarmupEntry]:
+    """Derive the warm-up set for ``model`` as deployed: one entry per
+    ``(bucket, dtype, scales-variant)`` over the placement in force.
+
+    ``input_shape``/``dtype`` describe ONE record on the wire (default:
+    the topology's declared input shape, f32).  ``max_batch`` is the
+    engine's adaptive-batcher ceiling (default: the model's own pow-2
+    ``max_batch``); buckets come from the same ladder ``do_predict`` pads
+    to, so the mesh-multiple rounding and the non-pow-2 clamp are
+    reproduced, not re-implemented.  ``scales``: ``"off"`` plain-only,
+    ``"both"`` every bucket per scale dtype (plus the plain entry),
+    ``"auto"``/``"on"`` = scale variants when the program is jit-compiled
+    (the int8 wire is part of the serving surface), plain-only for bridge
+    models.  ``scale_dtypes`` names the compact wire dtypes the scale
+    variants arrive in — default the int8 wire; deployments serving u8
+    images (``QuantizedTensor(uint8, 1.0)`` records) add ``"|u1"`` via
+    the spec so their per-row-scale program warms too."""
+    if input_shape is None:
+        spec = infer_input_spec(model)
+        if spec is None:
+            raise ValueError(
+                "warmup_manifest: the model declares no input shape; pass "
+                "input_shape=(d0, ...) for one record")
+        input_shape, dtype = spec
+    tail = tuple(int(s) for s in input_shape)
+    multiple = int(getattr(model, "_batch_multiple", 1) or 1)
+    cap = int(getattr(model, "max_batch", 1024) or 1024)
+    mb = int(max_batch) if max_batch else cap
+    mesh = None
+    mode = getattr(model, "_sharding_mode", None) or "off"
+    m = getattr(model, "_mesh", None)
+    if m is not None:
+        mesh = (int(m.shape.get("data", 1)), int(m.shape.get("model", 1)))
+    jit_ok = hasattr(getattr(model, "_jitted", None), "lower")
+    if scales in ("auto", "on"):
+        want_scales = jit_ok
+    elif scales == "both":
+        want_scales = True
+    else:
+        want_scales = False
+    entries: List[WarmupEntry] = []
+    for bucket in bucket_ladder(mb, multiple, model_cap=cap):
+        entries.append(WarmupEntry(bucket, tail, np.dtype(dtype).str,
+                                   False, mesh, mode))
+        if want_scales:
+            # compact-wire variants: the batch arrives in its wire dtype
+            # with per-row dequant scales (engine QuantizedTensor path)
+            for sdt in scale_dtypes:
+                entries.append(WarmupEntry(bucket, tail,
+                                           np.dtype(sdt).str, True,
+                                           mesh, mode))
+    return entries
+
+
+def resolve_manifest(model, warmup_spec) -> List[WarmupEntry]:
+    """Manifest from a ``ServingParams.warmup`` value: ``True`` derives
+    everything from the model, a spec dict ``{"shape", "dtype", "scales",
+    "max_batch"}`` overrides per key — the ONE resolution shared by the
+    serving engine and ``manager warmup`` so the pre-warm pass compiles
+    exactly the set the replicas will look up."""
+    spec = warmup_spec if isinstance(warmup_spec, dict) else {}
+    return warmup_manifest(
+        model,
+        input_shape=spec.get("shape"),
+        dtype=str(spec.get("dtype", "<f4")),
+        max_batch=spec.get("max_batch"),
+        scales=str(spec.get("scales", "auto")),
+        scale_dtypes=tuple(spec.get("scale_dtypes") or ("|i1",)))
+
+
+def warm_up(model, manifest: Optional[Sequence[WarmupEntry]] = None,
+            progress=None, stop=None, **manifest_kw) -> Dict:
+    """Compile every program in ``manifest`` (default: derived via
+    ``warmup_manifest``) into the model's AOT executable cache.  Each
+    entry that is already cached (an earlier warm-up, or a live request
+    that beat us to it) is skipped for free.  ``progress(done, total,
+    entry)`` is called after each entry — the serving engine uses it to
+    publish per-bucket progress on ``/readyz``.
+
+    Returns ``{"programs", "compiled", "skipped", "failed", "seconds",
+    "compile_stats"}`` where ``compile_stats`` is the COMPILE_STATS delta
+    for the pass — on a process whose persistent cache is already
+    populated, ``cache_misses`` stays 0 and ``cache_hits`` covers the
+    set (the zero-cold-start evidence)."""
+    install_compile_listeners()
+    if manifest is None:
+        manifest = warmup_manifest(model, **manifest_kw)
+    before = COMPILE_STATS.snapshot()
+    t0 = time.monotonic()
+    compiled = skipped = failed = 0
+    stopped = False
+    for i, entry in enumerate(manifest):
+        if stop is not None and stop():
+            # a draining engine must not keep the process alive compiling
+            # programs nobody will run
+            stopped = True
+            break
+        try:
+            fresh = model.warm(entry.bucket, entry.shape, dtype=entry.dtype,
+                               scales=entry.scales)
+            compiled += 1 if fresh else 0
+            skipped += 0 if fresh else 1
+        except Exception as e:  # noqa: BLE001 — one bad entry must not
+            # strand the rest of the set (the live path falls back to
+            # tracing for whatever stays cold)
+            failed += 1
+            logger.warning("aot: warm-up entry %s failed (%s: %s)",
+                           entry, type(e).__name__, e)
+        if progress is not None:
+            progress(i + 1, len(manifest), entry)
+    after = COMPILE_STATS.snapshot()
+    stats = {
+        "programs": len(manifest),
+        "compiled": compiled,
+        "skipped": skipped,
+        "failed": failed,
+        "stopped": stopped,
+        "seconds": round(time.monotonic() - t0, 3),
+        "compile_stats": {k: round(after[k] - before[k], 3)
+                          for k in after},
+    }
+    logger.info("aot: warm-up %d program(s) in %.2fs (%d fresh, %d cached, "
+                "%d failed; %s backend compile(s), %s cache hit(s))",
+                stats["programs"], stats["seconds"], compiled, skipped,
+                failed, stats["compile_stats"]["cache_misses"],
+                stats["compile_stats"]["cache_hits"])
+    return stats

@@ -26,6 +26,7 @@ program per bucket.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import weakref
@@ -122,6 +123,25 @@ class InferenceModel:
         self._model: Optional[Layer] = None
         self._jitted = None
         self._sem = threading.BoundedSemaphore(self.concurrent_num)
+        # AOT executable cache (PR 11 zero cold start): one compiled
+        # program per (load epoch, padded signature), consulted by
+        # do_predict/dispatch BEFORE tracing.  aot.warm_up pre-populates
+        # it at load time; live misses compile once and join it.  The
+        # epoch bumps whenever the underlying program changes (re-load,
+        # quantize, shard) so stale executables can never serve — and a
+        # `_jitted_scaled_base` wrapper rebuild alone can NOT invalidate
+        # it (the old churn: every rebuild emptied the jit cache).
+        self._aot: Dict = {}
+        self._aot_lock = threading.Lock()
+        self._aot_epoch = 0
+        self.aot_hits = 0               # padded calls served by the cache
+        self.aot_compiles = 0           # lower().compile() calls we made
+        self.load_seconds: Optional[float] = None   # last do_load* wall
+        self.load_mmap = False          # last load used the mmap store
+        # scaled-program wrappers per base program (bounded): a base that
+        # drifts A -> B -> A (instance patches, chaos shims) re-uses A's
+        # wrapper and its jit cache instead of rebuilding from scratch
+        self._scaled_wrappers: Dict = {}
         # unified telemetry (PR 4): predict/dispatch latency + batch-size
         # histograms.  `registry` is an observability.MetricsRegistry; left
         # None it binds lazily — to the serving engine's registry when this
@@ -318,6 +338,7 @@ class InferenceModel:
         self._plan = plan
         self._sharding_mode = mode
         self._batch_multiple = max(1, dd)
+        self._bump_epoch()     # committed shardings change the programs
         self._obs = None       # histogram children re-label with the mode
         logger.info(
             "InferenceModel: sharded predict enabled — mode=%s mesh=%dx%d "
@@ -344,6 +365,99 @@ class InferenceModel:
         self._sharded_calls += 1
         return xs, scales
 
+    # -- AOT executable cache (PR 11 zero cold start) -------------------------
+    def _bump_epoch(self) -> None:
+        """Invalidate every compiled executable: the underlying program
+        changed (new weights, quantized graph, mesh placement)."""
+        with self._aot_lock:
+            self._aot_epoch += 1
+            self._aot.clear()
+            self._scaled_wrappers.clear()
+
+    def _aot_key(self, fn, xs: List, sc, multi: bool):
+        # `fn` (the jitted base or its per-base scaled wrapper) is part of
+        # the key: an external `_jitted` patch that skips the epoch bump
+        # must MISS, never serve the old program — while the per-base
+        # wrapper cache keeps the fn identity stable across scaled/
+        # unscaled interleaving, so legitimate reuse still hits
+        return (self._aot_epoch, fn, multi, sc is not None,
+                tuple((tuple(a.shape), np.dtype(a.dtype).str) for a in xs))
+
+    def _padded_call(self, xs: List, sc, multi: bool, execute: bool = True):
+        """Run ONE padded, committed bucket batch — the single exec path
+        shared by `do_predict`, `dispatch` and `warm`.  An AOT executable
+        for this signature (warm-up or an earlier call) runs without any
+        tracing; a miss lowers+compiles once via the same jitted program
+        and joins the cache (hitting the persistent compilation cache when
+        one is configured), so at most one compile per signature per load
+        epoch ever happens, no matter how wrappers churn.
+
+        ``execute=False`` (the warm-up path) stops after the executable
+        exists: compiling is what warm-up buys — running every program on
+        a dummy batch would burn real forward-pass CPU against the live
+        pipeline for nothing."""
+        if sc is not None:
+            fn = self._jitted_with_scales()
+            if not hasattr(fn, "lower"):
+                # host bridge path (TFNet lambda): nothing to compile
+                return fn(self._params, self._state, xs[0], sc) \
+                    if execute else None
+            args = (self._params, self._state, xs[0], sc)
+        else:
+            fn = self._jitted
+            if not hasattr(fn, "lower"):
+                return fn(self._params, self._state,
+                          xs if multi else xs[0]) if execute else None
+            args = (self._params, self._state, xs if multi else xs[0])
+        key = self._aot_key(fn, xs, sc, multi)
+        exe = self._aot.get(key)
+        if exe is None:
+            # compile OUTSIDE the lock: the warm-up thread walks its
+            # manifest through here, and a live request racing it for a
+            # different bucket must not queue behind the whole set.  Two
+            # threads racing the SAME signature both compile (the
+            # persistent cache makes the loser cheap) and the dict keeps
+            # whichever registered first.
+            exe = fn.lower(*args).compile()
+            with self._aot_lock:
+                self.aot_compiles += 1
+                if key[0] == self._aot_epoch:
+                    exe = self._aot.setdefault(key, exe)
+        else:
+            self.aot_hits += 1
+        return exe(*args) if execute else None
+
+    def warm(self, bucket: int, shape, dtype: str = "<f4",
+             scales: bool = False) -> bool:
+        """Compile (or confirm cached) the program for one warm-up entry:
+        a `(bucket,) + shape` batch of `dtype`, optionally the int8-wire
+        per-row-scales variant.  Runs the REAL padded/committed exec path
+        so the cached executable is byte-for-byte the one `do_predict` and
+        `dispatch` will look up — but does NOT execute it (execute=False:
+        `.compile()` returning IS the warm state).  Returns True when this
+        call compiled a fresh executable, False when already cached."""
+        x = np.zeros((int(bucket),) + tuple(int(s) for s in shape),
+                     np.dtype(dtype))
+        sc = np.ones((int(bucket),), np.float32) if scales else None
+        xs, sc = self._commit([x], sc)
+        fn = self._jitted_with_scales() if sc is not None else self._jitted
+        if not hasattr(fn, "lower"):
+            # bridge path (TFNet lambda): nothing compilable exists, so
+            # nothing can become "fresh" — reporting True forever would
+            # make warm_up claim compile progress that never happened
+            return False
+        fresh = self._aot_key(fn, xs, sc, False) not in self._aot
+        self._padded_call(xs, sc, False, execute=False)
+        return fresh
+
+    def aot_stats(self) -> Dict:
+        """AOT-cache evidence counters (bench/test surface)."""
+        with self._aot_lock:
+            return {"epoch": self._aot_epoch,
+                    "cached_programs": len(self._aot),
+                    "hits": self.aot_hits,
+                    "compiles": self.aot_compiles}
+
     # -- loaders --------------------------------------------------------------
     def do_load_model(self, model: Layer, params=None, state=None):
         """Load an in-memory zoo layer/container (doLoadBigDL analog).
@@ -359,15 +473,63 @@ class InferenceModel:
         self._plan = None
         self._sharding_mode = None
         self._batch_multiple = 1
+        self._bump_epoch()
         return self
 
-    def do_load(self, topology_builder: Callable[[], Layer], weights_path: str):
-        """Rebuild topology via `topology_builder` and load weights from `.npz`
-        (doLoad analog — weights file + known architecture)."""
+    def do_load(self, topology_builder: Callable[[], Layer],
+                weights_path: str):
+        """Rebuild topology via `topology_builder` and load weights from
+        `.npz` (doLoad analog — weights file + known architecture).  A
+        DIRECTORY path is an mmap'd weight store (inference/weightstore.py,
+        PR 11): leaves restore as memory-mapped views — no deserialization
+        copy at boot, and N replicas on one host share the page cache —
+        then move to the device with one `jax.device_put` per leaf."""
+        t0 = time.perf_counter()
+        if os.path.isdir(weights_path):
+            return self.do_load_store(topology_builder, weights_path)
         model = topology_builder()
         model.init_weights()
         model.load_weights(weights_path)
-        return self.do_load_model(model, model._params, model._state)
+        out = self.do_load_model(model, model._params, model._state)
+        self.load_seconds = time.perf_counter() - t0
+        self.load_mmap = False
+        return out
+
+    def do_load_store(self, topology_builder: Callable[[], Layer],
+                      store_dir: str):
+        """Restore weights from an mmap'd store directory (PR 11 zero cold
+        start): each leaf is a bare `.npy` read with
+        ``np.load(mmap_mode="r")`` — the boot touches no weight bytes until
+        the device transfer pages them in, and every replica on the host
+        maps the SAME page-cache pages — then the whole tree is placed with
+        `jax.device_put` once, so predict calls never re-transfer host
+        params."""
+        from analytics_zoo_tpu.inference import weightstore
+        t0 = time.perf_counter()
+        model = topology_builder()
+        # the restore needs only the tree SKELETON (paths + shapes), not
+        # computed weights: eval_shape traces init abstractly — no random
+        # generation, no initializer compiles — shaving the warm boot
+        # further.  Builders whose init resists abstract evaluation fall
+        # back to a real init.
+        try:
+            p0, s0 = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+            like = {"params": p0, "state": s0}
+        except Exception:  # noqa: BLE001 — data-dependent init
+            model.init_weights()
+            like = {"params": model._params, "state": model._state}
+        tree = weightstore.load_store(store_dir, like=like)
+        params, state = tree["params"], tree["state"]
+        # one transfer at load (vs one per predict for host-resident
+        # params): DMA reads the mapped pages directly
+        params = jax.device_put(params)
+        if state:
+            state = jax.device_put(state)
+        model.set_weights(params, state)
+        out = self.do_load_model(model, params, state)
+        self.load_seconds = time.perf_counter() - t0
+        self.load_mmap = True
+        return out
 
     def do_load_tensorflow(self, saved_model_path: str,
                            signature: str = "serving_default"):
@@ -378,6 +540,7 @@ class InferenceModel:
         self._model = net
         self._params, self._state = {}, {}
         self._jitted = lambda p, s, x: net.call({}, x)
+        self._bump_epoch()
         return self
 
     def do_load_onnx(self, onnx_path: str):
@@ -443,6 +606,7 @@ class InferenceModel:
         model = self._model
         self._jitted = jax.jit(
             lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        self._bump_epoch()     # the quantized graph is a new program
         if self._mesh is not None:
             # quantize rebuilt the params tree on host: re-place it under
             # the plan already in force (leaves whose new shapes no longer
@@ -490,12 +654,7 @@ class InferenceModel:
         bucket = _bucket(n, self.max_batch, self._batch_multiple)
         xs, sc = _pad_to_bucket(xs, scales, n, bucket)
         xs, sc = self._commit(xs, sc)
-        if sc is not None:
-            out = self._jitted_with_scales()(self._params, self._state,
-                                             xs[0], sc)
-        else:
-            arg = xs if multi else xs[0]
-            out = self._jitted(self._params, self._state, arg)
+        out = self._padded_call(xs, sc, multi)
         self._observe("dispatch", n, time.perf_counter() - t0)
         return self._Pending(out, n)
 
@@ -504,30 +663,46 @@ class InferenceModel:
         """Lazily-built dequantizing predict: the int8/uint8 batch is
         TRANSFERRED in its compact dtype and multiplied by the per-row scale
         on device (round 5 serving wire path) — 4x less host->device
-        traffic than shipping f32."""
-        if getattr(self, "_jitted_scaled", None) is None \
-                or getattr(self, "_jitted_scaled_base", None) \
-                is not self._jitted:
-            import jax.numpy as jnp
-            base = self._jitted
-            if hasattr(base, "lower"):        # a real jitted program
+        traffic than shipping f32.
 
-                def fn(p, s, x, sc):
-                    xf = x.astype(jnp.float32) \
-                        * sc.reshape(sc.shape + (1,) * (x.ndim - 1))
-                    return base(p, s, xf)
-                self._jitted_scaled = jax.jit(fn)
-            else:
-                # un-jittable bridge path (e.g. TFNet lambda): dequantize on
-                # host — correctness over the transfer win
-                def fn(p, s, x, sc):
-                    xf = np.asarray(x, np.float32) * np.asarray(
-                        sc, np.float32).reshape(
-                            sc.shape + (1,) * (np.ndim(x) - 1))
-                    return base(p, s, xf)
-                self._jitted_scaled = fn
-            self._jitted_scaled_base = base
-        return self._jitted_scaled
+        Wrappers are cached PER BASE PROGRAM (PR 11 churn fix): the old
+        single-slot cache was discarded whenever `_jitted` drifted, so a
+        base that flipped A -> B -> A (instance patches, chaos shims,
+        re-quantize round-trips) rebuilt the jit wrapper — and with it an
+        empty compile cache — every flip.  Now each base keeps its wrapper
+        (bounded; epoch bumps clear the table), and the AOT executable
+        cache keys by signature rather than wrapper identity, so interleaved
+        scaled/unscaled dispatches never recompile a bucket they have
+        already paid for."""
+        base = self._jitted
+        fn = self._scaled_wrappers.get(base)
+        if fn is not None:
+            self._jitted_scaled, self._jitted_scaled_base = fn, base
+            return fn
+        import jax.numpy as jnp
+        if hasattr(base, "lower"):        # a real jitted program
+
+            def fn(p, s, x, sc):
+                xf = x.astype(jnp.float32) \
+                    * sc.reshape(sc.shape + (1,) * (x.ndim - 1))
+                return base(p, s, xf)
+            fn = jax.jit(fn)
+        else:
+            # un-jittable bridge path (e.g. TFNet lambda): dequantize on
+            # host — correctness over the transfer win
+            def fn(p, s, x, sc):
+                xf = np.asarray(x, np.float32) * np.asarray(
+                    sc, np.float32).reshape(
+                        sc.shape + (1,) * (np.ndim(x) - 1))
+                return base(p, s, xf)
+        if len(self._scaled_wrappers) >= 8:
+            # bounded: drop the oldest wrapper (its AOT executables stay
+            # valid — they are keyed by signature, not by the wrapper)
+            self._scaled_wrappers.pop(next(iter(self._scaled_wrappers)))
+        self._scaled_wrappers[base] = fn
+        # legacy aliases (pre-PR-11 callers/tests poked at these)
+        self._jitted_scaled, self._jitted_scaled_base = fn, base
+        return fn
 
     def do_predict(self, x, batch_size: Optional[int] = None,
                    scales: Optional[np.ndarray] = None) -> np.ndarray:
@@ -566,13 +741,8 @@ class InferenceModel:
                     chunk, None if sc is None else sc[i:i + take],
                     take, bucket)
                 chunk, schunk = self._commit(chunk, schunk)
-                if schunk is not None:
-                    pending.append((self._jitted_with_scales()(
-                        self._params, self._state, chunk[0], schunk), take))
-                else:
-                    arg = chunk if multi else chunk[0]
-                    pending.append(
-                        (self._jitted(self._params, self._state, arg), take))
+                pending.append(
+                    (self._padded_call(chunk, schunk, multi), take))
                 if len(pending) >= self.concurrent_num:
                     drain_one()
                 i += take

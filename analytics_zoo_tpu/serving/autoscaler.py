@@ -77,6 +77,12 @@ class FleetSignals:
     preprocess_p99_ms: Optional[float] = None
     predict_p99_ms: Optional[float] = None
     heartbeat_ages: Dict[str, float] = field(default_factory=dict)
+    # zero cold start (PR 11): members still compiling their warm-up set
+    # (alive but not yet taking routed traffic) and the fleet's slowest
+    # measured spawn-to-first-result — together they tell the controller
+    # how stale its own scale-up decisions run (actuation lag)
+    replicas_warming: int = 0
+    cold_start_s: Optional[float] = None
     # current fast-tier targets + their ceilings (from the engines' knobs())
     max_batch: int = 4
     max_batch_ceiling: int = 1024
@@ -349,6 +355,17 @@ class Autoscaler:
         self._g_p99 = reg.gauge(
             "autoscaler_observed_p99_ms",
             "Fleet e2e p99 at the last controller tick")
+        # actuation lag (PR 11): scale_up decision -> every new member
+        # alive AND warm.  The whole point of zero-cold-start replicas is
+        # shrinking this number — with it measured, a predictive policy
+        # term has something to be judged against.
+        self._g_lag = reg.gauge(
+            "autoscaler_actuation_lag_seconds",
+            "Last scale_up decision to fleet-at-target-and-warm")
+        self._g_warming = reg.gauge(
+            "autoscaler_replicas_warming",
+            "Members still compiling their warm-up set")
+        self._pending_scale: Optional[tuple] = None  # (t_decided, target)
 
     # -- one evaluation -------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> List[Action]:
@@ -362,9 +379,28 @@ class Autoscaler:
         self._m_ticks.inc()
         self._g_p99.set(signals.e2e_p99_ms
                         if signals.e2e_p99_ms is not None else float("nan"))
+        self._g_warming.set(float(signals.replicas_warming))
+        if self._pending_scale is not None:
+            t_req, target = self._pending_scale
+            if signals.replicas >= target and signals.replicas_warming == 0:
+                lag = max(0.0, now - t_req)
+                self._g_lag.set(lag)
+                self._pending_scale = None
+                logger.info(
+                    "autoscaler: scale-up actuated — %d replica(s) alive "
+                    "and warm %.2fs after the decision (fleet cold-start "
+                    "%s)", target, lag,
+                    f"{signals.cold_start_s:.2f}s"
+                    if signals.cold_start_s is not None else "n/a")
         actions = self.policy.decide(signals, now)
         for act in actions:
             self._apply(act, signals)
+            if act.kind == "scale_up":
+                self._pending_scale = (now, int(act.target))
+            elif act.kind == "scale_down":
+                # the fleet is shrinking: a pending lag measurement would
+                # trivially "complete" at the lower target — drop it
+                self._pending_scale = None
         # current targets AFTER this tick's actions
         self._g_replicas.set(getattr(self.fleet, "desired", signals.desired))
         self._g_max_batch.set(signals.max_batch)
@@ -558,6 +594,8 @@ class EngineFleet:
         except Exception:  # noqa: BLE001 — backend down: zeros, the
             qh = {}        # heartbeats still drive replacement
         served = shed = quarantined = reclaimed = 0.0
+        warming = 0
+        cold_start = None
         hb: Dict[str, float] = {}
         for e in engines:
             served += e.total_records
@@ -565,6 +603,13 @@ class EngineFleet:
             quarantined += e.dead_lettered
             reclaimed += e.reclaimed
             hb[e.replica_id] = e._heartbeat_age()
+            w = getattr(e, "_warm_state", None) or {}
+            if w.get("state") in ("pending", "warming"):
+                warming += 1
+            cs = getattr(e, "_cold_start_s", None)
+            if cs is not None:
+                cold_start = cs if cold_start is None \
+                    else max(cold_start, cs)
         for rid, ext in external.items():
             age = None
             try:
@@ -596,7 +641,9 @@ class EngineFleet:
                 e._stages["preprocess"] for e in engines),
             predict_p99_ms=self._merged_p99_ms(
                 e._stages["predict"] for e in engines),
-            heartbeat_ages=hb)
+            heartbeat_ages=hb,
+            replicas_warming=warming,
+            cold_start_s=cold_start)
         if engines:
             k = engines[0].knobs()
             sig.max_batch = int(k["max_batch"])
@@ -664,6 +711,8 @@ class ManagerFleet:
             preprocess_p99_ms=agg.get("preprocess_p99_ms"),
             predict_p99_ms=agg.get("predict_p99_ms"),
             heartbeat_ages=dict(agg.get("heartbeat_ages", {})),
+            replicas_warming=int(agg.get("replicas_warming", 0) or 0),
+            cold_start_s=agg.get("cold_start_s"),
             max_batch=int(knobs.get("max_batch", 4)),
             max_batch_ceiling=int(knobs.get("max_batch_ceiling", 1024)),
             inflight_batches=int(knobs.get("inflight_batches", 2)),

@@ -328,7 +328,9 @@ class ServingParams:
                  max_deliveries: int = 5,
                  mesh_shape=None,
                  sharding: str = "off",
-                 gateway: bool = True):
+                 gateway: bool = True,
+                 warmup=False,
+                 compile_cache_dir: Optional[str] = None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -394,6 +396,19 @@ class ServingParams:
         # on the probe port.  Off = probe-only port (deployments that front
         # ingest elsewhere)
         self.gateway = bool(gateway)
+        # zero cold start (PR 11).  `warmup`: AOT-compile the full
+        # (bucket, scales-variant) program set at start() — False (off,
+        # the pre-PR-11 behaviour), True (input spec inferred from the
+        # topology's declared input shape), or a spec dict
+        # {"shape": [d0, ...], "dtype": "<f4", "scales": "auto|both|off",
+        #  "max_batch": N} for models that declare nothing.  /readyz
+        # reports `warming (k/n programs)` until the set is compiled.
+        # `compile_cache_dir`: persistent XLA compilation cache directory
+        # shared by every replica of the deployment (the manager derives
+        # `<pidfile>.xla_cache` when unset) — the second replica of a
+        # topology loads executables from disk instead of compiling.
+        self.warmup = warmup if isinstance(warmup, dict) else bool(warmup)
+        self.compile_cache_dir = compile_cache_dir
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -438,7 +453,9 @@ class ServingParams:
                         if isinstance(p["mesh_shape"], (list, tuple))
                         else int(p["mesh_shape"])),
             sharding=str(p.get("sharding", "off")),
-            gateway=bool(p.get("gateway", True)))
+            gateway=bool(p.get("gateway", True)),
+            warmup=p.get("warmup", False),
+            compile_cache_dir=p.get("compile_cache_dir"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -494,6 +511,15 @@ class ClusterServing:
         # self-reclaim would double-serve them.  Entries clear on ack.
         self._inflight: Dict[str, float] = {}
         self._hb_ts = time.monotonic()       # read-loop heartbeat stamp
+        # zero cold start (PR 11): AOT warm-up progress (published on
+        # /readyz + the health doc) and the construction-to-first-result
+        # clock the cold-start metric reports
+        self._t_construct = time.monotonic()
+        self._cold_start_s: Optional[float] = None
+        self._warm_state: Dict = {"state": "off", "total": 0,
+                                  "compiled": 0, "failed": 0,
+                                  "seconds": None}
+        self._warm_thread: Optional[threading.Thread] = None
         # the queue handle's claims are made under our replica identity
         try:
             self.queue.consumer = self.replica_id
@@ -608,6 +634,20 @@ class ClusterServing:
         self._gauge_fns.append(
             (reg.gauge("serving_breaker_trips", "Result-write breaker trips",
                        fn=trips), trips))
+        # cold-start observability (PR 11): how long this replica took to
+        # become useful, split into its phases — `load` is the model's
+        # weight-load wall (stamped by do_load*; mmap'd store loads are
+        # near-zero), `compile` the AOT warm-up pass.  The autoscaler reads
+        # these off the health doc to log scale-up actuation lag.
+        self._g_warm = reg.gauge(
+            "serving_warmup_seconds",
+            "Replica warm-up wall seconds, by phase", labels=("phase",))
+        self._g_cold = reg.gauge(
+            "replica_cold_start_seconds",
+            "Engine construction to first result written, this replica")
+        load_s = getattr(model, "load_seconds", None)
+        if load_s is not None:
+            self._g_warm.labels(phase="load").set(float(load_s))
         # inference-side latency/batch histograms (InferenceModel) ride this
         # engine's registry so one scrape covers the whole data plane (see
         # InferenceModel.bind_registry for the re-binding/pinning rules)
@@ -1287,6 +1327,14 @@ class ClusterServing:
                                  trace_id=tmap.get(rid), uri=rid)
         if n and inflight.t_read is not None:
             self._e2e.record(now - inflight.t_read, n=n)
+        if n and self._cold_start_s is None:
+            # construction-to-serving-capable, the number the autoscaler's
+            # actuation lag is made of.  Stamped by whichever comes first:
+            # the first result written (a backlog was waiting — the bench's
+            # spawn-to-first-result) or warm-up completion (an idle boot
+            # must not count time spent waiting for traffic as cold start)
+            self._cold_start_s = now - self._t_construct
+            self._g_cold.set(self._cold_start_s)
         self.total_records += n
         self._m_records.inc(n)
         dt = max(now - inflight.t_dispatch, 1e-9)
@@ -1368,6 +1416,17 @@ class ClusterServing:
             from analytics_zoo_tpu.serving.http import HealthServer
             self._http = HealthServer(self, host=p.http_host,
                                       port=p.http_port).start()
+        # zero cold start (PR 11): persistent compile cache + AOT warm-up.
+        # The warm-up runs on its own thread so the pipeline serves (and
+        # compiles lazily) meanwhile; /readyz reports `warming` with
+        # per-program progress until the set is compiled, so the front
+        # door routes around a still-cold replica instead of eating its
+        # compile latency.
+        if p.compile_cache_dir and p.compile_cache_dir != "off":
+            from analytics_zoo_tpu.inference import aot
+            aot.enable_persistent_cache(p.compile_cache_dir)
+        if p.warmup and isinstance(self.model, InferenceModel):
+            self._start_warmup()
         self._staged = _q.Queue(maxsize=p.pipeline_depth)
         # dispatch() takes no semaphore, so the engine is what bounds
         # device-resident batches: the handle queue holds `inflight`, plus
@@ -1405,6 +1464,73 @@ class ClusterServing:
         self._pre_thread = self._pre_sup._thread
         self._thread = self._predict_sup._thread
         return self
+
+    # -- AOT warm-up (PR 11 zero cold start) ---------------------------------
+    def _start_warmup(self) -> None:
+        """Derive the warm-up manifest and compile it on a daemon thread.
+        An underivable manifest (no declared input shape and no spec) is a
+        warning, not a failed start — the deployment just stays on the
+        lazy-compile path it had before PR 11."""
+        from analytics_zoo_tpu.inference import aot
+        p = self.params
+        try:
+            manifest = aot.resolve_manifest(self.model, p.warmup)
+        except Exception as e:  # noqa: BLE001 — stay on the lazy path
+            logger.warning(
+                "serving: warm-up disabled — manifest underivable (%s: "
+                "%s); pass warmup={'shape': [...]} in params",
+                type(e).__name__, e)
+            self._warm_state.update(state="off", error=str(e))
+            return
+        # `pending` BEFORE the thread runs: a /readyz scraped between
+        # start() and the first compile must already say warming
+        self._warm_state.update(state="pending", total=len(manifest),
+                                compiled=0, failed=0, seconds=None)
+        self._warm_thread = threading.Thread(
+            target=self._warmup_loop, args=(manifest,),
+            name="serving-warmup", daemon=True)
+        self._warm_thread.start()
+
+    def _warmup_loop(self, manifest) -> None:
+        from analytics_zoo_tpu.inference import aot
+        self._warm_state["state"] = "warming"
+
+        def progress(done, total, entry):
+            self._warm_state["compiled"] = done
+
+        try:
+            stats = aot.warm_up(self.model, manifest, progress=progress,
+                                stop=self._stop.is_set)
+        except Exception as e:  # noqa: BLE001 — a warm-up crash must not
+            # block readiness forever; the lazy path still serves
+            logger.exception("serving: warm-up pass failed")
+            self._warm_state.update(state="failed", error=str(e))
+            return
+        if stats.get("stopped"):
+            self._warm_state.update(state="cancelled")
+            return
+        self._warm_state.update(
+            state="ready" if not stats["failed"] else "degraded",
+            failed=stats["failed"], seconds=stats["seconds"],
+            compile_stats=stats["compile_stats"])
+        self._g_warm.labels(phase="compile").set(float(stats["seconds"]))
+        if self._cold_start_s is None:
+            # serving-capable without having seen traffic yet: the replica
+            # is warm — the clock stops here, not at the first record
+            self._cold_start_s = time.monotonic() - self._t_construct
+            self._g_cold.set(self._cold_start_s)
+        logger.info(
+            "serving: replica %s warm — %d/%d program(s) in %.2fs (%s "
+            "backend compile(s), %s persistent-cache hit(s))",
+            self.replica_id, stats["programs"] - stats["failed"],
+            stats["programs"], stats["seconds"],
+            stats["compile_stats"]["cache_misses"],
+            stats["compile_stats"]["cache_hits"])
+
+    def warmup_state(self) -> Dict:
+        """Warm-up progress document (health doc / readyz / manager
+        status surface)."""
+        return dict(self._warm_state)
 
     def _pre_loop(self):
         sup = self._pre_sup
@@ -1530,6 +1656,12 @@ class ClusterServing:
              "total_records": self.total_records,
              "dead_lettered": self.dead_lettered,
              "shed": self.shed,
+             # zero cold start (PR 11): warm-up progress + the replica's
+             # measured spawn-to-first-result — these ride the health doc
+             # into fleet aggregation and FleetSignals
+             "warmup": self.warmup_state(),
+             "cold_start_s": (None if self._cold_start_s is None
+                              else round(self._cold_start_s, 3)),
              "breaker": self._breaker.health(),
              "dead_letter_breaker": self._dead_breaker.health(),
              # live data-plane knob targets (PR 10): the autoscaler's
@@ -1548,6 +1680,15 @@ class ClusterServing:
             reasons.append("draining")
         if not h["running"]:
             reasons.append("workers-not-running")
+        w = h.get("warmup") or {}
+        if w.get("state") in ("pending", "warming"):
+            # a cold replica must not take routed traffic: every record it
+            # claims pays a compile the warm fleet members would not.
+            # `failed`/`degraded` do NOT hold readiness — the lazy-compile
+            # path still serves, just cold.
+            reasons.append(
+                f"warming ({w.get('compiled', 0)}/{w.get('total', 0)} "
+                f"programs)")
         if h["breaker"]["state"] == CircuitBreaker.OPEN:
             reasons.append("result-write-breaker-open")
         q = h["queue"]
@@ -1565,8 +1706,18 @@ class ClusterServing:
         return {"ready": not reasons, "reasons": reasons}
 
     def ready(self) -> Dict:
-        """Readiness probe document (`/readyz`)."""
-        return self.health()["ready"]
+        """Readiness probe document (`/readyz`).  While the AOT warm-up
+        set is compiling the verdict is not-ready with a
+        ``warming (k/n programs)`` reason, and the progress block rides
+        the body so operators see WHY a new replica is not taking traffic
+        yet."""
+        h = self.health()
+        doc = dict(h["ready"])
+        if self._warm_state.get("state") != "off":
+            doc["warmup"] = {
+                k: self._warm_state.get(k)
+                for k in ("state", "compiled", "total", "seconds")}
+        return doc
 
     @staticmethod
     def metrics_from_health(h: Dict) -> Dict:

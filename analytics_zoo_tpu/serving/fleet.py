@@ -125,6 +125,8 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     hb: Dict[str, float] = {}
     knobs: Optional[Dict] = None
     alive = 0
+    warming = 0                      # replicas still compiling (PR 11)
+    cold_start: Optional[float] = None   # slowest measured cold start
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -146,8 +148,16 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             hb[rid] = float("inf")
         if knobs is None and isinstance(doc.get("knobs"), dict):
             knobs = doc["knobs"]
+        w = doc.get("warmup") or {}
+        if w.get("state") in ("pending", "warming"):
+            warming += 1
+        cs = doc.get("cold_start_s")
+        if isinstance(cs, (int, float)):
+            cold_start = cs if cold_start is None else max(cold_start, cs)
     return {"replicas_total": len(docs),
             "replicas_alive": alive,
+            "replicas_warming": warming,
+            "cold_start_s": cold_start,
             "served": served, "shed": shed, "quarantined": quarantined,
             "reclaimed": reclaimed, "duplicates": duplicates,
             "restarts": restarts,
@@ -171,7 +181,7 @@ def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
     per_replica = {}
     for i, doc in sorted(docs.items()):
         e2e = (doc.get("stages") or {}).get("e2e") or {}
-        per_replica[doc.get("replica_id") or f"replica-{i}"] = {
+        member = {
             "served": doc.get("total_records", 0),
             "shed": doc.get("shed", 0),
             "quarantined": doc.get("dead_lettered", 0),
@@ -179,8 +189,21 @@ def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
             "running": bool(doc.get("running")),
             "heartbeat_age_s": doc.get("heartbeat_age_s"),
             "p99_ms": e2e.get("p99_ms")}
+        # warm-up visibility (PR 11): a replica that exists but is not
+        # taking traffic yet shows `warming (k/n)` here, so `manager
+        # metrics --all-replicas` explains the gap between desired and
+        # serving capacity
+        w = doc.get("warmup") or {}
+        if w.get("state") and w["state"] != "off":
+            member["warmup"] = {k: w.get(k) for k in
+                                ("state", "compiled", "total", "seconds")}
+        if doc.get("cold_start_s") is not None:
+            member["cold_start_s"] = doc["cold_start_s"]
+        per_replica[doc.get("replica_id") or f"replica-{i}"] = member
     return {"replicas": {"total": agg["replicas_total"],
-                         "alive": agg["replicas_alive"]},
+                         "alive": agg["replicas_alive"],
+                         "warming": agg["replicas_warming"]},
+            "cold_start_s": agg["cold_start_s"],
             "served": agg["served"],
             "quarantined": agg["quarantined"],
             "shed": agg["shed"],

@@ -14,8 +14,11 @@ Probes (PR 2/4/5):
   snapshots to ``<pidfile>.health.json``.
 - ``GET /readyz``   — readiness: 200 only when the engine can take traffic
   (workers alive, breakers not open, queue depth under the admission
-  threshold, backend reachable, not draining).  503 with
-  ``{"ready": false, "reasons": [...]}`` otherwise.
+  threshold, backend reachable, not draining, AOT warm-up set compiled).
+  503 with ``{"ready": false, "reasons": [...]}`` otherwise; while the
+  PR 11 warm-up runs, the reasons carry ``warming (k/n programs)`` and the
+  body a ``warmup`` progress block, so the front door routes around a
+  still-cold replica instead of eating its compile latency.
 - ``GET /metrics``  — JSON counters (PR 2/3 document, unchanged); with
   ``?format=prom`` or a text/plain Accept header, the Prometheus text
   exposition v0.0.4 of the engine's registry (PR 4).

@@ -59,6 +59,24 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # (max-deliveries-exceeded) instead
                                         # of looping through reclaim; <= 0
                                         # disables
+      warmup: false                     # zero cold start (PR 11): true =
+                                        # AOT-compile every (bucket,
+                                        # scales-variant) program at boot
+                                        # (input spec inferred from the
+                                        # topology), or a spec dict
+                                        # {shape: [d0, ...], dtype: <f4,
+                                        # scales: auto|both|off,
+                                        # max_batch: N}.  /readyz reports
+                                        # `warming (k/n programs)` until
+                                        # done; `start --replicas` runs
+                                        # one throwaway pre-warm pass
+                                        # first so replicas boot from the
+                                        # compile cache
+      compile_cache_dir: null           # persistent XLA compilation cache
+                                        # shared by every replica spawn:
+                                        # null = <pidfile>.xla_cache
+                                        # (created by the manager), a
+                                        # path pins it, "off" disables
     autoscaler:                         # closed-loop autoscaling (PR 10),
       slo_p99_ms: 500                   # used with `start --replicas N
       min_replicas: 1                   # --autoscale`; every
@@ -107,6 +125,16 @@ CLI (used by scripts/cluster-serving/*.sh):
         # params.http_port is configured (--prom asks for the Prometheus
         # text exposition), else derive the same JSON document from the
         # health.json snapshot
+    python -m analytics_zoo_tpu.serving.manager warmup [-c config.yaml]
+        # zero cold start (PR 11): one throwaway pass that persists the
+        # deployment's warm state next to the pidfile — the mmap weight
+        # store (<pidfile>.weights, np.load(mmap_mode="r") at every
+        # replica boot, page cache shared host-wide) and the persistent
+        # XLA compilation cache (<pidfile>.xla_cache) covering the whole
+        # (bucket x scales-variant) program set.  `start --replicas` runs
+        # this implicitly when params.warmup is set (skip: --no-prewarm);
+        # every replica spawned after it — including autoscaler
+        # scale-ups — reaches /readyz in seconds with ZERO XLA compiles.
     python -m analytics_zoo_tpu.serving.manager metrics --all-replicas
         [--prom]
         # PR 10: ONE fleet-wide snapshot summed across the per-replica
@@ -183,7 +211,13 @@ def detect_model_type(path: str) -> str:
     raise ValueError(f"cannot autodetect model type for {path}")
 
 
-def load_model(cfg: dict) -> InferenceModel:
+def load_model(cfg: dict,
+               weight_store: Optional[str] = None) -> InferenceModel:
+    """Build the deployment's InferenceModel.  ``weight_store`` (PR 11):
+    when the per-deployment mmap store exists (``manager warmup`` exports
+    it next to the pidfile), zoo weights restore from it —
+    ``np.load(mmap_mode="r")`` per leaf, so N replicas on one host share
+    the page cache instead of each inflating its own `.npz` copy."""
     mcfg = cfg.get("model", {})
     path = mcfg.get("path")
     if not path:
@@ -204,6 +238,10 @@ def load_model(cfg: dict) -> InferenceModel:
         scope: dict = {}
         with open(topo) as f:
             exec(compile(f.read(), topo, "exec"), scope)
+        if weight_store:
+            from analytics_zoo_tpu.inference import weightstore
+            if weightstore.is_store(weight_store):
+                return im.do_load(scope["build_model"], weight_store)
         return im.do_load(scope["build_model"], path)
     raise ValueError(f"unknown model type {mtype!r}")
 
@@ -235,7 +273,9 @@ def serving_params(cfg: dict) -> ServingParams:
 def serve_from_config(config_path: str,
                       tensorboard_dir: Optional[str] = None,
                       replica_id: Optional[str] = None,
-                      http_port_offset: int = 0) -> ClusterServing:
+                      http_port_offset: int = 0,
+                      cache_dir: Optional[str] = None,
+                      weight_store: Optional[str] = None) -> ClusterServing:
     cfg = load_config(config_path)
     params = serving_params(cfg)
     if replica_id is not None:
@@ -246,7 +286,12 @@ def serve_from_config(config_path: str,
         # replicas cannot share one probe port: replica i listens on
         # http_port + i (documented in the module docstring)
         params.http_port += http_port_offset
-    serving = ClusterServing(load_model(cfg), build_queue(cfg),
+    if cache_dir and not params.compile_cache_dir:
+        # the manager's per-deployment cache dir (PR 11); the engine
+        # enables it at start(), before any program compiles
+        params.compile_cache_dir = cache_dir
+    serving = ClusterServing(load_model(cfg, weight_store=weight_store),
+                             build_queue(cfg),
                              params=params,
                              tensorboard_dir=tensorboard_dir)
     return serving
@@ -278,6 +323,27 @@ def _autoscaler_path(pidfile: str) -> str:
     return pidfile + ".autoscaler.json"
 
 
+def _cache_dir(pidfile: str) -> str:
+    """Per-deployment persistent XLA compilation cache (PR 11), created
+    by the manager and shared read/write across every replica spawn of
+    this deployment — the second replica of a topology never compiles."""
+    return pidfile + ".xla_cache"
+
+
+def _weights_dir(pidfile: str) -> str:
+    """Per-deployment mmap'd weight store (PR 11): `manager warmup`
+    persists the params once, every replica boot maps the same pages."""
+    return pidfile + ".weights"
+
+
+def _resolve_cache_dir(params: ServingParams, pidfile: str):
+    """`params.compile_cache_dir`: an explicit path wins, "off" disables,
+    unset defaults to the per-deployment dir next to the pidfile."""
+    if params.compile_cache_dir == "off":
+        return None
+    return params.compile_cache_dir or _cache_dir(pidfile)
+
+
 def _write_health(serving, path: str) -> None:
     """Atomic health snapshot (ClusterServing.health()) next to the pidfile —
     the `status`/`health` CLI actions read it from outside the daemon."""
@@ -294,11 +360,24 @@ def _write_health(serving, path: str) -> None:
 def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
                     http_port_offset: int = 0,
-                    knobs_path: Optional[str] = None):
+                    knobs_path: Optional[str] = None,
+                    base_pidfile: Optional[str] = None):
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
+    # zero cold start (PR 11): every replica of one deployment shares the
+    # BASE pidfile's compile cache + weight store (replica pidfiles are
+    # `<base>.rN`); the cache dir must be live before the model loads so
+    # no compile escapes it
+    base = base_pidfile or pidfile
+    params0 = serving_params(load_config(config_path))
+    cache_dir = _resolve_cache_dir(params0, base)
+    if cache_dir:
+        from analytics_zoo_tpu.inference import aot
+        aot.enable_persistent_cache(cache_dir)
     serving = serve_from_config(config_path, replica_id=replica_id,
-                                http_port_offset=http_port_offset)
+                                http_port_offset=http_port_offset,
+                                cache_dir=cache_dir,
+                                weight_store=_weights_dir(base))
     health_path = _health_path(pidfile)
     if knobs_path is None:
         knobs_path = _knobs_path(pidfile)
@@ -360,9 +439,49 @@ def _run_foreground(config_path: str, pidfile: str,
         time.sleep(1)
 
 
+def _prewarm(config_path: str, pidfile: str,
+             timeout_s: float = 900.0) -> Optional[dict]:
+    """One throwaway warm-up pass BEFORE any replica forks (PR 11): a
+    subprocess (never a fork — the supervisor must stay jax-free so its
+    children fork clean) runs `manager warmup`, which exports the mmap
+    weight store and populates the per-deployment XLA compilation cache.
+    Every replica spawned afterwards — including every future autoscaler
+    scale-up — loads executables from disk instead of compiling.  Failure
+    is logged, not fatal: replicas fall back to compiling for themselves."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+             "warmup", "-c", config_path, "--pidfile", pidfile],
+            capture_output=True, text=True, timeout=timeout_s)
+        doc = None
+        for line in (out.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    pass
+        if out.returncode != 0:
+            print(json.dumps({"event": "prewarm failed",
+                              "rc": out.returncode,
+                              "stderr": (out.stderr or "")[-500:]}),
+                  file=sys.stderr, flush=True)
+            return None
+        print(json.dumps({"event": "prewarm done", "warmup": doc}),
+              file=sys.stderr, flush=True)
+        return doc
+    except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+        print(json.dumps({"event": "prewarm failed",
+                          "error": f"{type(e).__name__}: {e}"}),
+              file=sys.stderr, flush=True)
+        return None
+
+
 def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                     autoscale: bool = False,
-                    lb_port: Optional[int] = None):
+                    lb_port: Optional[int] = None,
+                    prewarm: bool = True):
     """Replica supervisor (PR 5 tentpole): fork one serving process per
     replica over the SHARED queue, monitor them, respawn crashed ones (a
     SIGKILLed replica's orphaned records are reclaimed by the survivors
@@ -390,6 +509,13 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
 
     cfg = load_config(config_path)
     params = serving_params(cfg)
+    if prewarm and params.warmup and \
+            _resolve_cache_dir(params, pidfile):
+        # pre-populate the deployment's compile cache + weight store so
+        # the replicas about to fork (and every scale-up after them) boot
+        # warm.  The fleet takes traffic a few seconds later but each
+        # member reaches /readyz in seconds instead of a compile.
+        _prewarm(config_path, pidfile)
     scaler = None
     balancer = None
     if autoscale:
@@ -422,7 +548,8 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                 _run_foreground(config_path, _replica_pidfile(pidfile, index),
                                 replica_id=f"replica-{index}",
                                 http_port_offset=index,
-                                knobs_path=_knobs_path(pidfile))
+                                knobs_path=_knobs_path(pidfile),
+                                base_pidfile=pidfile)
             finally:
                 os._exit(0)
         children[index] = pid
@@ -520,7 +647,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
-                             "replay", "metrics", "scale"])
+                             "replay", "metrics", "scale", "warmup"])
     ap.add_argument("value", nargs="?", default=None,
                     help="scale: target replica count")
     ap.add_argument("-c", "--config", default="config.yaml")
@@ -551,6 +678,10 @@ def main(argv=None):
     ap.add_argument("--prom", action="store_true",
                     help="metrics: print the Prometheus text exposition "
                          "(requires params.http_port on the daemon)")
+    ap.add_argument("--no-prewarm", action="store_true",
+                    help="start --replicas: skip the supervisor's "
+                         "throwaway warm-up pass (replicas then compile "
+                         "for themselves on first boot)")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -574,6 +705,48 @@ def main(argv=None):
         except (OSError, ValueError):
             return None
 
+    if args.action == "warmup":
+        # zero cold start (PR 11): one throwaway pass that persists the
+        # deployment's warm state — the mmap weight store and the
+        # persistent XLA compilation cache, both next to the pidfile — so
+        # every replica spawned after it boots warm.  Run standalone at
+        # deploy time, or implicitly by `start --replicas` (the
+        # supervisor's pre-warm subprocess IS this action).
+        from analytics_zoo_tpu.inference import aot, weightstore
+        cfg = load_config(args.config)
+        params = serving_params(cfg)
+        cache_dir = _resolve_cache_dir(params, args.pidfile)
+        if cache_dir:
+            aot.enable_persistent_cache(cache_dir)
+        store = _weights_dir(args.pidfile)
+        im = load_model(cfg, weight_store=store)
+        exported = False
+        if getattr(im, "_params", None):
+            try:
+                man = weightstore.save_store(
+                    store, {"params": im._params,
+                            "state": im._state or {}})
+                exported = not man.get("skipped", False)
+            except Exception as e:  # noqa: BLE001 — store is an optim,
+                # not a correctness requirement
+                print(json.dumps({"warning": f"weight store export "
+                                             f"failed ({type(e).__name__}"
+                                             f": {e})"}), file=sys.stderr)
+                store = None
+        else:
+            store = None
+        if params.sharding != "off":
+            # warm the DEPLOYED placement: the replicas shard at
+            # construction, so an unsharded warm-up would compile the
+            # wrong programs
+            im.shard(mesh=params.mesh_shape, sharding=params.sharding)
+        stats = aot.warm_up(im, aot.resolve_manifest(
+            im, params.warmup if params.warmup else True))
+        print(json.dumps({"cache_dir": cache_dir, "weight_store": store,
+                          "store_exported": exported,
+                          "load_seconds": im.load_seconds,
+                          "load_mmap": im.load_mmap, **stats}))
+        return 0 if stats["failed"] == 0 else 1
     if args.action == "metrics":
         # live metrics snapshot (PR 4).  Preferred source: the daemon's own
         # /metrics endpoint (exactly what a scraper sees, including
@@ -708,6 +881,7 @@ def main(argv=None):
             except (OSError, ValueError):
                 desired = 0
             replicas = {}
+            warming = 0
             for i in range(desired):
                 rp = _replica_pidfile(args.pidfile, i)
                 try:
@@ -715,10 +889,33 @@ def main(argv=None):
                         rpid = int(f.read().strip())
                 except (OSError, ValueError):
                     rpid = None
-                replicas[f"r{i}"] = {
-                    "pid": rpid,
-                    "alive": rpid is not None and alive(rpid)}
-            out["replicas"] = {"desired": desired, "members": replicas}
+                member = {"pid": rpid,
+                          "alive": rpid is not None and alive(rpid)}
+                # zero cold start (PR 11): per-replica warm-up state off
+                # the health snapshot, so an operator can see WHY a fresh
+                # replica is not taking traffic yet (warming k/n) without
+                # curling its probe port
+                try:
+                    with open(_health_path(rp)) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    doc = None
+                if isinstance(doc, dict):
+                    w = doc.get("warmup") or {}
+                    if w.get("state") and w["state"] != "off":
+                        member["warmup"] = {
+                            k: w.get(k)
+                            for k in ("state", "compiled", "total",
+                                      "seconds")}
+                        if w["state"] in ("pending", "warming"):
+                            warming += 1
+                    member["ready"] = bool(
+                        (doc.get("ready") or {}).get("ready"))
+                    if doc.get("cold_start_s") is not None:
+                        member["cold_start_s"] = doc["cold_start_s"]
+                replicas[f"r{i}"] = member
+            out["replicas"] = {"desired": desired, "warming": warming,
+                               "members": replicas}
         health = read_health()
         if health is not None:
             out["health"] = health
@@ -782,13 +979,15 @@ def main(argv=None):
             return 1
         if args.foreground:
             _run_supervisor(args.config, args.pidfile, args.replicas,
-                            autoscale=args.autoscale, lb_port=args.lb_port)
+                            autoscale=args.autoscale, lb_port=args.lb_port,
+                            prewarm=not args.no_prewarm)
             return 0
         pid = os.fork()
         if pid == 0:                       # child: detach and supervise
             os.setsid()
             _run_supervisor(args.config, args.pidfile, args.replicas,
-                            autoscale=args.autoscale, lb_port=args.lb_port)
+                            autoscale=args.autoscale, lb_port=args.lb_port,
+                            prewarm=not args.no_prewarm)
             return 0
         print(json.dumps({"started": True, "pid": pid,
                           "replicas": args.replicas}))

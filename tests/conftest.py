@@ -58,6 +58,12 @@ def pytest_configure(config):
         "fleets, front-door sockets; the chaos A/B additionally carries "
         "`slow` because it spawns live replica subprocesses); default "
         "300 s SIGALRM budget so a wedged fleet cannot stall tier-1")
+    config.addinivalue_line(
+        "markers",
+        "coldstart: zero-cold-start tests (AOT warm-up, persistent XLA "
+        "compilation cache, mmap weight store); the spawn-twice test "
+        "forks fresh interpreters that re-import jax and compile, so "
+        "they carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -69,6 +75,7 @@ REPLICAS_DEFAULT_TIMEOUT_S = 300.0
 MULTICHIP_DEFAULT_TIMEOUT_S = 300.0
 WIRE_DEFAULT_TIMEOUT_S = 120.0
 AUTOSCALE_DEFAULT_TIMEOUT_S = 300.0
+COLDSTART_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -92,6 +99,8 @@ def pytest_runtest_call(item):
             seconds = WIRE_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("autoscale") is not None:
             seconds = AUTOSCALE_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("coldstart") is not None:
+            seconds = COLDSTART_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

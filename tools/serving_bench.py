@@ -277,6 +277,161 @@ def _run_once(im, args, batch_size):
     return out
 
 
+# -- zero-cold-start A/B (PR 11) ----------------------------------------------
+
+def _cold_start_child(args):
+    """One replica boot, measured: attach the per-deployment compile
+    cache + weight store, load the model (mmap on the second boot), start
+    a warmup-enabled engine over the shared FileQueue — where the parent
+    already parked one record — and stamp spawn-to-first-result.  Prints
+    a JSON stats line the parent diffs cold-vs-warm.
+
+    Interpreter + module import wall is reported separately
+    (``import_seconds``; the parent's ``spawn_wall_seconds`` covers the
+    whole process): it is byte-identical on the cold and warm sides, so
+    folding it into ``cold_start_seconds`` would only dilute the quantity
+    the A/B exists to measure — the boot work the cache and the weight
+    store actually remove."""
+    t_imp = time.monotonic()
+    from analytics_zoo_tpu.inference import aot, weightstore
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    import_seconds = time.monotonic() - t_imp
+    t0 = time.monotonic()
+    root = args.cold_dir
+    aot.enable_persistent_cache(os.path.join(root, "xla_cache"))
+    store = os.path.join(root, "weights")
+
+    def build():
+        # a serving-sized classifier (~5.3M params, 25 MB of weights over
+        # a 3072-d record): the boot cost profile of a real deployment —
+        # per-bucket compiles in the 100s-of-ms and a weight file the
+        # mmap store meaningfully avoids re-copying — without a conv
+        # stack that this CPU container would compile for minutes
+        from analytics_zoo_tpu.nn import Sequential
+        from analytics_zoo_tpu.nn.layers import Dense
+        m = Sequential()
+        m.add(Dense(1024, activation="relu", input_shape=(3072,)))
+        m.add(Dense(1024, activation="relu"))
+        m.add(Dense(1000, activation="softmax"))
+        return m
+
+    im = InferenceModel(max_batch=args.cold_max_batch)
+    if weightstore.is_store(store):
+        im.do_load_store(build, store)
+    else:
+        # first boot of the deployment: load normally and persist the
+        # store for every boot after (exactly the manager warmup flow)
+        model = build()
+        model.init_weights()
+        im.do_load_model(model, model._params, model._state)
+        im.load_seconds = time.monotonic() - t0
+        weightstore.save_store(store, {"params": im._params,
+                                       "state": im._state or {}})
+    queue = FileQueue(os.path.join(root, "queue"))
+    serving = ClusterServing(im, queue, params=ServingParams(
+        batch_size=4, max_batch=args.cold_max_batch,
+        warmup={"shape": [3072], "max_batch": args.cold_max_batch},
+        poll_timeout_s=0.02, trim_interval_s=3600.0))
+    serving.start()
+    uri = args.cold_uri
+    t_result = None
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if t_result is None and queue.get_result(uri) is not None:
+            t_result = time.monotonic()
+        if t_result is not None and serving.warmup_state()["state"] not in (
+                "pending", "warming"):
+            break
+        time.sleep(0.01)
+    warm_state = serving.warmup_state()
+    serving.shutdown()
+    stats = aot.COMPILE_STATS.snapshot()
+    print(json.dumps({
+        "cold_start_seconds": (None if t_result is None
+                               else round(t_result - t0, 3)),
+        "import_seconds": round(import_seconds, 3),
+        "load_seconds": round(im.load_seconds or 0.0, 3),
+        "load_mmap": im.load_mmap,
+        "warmup_state": warm_state.get("state"),
+        "warmup_programs": warm_state.get("total"),
+        "warmup_seconds": warm_state.get("seconds"),
+        "compile_cache_hits": stats["cache_hits"],
+        "compile_cache_misses": stats["cache_misses"],
+        "compile_seconds": stats["compile_seconds"],
+    }), flush=True)
+    return 0
+
+
+def _run_cold_start(args):
+    """The PR 11 acceptance A/B: spawn the SAME replica boot twice against
+    one per-deployment state dir — the first pays every XLA compile and
+    exports the weight store (cold), the second restores mmap'd weights
+    and loads every executable from the persistent cache (warm).  Each
+    boot races against one already-queued record, so `cold_start_seconds`
+    is spawn-to-first-result under a waiting backlog.  The warm boot must
+    show compile_cache_misses == 0: zero XLA compiles."""
+    import subprocess
+
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    root = tempfile.mkdtemp(prefix="serving_coldstart_")
+    queue = FileQueue(os.path.join(root, "queue"))
+    cin = InputQueue(queue)
+    g = np.random.default_rng(0)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    results = []
+    for run, label in ((0, "cold"), (1, "warm")):
+        uri = f"cold-{run}"
+        cin.enqueue_tensor(uri, g.random(3072, np.float32))
+        t0 = time.monotonic()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cold-start-child", "--cold-dir", root, "--cold-uri", uri,
+             "--cold-max-batch", str(args.cold_max_batch)],
+            capture_output=True, text=True, env=env, timeout=600)
+        wall = time.monotonic() - t0
+        doc = None
+        for line in (out.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    pass
+        if out.returncode != 0 or doc is None:
+            raise RuntimeError(
+                f"{label} child failed (rc {out.returncode}): "
+                f"{(out.stderr or '')[-800:]}")
+        doc["run"] = label
+        # includes interpreter + jax import, identical on both sides —
+        # reported for honesty, judged on cold_start_seconds
+        doc["spawn_wall_seconds"] = round(wall, 3)
+        results.append(doc)
+        print(json.dumps(doc))
+    cold, warm = results
+    doc = {
+        "profile": "cold-start",
+        "cold_max_batch": args.cold_max_batch,
+        "cold": cold, "warm": warm,
+        "cold_start_seconds": warm["cold_start_seconds"],
+        "compile_cache_hits": warm["compile_cache_hits"],
+        "speedup": (round(cold["cold_start_seconds"]
+                          / warm["cold_start_seconds"], 2)
+                    if cold["cold_start_seconds"]
+                    and warm["cold_start_seconds"] else None),
+        "warm_zero_compiles": warm["compile_cache_misses"] == 0,
+    }
+    assert warm["compile_cache_misses"] == 0, \
+        f"warm boot compiled: {warm['compile_cache_misses']} cache misses"
+    assert warm["load_mmap"], "warm boot did not restore via the mmap store"
+    return doc
+
+
 # -- elastic-serving load-swing A/B (PR 10) -----------------------------------
 
 def _swing_model(max_batch):
@@ -709,6 +864,21 @@ def main(argv=None):
     ap.add_argument("--drain-timeout-s", type=float, default=60.0,
                     help="swing: post-profile wait for every record to "
                          "resolve")
+    # PR 11 zero-cold-start A/B
+    ap.add_argument("--cold-start", action="store_true",
+                    help="spawn the same replica boot twice against one "
+                         "per-deployment state dir: cold (every compile "
+                         "paid, weight store exported) vs warm (mmap'd "
+                         "weights + persistent-cache executables, ZERO "
+                         "XLA compiles).  cold_start_seconds is spawn-to-"
+                         "first-result with a record already queued")
+    ap.add_argument("--cold-start-child", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: one measured boot
+    ap.add_argument("--cold-dir", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--cold-uri", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--cold-max-batch", type=int, default=8,
+                    help="cold-start: model bucket ceiling — the warm-up "
+                         "set is every (bucket, scales) program up to it")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -732,6 +902,23 @@ def main(argv=None):
                          "plane, the regime serving actually runs in on "
                          "TPU")
     args = ap.parse_args(argv)
+
+    if args.cold_start_child:
+        return _cold_start_child(args)
+    if args.cold_start:
+        out = _run_cold_start(args)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("cold", "warm")}))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
 
     if args.load_profile == "swing":
         # the elastic-serving A/B is self-contained: tiny fixed model,
