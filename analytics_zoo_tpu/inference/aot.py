@@ -244,6 +244,46 @@ def warmup_manifest(model, input_shape=None, dtype: str = "<f4",
     return entries
 
 
+class GenWarmupEntry(NamedTuple):
+    """One program of a generation deployment's warm-up set (PR 12
+    continuous batching): the scheduler runs one ``prefill`` program per
+    (admission-batch, prompt-bucket, lane), one ``insert`` per
+    (admission-batch, lane), and one ``decode_step`` per lane — the
+    (prefill-bucket x decode-step) set a warm replica must hold to serve
+    its first token with zero compiles."""
+
+    kind: str                        # prefill | decode_step | insert
+    prefill_bucket: Optional[int]    # prompt padding bucket (prefill only)
+    lane_bucket: int                 # decode lane capacity bucket
+    prefill_batch: Optional[int] = None   # admission batch bucket (pow-2)
+
+
+def generation_manifest(prefill_buckets: Sequence[int],
+                        lane_buckets: Sequence[int],
+                        prefill_batches: Sequence[int] = (1,),
+                        cache_model: bool = True
+                        ) -> List[GenWarmupEntry]:
+    """Enumerate the continuous-batching program set: for every decode
+    lane, its step program, plus — per admission-batch bucket — one
+    insert program and one prefill program per prompt bucket.  The ONE
+    enumeration shared by ``ContinuousBatcher.warm`` and the serving
+    warm-up manifest, so the pre-warm pass compiles exactly the set the
+    scheduler will look up.  ``cache_model=True`` keeps only prompt
+    buckets that fit the lane (prefill allocates the KV cache at lane
+    capacity, so bigger prompts can never run there); bare-state models
+    (lane capacity is not a prompt bound — the scheduler pads any
+    admissible prompt to any bucket of the ladder) keep them all."""
+    entries: List[GenWarmupEntry] = []
+    for lane in sorted({int(b) for b in lane_buckets}):
+        entries.append(GenWarmupEntry("decode_step", None, lane))
+        for bb in sorted({int(b) for b in prefill_batches}):
+            entries.append(GenWarmupEntry("insert", None, lane, bb))
+            for pb in sorted({int(b) for b in prefill_buckets}):
+                if pb <= lane or not cache_model:
+                    entries.append(GenWarmupEntry("prefill", pb, lane, bb))
+    return entries
+
+
 def resolve_manifest(model, warmup_spec) -> List[WarmupEntry]:
     """Manifest from a ``ServingParams.warmup`` value: ``True`` derives
     everything from the model, a spec dict ``{"shape", "dtype", "scales",

@@ -8,6 +8,25 @@ runs the greedy decode loop.
 
 TPU-native: both rollouts are lax.scan programs; greedy decode is a scan carrying
 (states, token) so inference jits to a single XLA while-style program.
+
+Step-wise decode (PR 12 continuous batching): the monolithic greedy scan is
+refactored over two primitives the serving scheduler drives one token at a
+time —
+
+- ``init_decode(params, enc_in, lengths=None) -> DecodeState``: encoder +
+  bridge.  ``lengths`` (per-row true prompt length) masks the encoder scan
+  so a right-PADDED prompt batch produces byte-identical states to the
+  unpadded prompts — the scheduler pads every prompt to a pow-2 bucket, so
+  one compiled program serves any prompt length in the bucket.
+- ``decode_step(params, state, tokens) -> (logits, state)``: one decoder
+  step for the whole slot batch.  ``state`` is a pytree whose every leaf has
+  a leading batch (slot) axis, so the scheduler can insert/evict individual
+  requests with ``.at[slot].set`` without retracing.
+
+``infer`` now runs the SAME primitives under one ``lax.scan`` (numerics
+unchanged) and honors EOS: tokens after a row's ``stop_sign`` are frozen to
+``stop_sign`` and ``return_lengths=True`` yields per-row generated lengths,
+so callers can truncate without re-scanning the output on host.
 """
 
 from __future__ import annotations
@@ -177,30 +196,98 @@ class Seq2seq(KerasNet):
         logits = self._project(params, jnp.swapaxes(tops, 0, 1))
         return jax.nn.softmax(logits, axis=-1)
 
+    # -- step-wise decode API (PR 12 continuous batching) ---------------------
+    def init_decode(self, params, enc_in, lengths=None):
+        """Run encoder + bridge for a (possibly right-padded) prompt batch
+        and return the decoder's initial ``DecodeState`` — a list of per-
+        layer ``(h, c)`` pairs, every leaf ``(B, H)``.  ``lengths`` (B,)
+        gives each row's true prompt length: encoder steps at ``t >=
+        length`` keep the previous state, so padding a prompt to a bucket
+        does not perturb its states (without it, zero-padded steps would
+        keep updating the LSTM).  The masked program computes the same math
+        as the unmasked one but fuses differently — expect ~1-ulp float
+        drift against ``lengths=None``; WITHIN one program, rows are
+        independent, which is what the scheduler's bitwise-isolation
+        contract rests on.  ``lengths=None`` = all rows full-length (the
+        monolithic ``infer``/``call`` encoder, bit-for-bit)."""
+        enc_in = jnp.asarray(enc_in)
+        if enc_in.ndim == 3 and enc_in.shape[-1] == 1:
+            enc_in = enc_in[..., 0]
+        if lengths is None:
+            states = self._encode(params, enc_in)
+        else:
+            lengths = jnp.asarray(lengths, jnp.int32)
+            xs = jnp.swapaxes(self._embed(params, enc_in), 0, 1)
+            states0 = _LSTMCellStack.zero_states(enc_in.shape[0],
+                                                 self.hidden_sizes)
+
+            def body(carry, xt):
+                states, t = carry
+                x_t = xt
+                new_states, _ = _LSTMCellStack.step(
+                    params["encoder"], states, x_t)
+                keep = (t < lengths)[:, None]   # (B, 1): row still in prompt
+                merged = [
+                    (jnp.where(keep, hn, h), jnp.where(keep, cn, c))
+                    for (hn, cn), (h, c) in zip(new_states, states)]
+                return (merged, t + 1), 0.0
+
+            (states, _), _ = jax.lax.scan(
+                body, (states0, jnp.zeros((), jnp.int32)), xs)
+        return self._bridge(params, states)
+
+    def decode_step(self, params, state, tokens):
+        """One greedy-decode step for the whole slot batch: embed
+        ``tokens`` (B,), step the decoder stack, project to vocab logits.
+        Returns ``(logits (B, V), new_state)``."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        emb = jnp.take(params["embed"], tokens, axis=0)
+        new_state, top = _LSTMCellStack.step(params["decoder"], state, emb)
+        logits = self._project(params, top)
+        return logits, new_state
+
     # -- greedy inference (Seq2seq.scala infer) -------------------------------
     def infer(self, params, enc_in, start_sign: int, max_seq_len: int = 30,
-              stop_sign: Optional[int] = None):
+              stop_sign: Optional[int] = None, return_lengths: bool = False):
+        """Greedy decode.  With ``stop_sign`` the scan tracks a per-row
+        done mask: tokens emitted after a row hits ``stop_sign`` are frozen
+        to ``stop_sign`` (the old scan kept decoding garbage for the full
+        ``max_seq_len``).  ``return_lengths=True`` returns ``(tokens,
+        lengths)`` where ``lengths`` counts each row's tokens BEFORE its
+        stop sign (``max_seq_len`` when it never stopped) — the callers'
+        (and the continuous-batching scheduler's) truncation signal."""
         enc_in = jnp.asarray(enc_in)
         if enc_in.ndim == 3 and enc_in.shape[-1] == 1:
             enc_in = enc_in[..., 0]
         B = enc_in.shape[0]
-        states = self._bridge(params, self._encode(params, enc_in))
+        states = self.init_decode(params, enc_in)
         tok0 = jnp.full((B,), start_sign, jnp.int32)
+        done0 = jnp.zeros((B,), bool)
+        stop = -1 if stop_sign is None else int(stop_sign)
 
         def body(carry, _):
-            st, tok = carry
-            emb = jnp.take(params["embed"], tok, axis=0)
-            new_st, top = _LSTMCellStack.step(params["decoder"], st, emb)
-            logits = self._project(params, top)
+            st, tok, done = carry
+            logits, new_st = self.decode_step(params, st, tok)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (new_st, nxt), nxt
+            if stop_sign is not None:
+                nxt = jnp.where(done, jnp.int32(stop), nxt)
+            new_done = done | (nxt == stop)
+            # a finished row's state stays frozen: its (ignored) outputs
+            # must not drift if a caller keeps stepping past EOS
+            keep = (~done)[:, None]
+            merged = [(jnp.where(keep, hn, h), jnp.where(keep, cn, c))
+                      for (hn, cn), (h, c) in zip(new_st, st)]
+            return (merged, nxt, new_done), (nxt, new_done)
 
-        _, toks = jax.lax.scan(body, (states, tok0), None, length=max_seq_len)
+        _, (toks, dones) = jax.lax.scan(body, (states, tok0, done0), None,
+                                        length=max_seq_len)
         out = np.asarray(jnp.swapaxes(toks, 0, 1))
-        if stop_sign is not None:
-            trimmed = []
-            for row in out:
-                stops = np.where(row == stop_sign)[0]
-                trimmed.append(row[:stops[0]] if len(stops) else row)
-            return trimmed
+        # generated length = tokens before the first stop sign (the stop
+        # itself is not a content token); rows that never stopped run full
+        done_steps = np.asarray(jnp.sum(dones, axis=0))   # (B,)
+        lengths = (max_seq_len - done_steps).astype(np.int64)
+        if stop_sign is not None and not return_lengths:
+            return [row[:n] for row, n in zip(out, lengths)]
+        if return_lengths:
+            return out, lengths
         return out

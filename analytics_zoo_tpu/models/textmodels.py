@@ -9,6 +9,12 @@ real linear-chain CRF (nn/layers/crf.py) rather than a wrapped dependency.
 Input conventions match the reference:
   NER / SequenceTagger: [word_ids (B, T), char_ids (B, T, W)]
   IntentEntity:         [word_ids (B, T), char_ids (B, T, W)]
+
+PR 12 (continuous batching) adds ``TransformerLM`` — a decoder-only
+autoregressive generator with a step-wise decode API: ``init_decode``
+prefills a FIXED-LENGTH KV cache from a (right-padded) prompt batch and
+``decode_step`` appends one token per call, so the serving scheduler can
+step a churning slot batch through one compiled program per cache bucket.
 """
 
 from __future__ import annotations
@@ -123,6 +129,263 @@ class _TaggerModel(Layer):
                      jnp.broadcast_to(cp["start"], (B,) + cp["start"].shape),
                      jnp.broadcast_to(cp["end"], (B,) + cp["end"].shape)]
         return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+class TransformerLM(Layer):
+    """Decoder-only transformer language model with a KV-cache step API
+    (the GPT-style generator the serving plane's continuous batcher
+    drives).  Pre-LN blocks, learned positional embeddings, weight-tied
+    output head.
+
+    Monolithic paths: ``call(params, ids)`` -> (B, T, V) logits (teacher
+    forcing / training), ``generate`` -> one ``lax.scan`` greedy rollout
+    (the batch-in/batch-out baseline).  Step-wise paths (PR 12):
+
+    - ``init_decode(params, prompt, lengths, cache_len) -> (state,
+      logits0)``: prefill.  ``prompt`` (B, P) is right-padded; ``lengths``
+      (B,) true lengths.  The per-layer K/V caches are allocated at
+      ``cache_len`` (>= P, the pow-2 capacity bucket) so every later
+      ``decode_step`` runs one fixed-shape program; ``logits0`` is each
+      row's next-token logits at its last REAL prompt position.
+    - ``decode_step(params, state, tokens) -> (logits, state)``: write the
+      token's K/V at each row's own cursor (``state["pos"]``), attend over
+      the cache positions written so far, advance the cursor.  Every state
+      leaf keeps a leading batch (slot) axis for ``.at[slot].set``
+      insertion."""
+
+    def __init__(self, vocab_size: int, hidden: int = 64, n_head: int = 4,
+                 n_layers: int = 2, max_len: int = 512,
+                 initializer_range: float = 0.02, **kwargs):
+        super().__init__(**kwargs)
+        if hidden % n_head:
+            raise ValueError(f"hidden={hidden} not divisible by "
+                             f"n_head={n_head}")
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.n_head = int(n_head)
+        self.n_layers = int(n_layers)
+        self.max_len = int(max_len)
+        self.std = float(initializer_range)
+        self._declared_input_shape = (None,)
+
+    def build(self, rng, input_shape=None):
+        H, V = self.hidden, self.vocab_size
+        r = jax.random.split(rng, 2 + 4 * self.n_layers)
+        std = self.std
+
+        def dense(key, d_in, d_out):
+            return {"W": std * jax.random.normal(key, (d_in, d_out),
+                                                 jnp.float32),
+                    "b": jnp.zeros((d_out,), jnp.float32)}
+
+        p = {"embed": std * jax.random.normal(r[0], (V, H), jnp.float32),
+             "pos": std * jax.random.normal(r[1], (self.max_len, H),
+                                            jnp.float32),
+             "ln_f": {"g": jnp.ones((H,), jnp.float32),
+                      "b": jnp.zeros((H,), jnp.float32)},
+             "blocks": []}
+        for i in range(self.n_layers):
+            k = r[2 + 4 * i: 6 + 4 * i]
+            p["blocks"].append({
+                "ln1": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))},
+                "qkv": dense(k[0], H, 3 * H),
+                "proj": dense(k[1], H, H),
+                "ln2": {"g": jnp.ones((H,)), "b": jnp.zeros((H,))},
+                "fc1": dense(k[2], H, 4 * H),
+                "fc2": dense(k[3], 4 * H, H)})
+        return p
+
+    # -- shared pieces --------------------------------------------------------
+    @staticmethod
+    def _ln(p, x, eps=1e-5):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+    @staticmethod
+    def _lin(p, x):
+        return jnp.matmul(x, p["W"],
+                          preferred_element_type=jnp.float32) + p["b"]
+
+    def _heads(self, x):
+        # (..., H) -> (..., n_head, head_dim)
+        return x.reshape(x.shape[:-1] + (self.n_head,
+                                         self.hidden // self.n_head))
+
+    def _logits(self, params, h):
+        # weight-tied head: logits = h @ embed.T
+        return jnp.matmul(h, params["embed"].T,
+                          preferred_element_type=jnp.float32)
+
+    # -- monolithic forward (teacher forcing / training) ----------------------
+    def call(self, params, inputs, *, training=False, rng=None):
+        ids = jnp.asarray(inputs)
+        if ids.ndim == 3 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        ids = ids.astype(jnp.int32)
+        B, T = ids.shape
+        x = jnp.take(params["embed"], ids, axis=0) + params["pos"][:T]
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        for blk in params["blocks"]:
+            h = self._ln(blk["ln1"], x)
+            qkv = self._lin(blk["qkv"], h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q, k, v = self._heads(q), self._heads(k), self._heads(v)
+            scale = 1.0 / np.sqrt(q.shape[-1])
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            att = jnp.where(causal[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+            x = x + self._lin(blk["proj"],
+                              o.reshape(B, T, self.hidden))
+            h = self._ln(blk["ln2"], x)
+            x = x + self._lin(blk["fc2"],
+                              jax.nn.gelu(self._lin(blk["fc1"], h)))
+        return self._logits(params, self._ln(params["ln_f"], x))
+
+    # -- step-wise decode (PR 12) ---------------------------------------------
+    def init_decode(self, params, prompt, lengths=None,
+                    cache_len: Optional[int] = None):
+        """Prefill: run the prompt through the stack once, parking K/V in
+        ``cache_len``-capacity caches.  Padded positions (>= the row's
+        length) are masked out of attention and overwritten later by
+        generated tokens — the cache layout stays gap-free because the
+        cursor starts AT the row's length."""
+        prompt = jnp.asarray(prompt)
+        if prompt.ndim == 3 and prompt.shape[-1] == 1:
+            prompt = prompt[..., 0]
+        prompt = prompt.astype(jnp.int32)
+        B, P = prompt.shape
+        C = int(cache_len) if cache_len is not None else int(P)
+        if C < P:
+            raise ValueError(f"cache_len={C} < prompt bucket {P}")
+        if C > self.max_len:
+            raise ValueError(f"cache_len={C} > max_len={self.max_len}")
+        lengths = (jnp.full((B,), P, jnp.int32) if lengths is None
+                   else jnp.asarray(lengths, jnp.int32))
+        nh, hd = self.n_head, self.hidden // self.n_head
+        x = jnp.take(params["embed"], prompt, axis=0) + params["pos"][:P]
+        pos_idx = jnp.arange(P)
+        # causal within the prompt AND key < row length (padding masked)
+        mask = (pos_idx[None, :, None] >= pos_idx[None, None, :]) \
+            & (pos_idx[None, None, :] < lengths[:, None, None])  # (B,P,P)
+        state = {"pos": lengths,
+                 "k": [], "v": []}
+        for blk in params["blocks"]:
+            h = self._ln(blk["ln1"], x)
+            q, k, v = jnp.split(self._lin(blk["qkv"], h), 3, axis=-1)
+            q, k, v = self._heads(q), self._heads(k), self._heads(v)
+            scale = 1.0 / np.sqrt(hd)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            att = jnp.where(mask[:, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+            x = x + self._lin(blk["proj"], o.reshape(B, P, self.hidden))
+            h2 = self._ln(blk["ln2"], x)
+            x = x + self._lin(blk["fc2"],
+                              jax.nn.gelu(self._lin(blk["fc1"], h2)))
+            kc = jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(k)
+            vc = jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(v)
+            state["k"].append(kc)
+            state["v"].append(vc)
+        h = self._ln(params["ln_f"], x)
+        # each row's next-token logits live at its LAST REAL position
+        last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+        return state, self._logits(params, last)
+
+    def decode_step(self, params, state, tokens):
+        """One token for every row: write K/V at the row cursor, attend
+        over the written prefix, advance.  (B,)-shaped ``tokens`` in,
+        ``(logits (B, V), new_state)`` out — one fixed-shape program per
+        cache bucket, no retracing as rows churn."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        pos = state["pos"]                         # (B,) cursor
+        B = tokens.shape[0]
+        C = state["k"][0].shape[1]
+        nh, hd = self.n_head, self.hidden // self.n_head
+        rows = jnp.arange(B)
+        # clamp the cursor so a full cache row keeps overwriting its last
+        # slot instead of indexing out of bounds (the scheduler retires
+        # rows at capacity; this is the belt under that suspender)
+        wpos = jnp.minimum(pos, C - 1)
+        x = jnp.take(params["embed"], tokens, axis=0) \
+            + jnp.take(params["pos"], jnp.minimum(pos, self.max_len - 1),
+                       axis=0)                     # (B, H)
+        new_k, new_v = [], []
+        key_idx = jnp.arange(C)
+        for li, blk in enumerate(params["blocks"]):
+            h = self._ln(blk["ln1"], x)
+            q, k, v = jnp.split(self._lin(blk["qkv"], h), 3, axis=-1)
+            q, k, v = self._heads(q), self._heads(k), self._heads(v)
+            kc = state["k"][li].at[rows, wpos].set(k)
+            vc = state["v"][li].at[rows, wpos].set(v)
+            scale = 1.0 / np.sqrt(hd)
+            att = jnp.einsum("bhd,bkhd->bhk", q, kc) * scale
+            valid = key_idx[None] <= wpos[:, None]          # (B, C)
+            att = jnp.where(valid[:, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhk,bkhd->bhd", att, vc)
+            x = x + self._lin(blk["proj"], o.reshape(B, self.hidden))
+            h2 = self._ln(blk["ln2"], x)
+            x = x + self._lin(blk["fc2"],
+                              jax.nn.gelu(self._lin(blk["fc1"], h2)))
+            new_k.append(kc)
+            new_v.append(vc)
+        logits = self._logits(params, self._ln(params["ln_f"], x))
+        return logits, {"pos": pos + 1, "k": new_k, "v": new_v}
+
+    # -- monolithic greedy rollout (batch-in/batch-out baseline) --------------
+    def generate(self, params, prompt, max_tokens: int = 32,
+                 eos_id: Optional[int] = None, lengths=None,
+                 return_lengths: bool = False):
+        """Greedy decode under ONE ``lax.scan`` — the static-batching
+        baseline the bench A/Bs against: the whole batch holds until the
+        slowest row has run all ``max_tokens`` steps.  Same EOS contract
+        as ``Seq2seq.infer``: post-EOS tokens freeze to ``eos_id`` and
+        ``return_lengths`` yields per-row generated lengths."""
+        prompt = np.asarray(prompt)
+        B, P = prompt.shape
+        # the KV cache cannot outgrow max_len: clamp the budget to the
+        # remaining capacity instead of silently overwriting the last
+        # slot for every overflow token (decode_step's cursor clamp is a
+        # belt for the serving scheduler, not a rollout contract)
+        room = self.max_len - P
+        if room < 1:
+            raise ValueError(f"prompt length {P} leaves no decode room "
+                             f"(max_len={self.max_len})")
+        max_tokens = min(int(max_tokens), room)
+        cap = P + max_tokens
+        state, logits0 = self.init_decode(params, prompt, lengths=lengths,
+                                          cache_len=cap)
+        tok0 = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+        stop = -1 if eos_id is None else int(eos_id)
+        done0 = (tok0 == stop)
+
+        def body(carry, _):
+            st, tok, done = carry
+            logits, new_st = self.decode_step(params, st, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.int32(stop), nxt)
+            return (new_st, nxt, done | (nxt == stop)), (nxt, done | (nxt == stop))
+
+        steps = max(int(max_tokens) - 1, 0)
+        if steps:
+            _, (toks, dones) = jax.lax.scan(body, (state, tok0, done0),
+                                            None, length=steps)
+            out = np.concatenate([np.asarray(tok0)[:, None],
+                                  np.asarray(jnp.swapaxes(toks, 0, 1))],
+                                 axis=1)
+            done_steps = np.asarray(jnp.sum(dones, axis=0)) \
+                + np.asarray(done0).astype(np.int64)
+        else:
+            out = np.asarray(tok0)[:, None]
+            done_steps = np.asarray(done0).astype(np.int64)
+        lengths_out = (int(max_tokens) - done_steps).astype(np.int64)
+        if return_lengths:
+            return out, lengths_out
+        return out
 
 
 class _TextModelBase:

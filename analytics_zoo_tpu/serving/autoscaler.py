@@ -83,6 +83,13 @@ class FleetSignals:
     # how stale its own scale-up decisions run (actuation lag)
     replicas_warming: int = 0
     cold_start_s: Optional[float] = None
+    # lag-aware prediction (PR 12): the MEASURED scale_up-decision ->
+    # fleet-at-target-and-warm wall from the last actuation
+    # (autoscaler_actuation_lag_seconds).  The Autoscaler runtime injects
+    # it each tick; the policy projects the backlog this far forward, so
+    # capacity lands when the projected load arrives instead of one
+    # actuation lag late.  None = never measured -> no lead applied.
+    actuation_lag_s: Optional[float] = None
     # current fast-tier targets + their ceilings (from the engines' knobs())
     max_batch: int = 4
     max_batch_ceiling: int = 1024
@@ -114,6 +121,12 @@ class AutoscalerParams:
     max_step: int = 2                  # replicas added/removed per decision
     knob_dwell_s: float = 1.0          # min gap between fast-tier nudges
     max_preprocess_workers: int = 8
+    # lag-aware scale-up lead (PR 12): project the backlog forward by the
+    # measured actuation lag (capped at max_lead_s so one pathological
+    # measurement cannot make every gentle ramp read as overload).
+    # predictive=False restores the PR 10 reactive-only controller.
+    predictive: bool = True
+    max_lead_s: float = 30.0
     heartbeat_stale_s: float = 10.0    # replica presumed dead past this
     replace_cooldown_s: float = 10.0   # per-replica, between replacements
 
@@ -160,8 +173,11 @@ class AutoscalerPolicy:
         prev, prev_now = self._prev, self._prev_now
         self._prev, self._prev_now = s, now
         if prev is None or prev_now is None or now <= prev_now:
-            return {"shed": 0.0, "reclaim": 0.0, "quarantine": 0.0}
+            return {"shed": 0.0, "reclaim": 0.0, "quarantine": 0.0,
+                    "backlog_rate": 0.0}
         dt = now - prev_now
+        backlog = max(0, s.queue_depth) + max(0, s.pending)
+        prev_backlog = max(0, prev.queue_depth) + max(0, prev.pending)
         # max(0, ...): a replaced external member's counters leaving the sum
         # reads as a negative delta — clamp rather than poison the rate
         return {
@@ -169,7 +185,10 @@ class AutoscalerPolicy:
             "reclaim": max(0.0, s.reclaimed_total - prev.reclaimed_total)
             / dt,
             "quarantine": max(0.0, s.quarantined_total
-                              - prev.quarantined_total) / dt}
+                              - prev.quarantined_total) / dt,
+            # signed: the predictive term only uses growth (> 0), but the
+            # sign is useful in reasons/logs
+            "backlog_rate": (backlog - prev_backlog) / dt}
 
     # -- the decision function ------------------------------------------------
     def decide(self, s: FleetSignals, now: float) -> List[Action]:
@@ -207,8 +226,19 @@ class AutoscalerPolicy:
         backlog = max(0, s.queue_depth) + max(0, s.pending)
         batch_quantum = max(1, s.max_batch) * desired
         p99 = s.e2e_p99_ms
+        # lag-aware lead (PR 12): new capacity arrives one MEASURED
+        # actuation lag after the decision, so judge the backlog where it
+        # will be when the replicas are actually warm — a growing backlog
+        # crosses the overload band one lead earlier, a shrinking or flat
+        # one is unaffected (and underload always judges the RAW backlog,
+        # so prediction can never cause a scale-down)
+        projected = backlog
+        if p.predictive and s.actuation_lag_s \
+                and rates["backlog_rate"] > 0:
+            lead = min(float(s.actuation_lag_s), p.max_lead_s)
+            projected = backlog + rates["backlog_rate"] * lead
         overload = ((p99 is not None and p99 > p.p99_high * p.slo_p99_ms)
-                    or backlog > p.backlog_high * batch_quantum
+                    or projected > p.backlog_high * batch_quantum
                     or rates["shed"] > 0)
         underload = (backlog < p.backlog_low * batch_quantum
                      and rates["shed"] == 0
@@ -234,7 +264,8 @@ class AutoscalerPolicy:
                 self._last_knob = now
                 actions.append(Action("retune_up", knobs=knob,
                                       reason=self._band_reason(
-                                          s, rates, backlog, batch_quantum)))
+                                          s, rates, backlog, batch_quantum,
+                                          projected)))
         elif underload and now - self._last_knob >= p.knob_dwell_s:
             knob = self._knob_down(s)
             if knob is not None:
@@ -254,7 +285,8 @@ class AutoscalerPolicy:
             self._overload_since = now
             actions.append(Action(
                 "scale_up", target=target,
-                reason=self._band_reason(s, rates, backlog, batch_quantum)))
+                reason=self._band_reason(s, rates, backlog, batch_quantum,
+                                         projected)))
         elif underload and self._underload_since is not None \
                 and now - self._underload_since >= p.dwell_down_s \
                 and now - self._last_scale >= p.scale_down_cooldown_s \
@@ -271,11 +303,16 @@ class AutoscalerPolicy:
         return actions
 
     @staticmethod
-    def _band_reason(s: FleetSignals, rates, backlog, quantum) -> str:
+    def _band_reason(s: FleetSignals, rates, backlog, quantum,
+                     projected=None) -> str:
         bits = []
         if s.e2e_p99_ms is not None:
             bits.append(f"p99 {s.e2e_p99_ms:.0f}ms")
         bits.append(f"backlog {backlog}/{quantum}")
+        if projected is not None and projected > backlog:
+            bits.append(
+                f"projected {projected:.0f} in {s.actuation_lag_s:.1f}s "
+                f"lag ({rates['backlog_rate']:+.1f}/s)")
         if rates["shed"] > 0:
             bits.append(f"shedding {rates['shed']:.1f}/s")
         return "overload: " + ", ".join(bits)
@@ -366,6 +403,9 @@ class Autoscaler:
             "autoscaler_replicas_warming",
             "Members still compiling their warm-up set")
         self._pending_scale: Optional[tuple] = None  # (t_decided, target)
+        # last measured actuation lag, fed back into the policy's
+        # predictive term (PR 12): the controller learns its own latency
+        self._last_lag: Optional[float] = None
 
     # -- one evaluation -------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> List[Action]:
@@ -385,6 +425,7 @@ class Autoscaler:
             if signals.replicas >= target and signals.replicas_warming == 0:
                 lag = max(0.0, now - t_req)
                 self._g_lag.set(lag)
+                self._last_lag = lag
                 self._pending_scale = None
                 logger.info(
                     "autoscaler: scale-up actuated — %d replica(s) alive "
@@ -392,6 +433,11 @@ class Autoscaler:
                     "%s)", target, lag,
                     f"{signals.cold_start_s:.2f}s"
                     if signals.cold_start_s is not None else "n/a")
+        if signals.actuation_lag_s is None:
+            # feed the measured closed-loop latency back into the policy's
+            # predictive term; a fleet that reports its own lag (future
+            # signal sources) wins over our local measurement
+            signals.actuation_lag_s = self._last_lag
         actions = self.policy.decide(signals, now)
         for act in actions:
             self._apply(act, signals)

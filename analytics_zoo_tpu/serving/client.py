@@ -236,43 +236,65 @@ class OutputQueue:
 
     def query(self, uri: str, timeout_s: Optional[float] = 0.0,
               poll_s: float = 0.01,
-              poll_max_s: float = 0.1) -> Optional[Dict]:
+              poll_max_s: float = 0.1,
+              partials: bool = False) -> Optional[Dict]:
         """Poll for the record's result until `timeout_s` (None = until a
         result arrives).  A quarantined
         record resolves to an ``{"error": ...}`` dict (engine dead-letter
         path) — callers should check `is_error` rather than blocking on a
         value that will never arrive.
 
+        Generation deployments (PR 12) stream ``{"partial": true,
+        "tokens": [...]}`` results while a request decodes.  By default
+        those are NOT returned — the poll keeps waiting for the terminal
+        result (falling back to the freshest partial at the deadline so
+        progress is never discarded); ``partials=True`` returns the first
+        result of either kind, for callers consuming tokens-so-far.
+
         The poll interval backs off 1.5x per empty read up to
         ``poll_max_s`` (PR 3): a long wait costs O(log) round-trips against
         the backend instead of one per ``poll_s``."""
         deadline = Deadline(timeout_s)
         poll = poll_s
+        partial = None
         while True:
             res = self.queue.get_result(uri)
-            if res is not None or deadline.expired():
-                return res
+            if res is not None:
+                if partials or not self.is_partial(res):
+                    return res
+                partial = res
+            if deadline.expired():
+                return res if res is not None else partial
             time.sleep(min(poll, max(deadline.remaining(), 0.001)))
             poll = min(poll * 1.5, poll_max_s)
 
     def query_many(self, uris, timeout_s: Optional[float] = 0.0,
                    poll_s: float = 0.01,
-                   poll_max_s: float = 0.25) -> Dict[str, Optional[Dict]]:
+                   poll_max_s: float = 0.25,
+                   partials: bool = False) -> Dict[str, Optional[Dict]]:
         """Poll for MANY records with one batched ``get_results`` per sweep
         (PR 3): a 1k-record query costs one backend round-trip per poll
         instead of 1k, and the poll interval backs off while results are
         pending.  Returns ``{uri: result-or-None}``; unresolved uris map to
-        None once ``timeout_s`` elapses (None = wait for all)."""
+        None once ``timeout_s`` elapses (None = wait for all).  Streaming
+        partials (PR 12) do not resolve a uri unless ``partials=True`` —
+        at the deadline an unresolved uri falls back to its freshest
+        partial rather than None."""
         uris = list(uris)              # may be a generator: iterated twice
         deadline = Deadline(timeout_s)
         got: Dict[str, Dict] = {}
+        latest_partial: Dict[str, Dict] = {}
         pending = list(uris)
         poll = poll_s
         while pending:
             res = self.queue.get_results(pending)
             for u, r in res.items():
-                if r is not None:
+                if r is None:
+                    continue
+                if partials or not self.is_partial(r):
                     got[u] = r
+                else:
+                    latest_partial[u] = r
             before = len(pending)
             pending = [u for u in pending if u not in got]
             if not pending or deadline.expired():
@@ -281,7 +303,7 @@ class OutputQueue:
                 poll = poll_s          # stream is draining: stay responsive
             time.sleep(min(poll, max(deadline.remaining(), 0.001)))
             poll = min(poll * 1.5, poll_max_s)
-        return {u: got.get(u) for u in uris}
+        return {u: got.get(u, latest_partial.get(u)) for u in uris}
 
     def dequeue(self, uris) -> Dict[str, Dict]:
         """One batched read for all uris (no polling)."""
@@ -291,6 +313,12 @@ class OutputQueue:
     def is_error(result: Optional[Dict]) -> bool:
         """True when a result is a dead-letter error marker."""
         return isinstance(result, dict) and "error" in result
+
+    @staticmethod
+    def is_partial(result: Optional[Dict]) -> bool:
+        """True when a result is a streaming tokens-so-far partial (PR 12
+        generation) — NOT a terminal state; keep polling for the final."""
+        return isinstance(result, dict) and bool(result.get("partial"))
 
     @staticmethod
     def is_deadline_exceeded(result: Optional[Dict]) -> bool:

@@ -251,6 +251,8 @@ class _Staged(NamedTuple):
     traces: Optional[List]        # per-record trace_id (wire-stamped)
     t_read: Optional[float]       # monotonic: read_batch returned
     t_ready: Optional[float]      # monotonic: preprocess/grouping done
+    metas: Optional[List] = None  # per-record `gen` options (PR 12), None
+    #                               for the predict plane
 
 
 class _InFlight(NamedTuple):
@@ -330,7 +332,8 @@ class ServingParams:
                  sharding: str = "off",
                  gateway: bool = True,
                  warmup=False,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 generation=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -409,6 +412,17 @@ class ServingParams:
         # topology loads executables from disk instead of compiling.
         self.warmup = warmup if isinstance(warmup, dict) else bool(warmup)
         self.compile_cache_dir = compile_cache_dir
+        # continuous batching (PR 12).  `generation`: None (off, the
+        # batch-in/batch-out predict plane) | True (defaults) | a config
+        # dict — see serving/generate.GenerationParams for the keys
+        # (max_active_slots, max_tokens, eos_id, start_id, max_prompt_len,
+        # bucket_lens, prefill_buckets, stream_interval).  When set, the
+        # predict+write stages are replaced by the token-level scheduler:
+        # requests join/leave the in-flight decode batch at step
+        # boundaries, results stream through OutputQueue partials, and the
+        # model must expose init_decode/decode_step.
+        self.generation = generation if isinstance(generation, dict) \
+            else ({} if generation else None)
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -455,7 +469,8 @@ class ServingParams:
             sharding=str(p.get("sharding", "off")),
             gateway=bool(p.get("gateway", True)),
             warmup=p.get("warmup", False),
-            compile_cache_dir=p.get("compile_cache_dir"))
+            compile_cache_dir=p.get("compile_cache_dir"),
+            generation=p.get("generation"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -653,6 +668,40 @@ class ClusterServing:
         # InferenceModel.bind_registry for the re-binding/pinning rules)
         if isinstance(model, InferenceModel):
             model.bind_registry(self.registry)
+        # continuous batching (PR 12): the token-level scheduler replaces
+        # the predict+write stages when `params.generation` is set.  Built
+        # at construction so a model lacking the step-wise decode API
+        # fails fast, not mid-stream.
+        self._batcher = None
+        self._gen_params = None
+        if self.params.generation is not None:
+            from analytics_zoo_tpu.serving.generate import (
+                ContinuousBatcher, GenerationParams)
+            self._gen_params = GenerationParams.from_dict(
+                self.params.generation)
+            self._batcher = ContinuousBatcher(model, self._gen_params)
+            self._m_decode_steps = reg.counter(
+                "serving_decode_steps_total",
+                "Decode-step boundaries executed by the token scheduler")
+            self._m_decode_steps.inc(0)
+            self._m_gen_tokens = reg.counter(
+                "serving_generated_tokens_total",
+                "Tokens generated across all requests")
+            self._m_gen_tokens.inc(0)
+            self._m_ttft = reg.histogram(
+                "serving_time_to_first_token_seconds",
+                "Request admission to first generated token")
+            self._g_tps = reg.gauge(
+                "serving_tokens_per_second",
+                "Generated tokens per second over the last rate window")
+            self._g_tps.set(0.0)       # materialized: scrapable pre-traffic
+            slots_fn = (lambda b=self._batcher: float(b.active))
+            self._gauge_fns.append(
+                (reg.gauge("serving_active_slots",
+                           "Decode slots currently serving a request",
+                           fn=slots_fn), slots_fn))
+            self._last_steps = 0
+            self._tps_window = (time.monotonic(), 0)   # (t0, tokens0)
         self._tb = None
         if tensorboard_dir:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
@@ -755,7 +804,14 @@ class ClusterServing:
         for rid, rec, deliveries in entries:
             tid = rec.get("trace_id") if isinstance(rec, dict) else None
             self._span("reclaim", t, t, trace_id=tid, uri=rid)
-            if existing.get(rid) is not None:
+            prior = existing.get(rid)
+            if isinstance(prior, dict) and prior.get("partial"):
+                # a PARTIAL streaming result (PR 12) is not a terminal
+                # state: the previous owner died mid-generation, so the
+                # record must be re-served, not suppressed — the fresh
+                # terminal result overwrites the stale partial
+                prior = None
+            if prior is not None:
                 self.duplicates += 1
                 self._m_duplicates.inc()
                 self._ack([rid])
@@ -921,16 +977,30 @@ class ClusterServing:
             return True
         if not expired:
             return False
-        self.shed += 1
-        self._m_shed.inc()
         if trace_id is None and rec is not None:
             trace_id = rec.get("trace_id")
+        self._shed_terminal(rid, stage=stage, trace_id=trace_id)
+        return True
+
+    def _shed_terminal(self, rid, stage: str = "read",
+                       trace_id: Optional[str] = None,
+                       error: str = "deadline-exceeded: budget elapsed "
+                                    "before predict",
+                       extra: Optional[Dict] = None) -> None:
+        """Terminal shed bookkeeping: error marker written (best-effort),
+        claim released, counters/span recorded.  Shared by the deadline
+        gates and the generation scheduler's step-boundary sheds;
+        ``extra`` rides the marker (a mid-generation shed's partial
+        tokens must survive the overwrite of the streamed partial)."""
+        self.shed += 1
+        self._m_shed.inc()
         now = time.monotonic()
-        error = "deadline-exceeded: budget elapsed before predict"
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
                          error=error)
         logger.info("serving: shedding expired record %r", rid)
         result = {"error": error}
+        if extra:
+            result.update(extra)
         if trace_id is not None:
             result["trace_id"] = trace_id
         try:
@@ -941,7 +1011,6 @@ class ClusterServing:
         # release the claim even when the marker write failed
         self._redelivered.pop(rid, None)
         self._ack([rid])
-        return True
 
     # -- adaptive micro-batching (PR 3 tentpole) -----------------------------
     def _read_coalesced(self):
@@ -967,7 +1036,8 @@ class ClusterServing:
                 batch.extend(more)
         return batch
 
-    def _stack_group(self, ids, items, deadlines, traces=None, t_read=None):
+    def _stack_group(self, ids, items, deadlines, traces=None, t_read=None,
+                     metas=None):
         """Stack one same-shape group into a staged
         (ids, tensors, scales, deadlines, traces) micro-batch."""
         t_ready = time.monotonic()
@@ -977,13 +1047,13 @@ class ClusterServing:
             tensors = np.stack([it.data for it in items])
             scales = np.asarray([it.scale for it in items], np.float32)
             return _Staged(ids, tensors, scales, deadlines, traces,
-                           t_read, t_ready)
+                           t_read, t_ready, metas)
         # mixed float/quantized batches dequantize the stragglers on host
         tensors = np.stack([
             it.data.astype(np.float32) * it.scale
             if isinstance(it, QuantizedTensor) else it for it in items])
         return _Staged(ids, tensors, None, deadlines, traces,
-                       t_read, t_ready)
+                       t_read, t_ready, metas)
 
     def _preprocess_pool(self):
         """Lazy thread pool for ``preprocess_workers > 1`` (base64 + cv2
@@ -1174,8 +1244,12 @@ class ClusterServing:
                     format=_wire_fmt_label(rec)).record(p1 - p0)
                 self._span("preprocess", p0, p1,
                                  trace_id=rec.get("trace_id"), uri=rid)
+                # per-record generation options (PR 12): `gen` rides the
+                # record untyped — the scheduler validates/clamps values
+                meta = rec.get("gen")
                 items.append((rid, item, rec.get("deadline_ns"),
-                              rec.get("trace_id")))
+                              rec.get("trace_id"),
+                              meta if isinstance(meta, dict) else None))
             except Exception as e:  # noqa: BLE001 — malformed record
                 self._quarantine(rid, "preprocess", e, record=rec)
         if kept:
@@ -1183,20 +1257,21 @@ class ClusterServing:
             # per-RECORD weighting is reserved for the e2e latency reservoir
             self._stages["preprocess"].record(time.monotonic() - t_read)
         groups: Dict[tuple, List] = {}
-        for rid, item, dl, tid in items:
+        for rid, item, dl, tid, meta in items:
             shape = np.shape(item.data if isinstance(item, QuantizedTensor)
                              else item)
-            groups.setdefault(shape, []).append((rid, item, dl, tid))
+            groups.setdefault(shape, []).append((rid, item, dl, tid, meta))
         if not groups:
             # records WERE read but all shed/quarantined: distinct from an
             # empty stream so a draining _pre_loop keeps reading the backlog
             return []
-        return [self._stack_group([rid for rid, _, _, _ in quads],
-                                  [it for _, it, _, _ in quads],
-                                  [dl for _, _, dl, _ in quads],
-                                  traces=[tid for _, _, _, tid in quads],
-                                  t_read=t_read)
-                for quads in groups.values()]
+        return [self._stack_group([rid for rid, *_ in quints],
+                                  [it for _, it, *_ in quints],
+                                  [dl for _, _, dl, _, _ in quints],
+                                  traces=[tid for *_, tid, _ in quints],
+                                  t_read=t_read,
+                                  metas=[m for *_, m in quints])
+                for quints in groups.values()]
 
     def _predict_isolated(self, ids, tensors, scales, tmap=None):
         """Predict with graceful degradation: on failure, bisect the batch to
@@ -1259,7 +1334,7 @@ class ClusterServing:
 
     def _predict_stage(self, ids, tensors, scales=None, deadlines=None,
                        traces=None, t_read=None,
-                       t_ready=None) -> Optional[_InFlight]:
+                       t_ready=None, metas=None) -> Optional[_InFlight]:
         """Deadline gate 2 + async dispatch.  Returns the in-flight handle
         for the write stage, or None when every record was shed."""
         # second deadline gate: a record can expire while staged behind a
@@ -1360,7 +1435,7 @@ class ClusterServing:
 
     def _predict_and_write(self, ids, tensors, scales=None,
                            deadlines=None, traces=None, t_read=None,
-                           t_ready=None) -> int:
+                           t_ready=None, metas=None) -> int:
         """Synchronous predict+write for one staged group (serve_once and
         the write-stage fallbacks); the pipelined loop runs the same two
         stages on separate workers."""
@@ -1374,6 +1449,16 @@ class ClusterServing:
     # -- one micro-batch (synchronous path, used by tests/clients) -----------
     def serve_once(self) -> int:
         staged = self._read_and_preprocess()
+        if self._batcher is not None:
+            # generation mode: run the scheduler to quiescence — reads one
+            # micro-batch, then steps until every admitted request reached
+            # a terminal state (tests and embedded callers)
+            for group in staged or ():
+                self._submit_group(group)
+            before = self.total_records
+            while not self._batcher.idle and not self._stop.is_set():
+                self._gen_tick()
+            return self.total_records - before
         if not staged:
             return 0
         return sum(self._predict_and_write(*group) for group in staged)
@@ -1449,17 +1534,30 @@ class ClusterServing:
             self._pre_loop, name="serving-preprocess",
             max_restarts=p.max_worker_restarts,
             backoff_s=p.worker_backoff_s, stop_event=self._stop)
-        self._predict_sup = SupervisedThread(
-            self._predict_loop, name="serving-predict",
-            max_restarts=p.max_worker_restarts,
-            backoff_s=p.worker_backoff_s, stop_event=self._stop)
-        self._write_sup = SupervisedThread(
-            self._write_loop, name="serving-write",
-            max_restarts=p.max_worker_restarts,
-            backoff_s=p.worker_backoff_s, stop_event=self._stop)
+        if self._batcher is not None:
+            # continuous batching (PR 12): ONE generate worker owns both
+            # decode stepping and result writing — results must flush AT
+            # step boundaries (a finished request unblocks its client
+            # immediately), so splitting the stages would only add a
+            # hand-off queue between two things that must stay in lockstep
+            self._predict_sup = SupervisedThread(
+                self._generate_loop, name="serving-generate",
+                max_restarts=p.max_worker_restarts,
+                backoff_s=p.worker_backoff_s, stop_event=self._stop)
+            self._write_sup = None
+        else:
+            self._predict_sup = SupervisedThread(
+                self._predict_loop, name="serving-predict",
+                max_restarts=p.max_worker_restarts,
+                backoff_s=p.worker_backoff_s, stop_event=self._stop)
+            self._write_sup = SupervisedThread(
+                self._write_loop, name="serving-write",
+                max_restarts=p.max_worker_restarts,
+                backoff_s=p.worker_backoff_s, stop_event=self._stop)
         self._pre_sup.start()
         self._predict_sup.start()
-        self._write_sup.start()
+        if self._write_sup is not None:
+            self._write_sup.start()
         # compat aliases: the raw threads, for callers that poked at them
         self._pre_thread = self._pre_sup._thread
         self._thread = self._predict_sup._thread
@@ -1474,7 +1572,14 @@ class ClusterServing:
         from analytics_zoo_tpu.inference import aot
         p = self.params
         try:
-            manifest = aot.resolve_manifest(self.model, p.warmup)
+            if self._batcher is not None:
+                # continuous batching (PR 12): the warm-up set is the
+                # scheduler's (prefill-bucket x decode-step) program set,
+                # so a warm replica serves its first TOKEN with zero
+                # compiles
+                manifest = self._batcher.warmup_manifest()
+            else:
+                manifest = aot.resolve_manifest(self.model, p.warmup)
         except Exception as e:  # noqa: BLE001 — stay on the lazy path
             logger.warning(
                 "serving: warm-up disabled — manifest underivable (%s: "
@@ -1499,8 +1604,12 @@ class ClusterServing:
             self._warm_state["compiled"] = done
 
         try:
-            stats = aot.warm_up(self.model, manifest, progress=progress,
-                                stop=self._stop.is_set)
+            if self._batcher is not None:
+                stats = self._batcher.warm(manifest, progress=progress,
+                                           stop=self._stop.is_set)
+            else:
+                stats = aot.warm_up(self.model, manifest, progress=progress,
+                                    stop=self._stop.is_set)
         except Exception as e:  # noqa: BLE001 — a warm-up crash must not
             # block readiness forever; the lazy path still serves
             logger.exception("serving: warm-up pass failed")
@@ -1610,6 +1719,176 @@ class ClusterServing:
                 continue
             self._write_stage(inflight)
 
+    # -- continuous batching (PR 12 tentpole) ---------------------------------
+    def _submit_group(self, group: _Staged) -> None:
+        """Unpack one staged micro-batch into per-record generation
+        requests and feed them to the scheduler.  The waiting room is
+        bounded: when full, the generate loop keeps stepping (finishing
+        requests frees it) instead of dropping records."""
+        from analytics_zoo_tpu.serving.generate import GenRequest
+        tensors = group.tensors
+        if group.scales is not None:
+            # int8-wire prompts: dequantize on host — token ids survive
+            # the round-trip exactly when the producer quantized ids
+            tensors = tensors.astype(np.float32) \
+                * np.asarray(group.scales)[:, None]
+        metas = group.metas or [None] * len(group.ids)
+        traces = group.traces or [None] * len(group.ids)
+        deadlines = group.deadlines or [None] * len(group.ids)
+        for i, rid in enumerate(group.ids):
+            meta = metas[i] if isinstance(metas[i], dict) else {}
+            mt = meta.get("max_tokens")
+            try:
+                mt = None if mt is None else int(mt)
+            except (TypeError, ValueError):
+                mt = None
+            req = GenRequest(rid, np.asarray(tensors[i]),
+                             deadline_ns=deadlines[i],
+                             trace_id=traces[i], t_read=group.t_read,
+                             max_tokens=mt)
+            while not self._batcher.submit(req):
+                if self._stop.is_set():
+                    return
+                self._handle_gen_events(self._batcher.step())
+
+    def _gen_tick(self) -> None:
+        """One decode-step boundary + its bookkeeping (stage timer,
+        decode-step counter, tokens/sec window)."""
+        b = self._batcher
+        t0 = time.monotonic()
+        events = b.step()
+        now = time.monotonic()
+        if b.active or events:
+            self._stages["predict"].record(now - t0)
+        steps = b.decode_steps
+        if steps > self._last_steps:
+            self._m_decode_steps.inc(steps - self._last_steps)
+            self._last_steps = steps
+        self._update_tps(now)
+        self._handle_gen_events(events)
+
+    def _update_tps(self, now: float) -> None:
+        """Roll the tokens/sec rate window.  Called from every generate
+        loop iteration — including idle ones, so the gauge decays to 0
+        when traffic stops instead of freezing at the last burst's
+        rate."""
+        wt0, wtok = self._tps_window
+        if now - wt0 >= 1.0:
+            self._g_tps.set((self._batcher.generated_tokens - wtok)
+                            / max(now - wt0, 1e-9))
+            self._tps_window = (now, self._batcher.generated_tokens)
+
+    def _handle_gen_events(self, events) -> None:
+        """Turn scheduler events into the existing record contracts:
+        finish -> batched result write + ack (+ e2e/cold-start stamps),
+        partial -> best-effort streaming overwrite, shed -> terminal
+        deadline marker, quarantine -> dead-letter, first_token -> TTFT."""
+        pairs: List[Tuple[str, Dict]] = []
+        finals = []
+        for ev in events:
+            if ev.kind == "first_token":
+                if ev.ttft_s is not None:
+                    self._m_ttft.record(ev.ttft_s)
+            elif ev.kind == "partial":
+                value = {"partial": True, "tokens": ev.tokens,
+                         "n": len(ev.tokens)}
+                if ev.trace_id is not None:
+                    value["trace_id"] = ev.trace_id
+                try:
+                    # streaming is best-effort: a failed partial write
+                    # must not retry-storm or quarantine a LIVE request —
+                    # the next interval (or the terminal write) overwrites
+                    self.queue.put_result(ev.rid, value)
+                except Exception:  # noqa: BLE001
+                    pass
+            elif ev.kind == "finish":
+                value = {"value": {"tokens": ev.tokens,
+                                   "length": len(ev.tokens),
+                                   "finish_reason": ev.finish_reason}}
+                if ev.trace_id is not None:
+                    value["trace_id"] = ev.trace_id
+                deliveries = self._redelivered.pop(ev.rid, None)
+                if deliveries:
+                    value["deliveries"] = deliveries
+                pairs.append((ev.rid, value))
+                finals.append(ev)
+                self._m_gen_tokens.inc(len(ev.tokens))
+            elif ev.kind == "shed":
+                # an ACTIVE request's shed event carries its progress:
+                # say so ("before predict" would point triage at queueing
+                # when the cost was decode time) and keep the tokens ON
+                # the marker — the marker overwrites any streamed
+                # partial, and default clients never return partials
+                if ev.tokens is not None:
+                    err = ("deadline-exceeded: budget elapsed "
+                           f"mid-generation after {len(ev.tokens)} "
+                           "token(s)")
+                    extra = {"tokens": ev.tokens, "n": len(ev.tokens)}
+                else:
+                    err = "deadline-exceeded: budget elapsed before decode"
+                    extra = None
+                self._shed_terminal(ev.rid, stage="generate",
+                                    trace_id=ev.trace_id, error=err,
+                                    extra=extra)
+            elif ev.kind == "quarantine":
+                self._quarantine(ev.rid, "generate",
+                                 RuntimeError(ev.error or "generation "
+                                                          "failed"),
+                                 trace_id=ev.trace_id)
+        if not pairs:
+            return
+        tmap = {ev.rid: ev.trace_id for ev in finals}
+        n = self._flush_results(pairs, tmap=tmap)
+        now = time.monotonic()
+        for ev in finals:
+            self._span("write", now, now, trace_id=ev.trace_id, uri=ev.rid)
+            if ev.t_read is not None:
+                self._e2e.record(now - ev.t_read)
+        if n and self._cold_start_s is None:
+            self._cold_start_s = now - self._t_construct
+            self._g_cold.set(self._cold_start_s)
+        self.total_records += n
+        self._m_records.inc(n)
+        self._maybe_trim()
+
+    def _generate_loop(self):
+        """The serving-generate worker: slot-map continuous batching
+        between preprocess and the result store.  Staged micro-batches are
+        unpacked into per-record requests; the scheduler admits them into
+        free decode slots at step boundaries, finished requests flush
+        immediately, and the loop never busy-spins an idle device (empty
+        scheduler -> blocking read on the staged queue)."""
+        import queue as _q
+        sup = self._predict_sup
+        b = self._batcher
+        while not self._stop.is_set():
+            if sup is not None:
+                sup.heartbeat()
+            # idle scheduler: block briefly for new work; busy: only sweep
+            # what is already staged, then take the next decode step
+            try:
+                if b.idle:
+                    group = self._staged.get(timeout=0.1)
+                else:
+                    group = self._staged.get_nowait()
+            except _q.Empty:
+                group = None
+            if group is not None:
+                self._submit_group(group)
+                while True:
+                    try:
+                        self._submit_group(self._staged.get_nowait())
+                    except _q.Empty:
+                        break
+            if b.idle:
+                self._update_tps(time.monotonic())
+                if self._draining.is_set() and self._pre_sup is not None \
+                        and not self._pre_sup.is_alive() \
+                        and self._staged.empty():
+                    return             # drain: upstream done + slots empty
+                continue
+            self._gen_tick()
+
     def stage_metrics(self) -> Dict:
         """Per-stage timing document (PR 3): read / preprocess / stage_wait /
         predict (dispatch -> host readback done) / write counters with
@@ -1670,6 +1949,10 @@ class ClusterServing:
              "workers": workers,
              "stages": self.stage_metrics(),
              "queue": queue_health}
+        if self._batcher is not None:
+            # continuous batching (PR 12): slot occupancy + token counters
+            # ride the health doc into fleet aggregation
+            h["generation"] = self._batcher.stats()
         h["ready"] = self._readiness(h)
         return h
 

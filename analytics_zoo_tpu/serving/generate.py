@@ -1,0 +1,826 @@
+"""Continuous batching for autoregressive serving (PR 12 tentpole).
+
+The serving engine was batch-in/batch-out end to end: a generation request
+batch held every member hostage until the SLOWEST decode finished, and a
+new request arriving one step after a batch dispatched waited a full
+rollout.  This module is the token-level scheduler that fixes both — the
+Orca (OSDI '22) / vLLM continuous-batching shape, built on the step-wise
+decode API the generation models now expose
+(``init_decode``/``decode_step``, models/seq2seq.py and
+models/textmodels.py):
+
+- **slot map** — decode runs over fixed ``(max_active, bucket)``-shaped
+  state buffers ("lanes", one per pow-2 capacity bucket).  Requests CLAIM a
+  free slot at a decode-step boundary (prefill via ``init_decode`` on a
+  pow-2-padded prompt, inserted with ``.at[slot].set``), generate one token
+  per step, and FREE the slot the moment they hit EOS / their token budget
+  / their deadline — the freed slot is refilled at the next boundary, so
+  one slow request never gates its neighbours.
+- **compile-once programs** — every device program (one prefill per
+  (prompt-bucket, lane), one decode step + one insert per lane) has a fixed
+  shape, is compiled once through ``jax.jit(...).lower().compile()`` and
+  cached; steady-state serving performs ZERO retraces no matter how
+  requests churn (asserted via ``inference/aot.py`` ``COMPILE_STATS``).
+  ``warm()`` pre-compiles the whole set from the same
+  ``aot.generation_manifest`` the serving warm-up manifest carries, so a
+  warm replica serves its first token with zero compiles.
+- **mesh placement** — lane state buffers are committed with a
+  ``NamedSharding`` over the PR 6 serving mesh when the model is sharded
+  (slot axis over ``data`` when it divides, replicated otherwise), so the
+  decode step partitions like the rest of the predict plane.
+- **events, not policy** — ``step()`` returns a list of ``GenEvent``s
+  (first_token / partial / finish / shed / quarantine); the engine turns
+  them into result writes, acks, quarantines and metrics, so the existing
+  per-record contracts (tracing, deadlines, lease ack, dead-letter) ride
+  unchanged.  A poisoned request (over-long or junk prompt, prefill
+  failure) quarantines ITS SLOT only: rows are independent in every lane
+  program, so neighbours' outputs are bitwise identical with or without
+  the poison.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _pow2_ceil(n: int) -> int:
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+def _pow2_ladder(lo: int, hi: int) -> List[int]:
+    """Pow-2 values in [lo, hi] (hi rounded up), smallest first."""
+    out = []
+    b = _pow2_ceil(lo)
+    hi = _pow2_ceil(hi)
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return out
+
+
+@dataclass
+class GenerationParams:
+    """``ServingParams.generation`` surface (config.yaml ``generation:``
+    section).
+
+    - ``max_active_slots`` — decode slots per lane: the in-flight batch
+      width of the compiled decode-step program.
+    - ``max_tokens`` — per-request generation budget (records may lower it
+      via ``{"gen": {"max_tokens": n}}``, never raise it).
+    - ``eos_id`` — stop token (None = budget-only stopping);
+      ``start_id`` — first decoder token for encoder/decoder models whose
+      prefill yields no logits (Seq2seq).
+    - ``max_prompt_len`` — longest accepted prompt; longer quarantines.
+    - ``bucket_lens`` — the pow-2 capacity ladder: one decode lane per
+      value, a request lands in the smallest lane holding
+      ``prompt + max_tokens``.  Default: one lane at
+      ``pow2(max_prompt_len + max_tokens)``.
+    - ``prefill_buckets`` — pow-2 prompt padding ladder (default 8 ..
+      pow2(max_prompt_len)); one compiled prefill program per (bucket,
+      lane) pair.
+    - ``stream_interval`` — tokens between partial-result flushes
+      (``OutputQueue`` partials / ``GET /v1/result`` tokens-so-far);
+      0 disables streaming.
+    - ``decode_quantum`` — tokens decoded per scheduler boundary: the
+      decode program scans this many steps internally, so the per-call
+      dispatch/sync overhead is paid once per ``decode_quantum`` tokens
+      instead of per token (the CPU/host analog of GPU graph capture).
+      Requests still join/leave at boundaries; a request finishing
+      mid-quantum wastes at most ``decode_quantum - 1`` row-steps (its
+      post-EOS tokens are discarded on host).  1 = pure per-token
+      scheduling.
+    """
+
+    max_active_slots: int = 8
+    max_tokens: int = 32
+    eos_id: Optional[int] = None
+    start_id: int = 1
+    max_prompt_len: int = 64
+    bucket_lens: Optional[List[int]] = None
+    prefill_buckets: Optional[List[int]] = None
+    stream_interval: int = 8
+    decode_quantum: int = 4
+
+    def __post_init__(self):
+        self.max_active_slots = max(1, int(self.max_active_slots))
+        self.max_tokens = max(1, int(self.max_tokens))
+        self.start_id = int(self.start_id)
+        self.max_prompt_len = max(1, int(self.max_prompt_len))
+        self.stream_interval = max(0, int(self.stream_interval))
+        self.decode_quantum = max(1, int(self.decode_quantum))
+        if self.eos_id is not None:
+            self.eos_id = int(self.eos_id)
+        if self.bucket_lens is None:
+            self.bucket_lens = [
+                _pow2_ceil(self.max_prompt_len + self.max_tokens)]
+        self.bucket_lens = sorted({_pow2_ceil(b) for b in self.bucket_lens})
+        if self.prefill_buckets is None:
+            self.prefill_buckets = _pow2_ladder(
+                min(8, _pow2_ceil(self.max_prompt_len)),
+                self.max_prompt_len)
+        self.prefill_buckets = sorted(
+            {_pow2_ceil(b) for b in self.prefill_buckets})
+        # a user-supplied ladder must still cover every ADMISSIBLE prompt
+        # (<= max_prompt_len), or valid requests would have no prefill
+        # program to land in
+        cap = _pow2_ceil(self.max_prompt_len)
+        if self.prefill_buckets[-1] < cap:
+            self.prefill_buckets.append(cap)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> "GenerationParams":
+        if not isinstance(d, dict):
+            return cls()
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C416
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class GenRequest:
+    """One admitted generation request (engine-internal)."""
+
+    __slots__ = ("rid", "prompt", "deadline_ns", "trace_id", "t_read",
+                 "max_tokens", "t_submit")
+
+    def __init__(self, rid: str, prompt: np.ndarray,
+                 deadline_ns: Optional[int] = None,
+                 trace_id: Optional[str] = None,
+                 t_read: Optional[float] = None,
+                 max_tokens: Optional[int] = None):
+        self.rid = rid
+        self.prompt = prompt
+        self.deadline_ns = deadline_ns
+        self.trace_id = trace_id
+        self.t_read = t_read
+        self.max_tokens = max_tokens
+        self.t_submit = time.monotonic()
+
+
+@dataclass
+class GenEvent:
+    """One scheduler outcome the engine must act on.
+
+    ``kind``: ``first_token`` (TTFT stamp), ``partial`` (stream
+    tokens-so-far), ``finish`` (terminal result), ``shed``
+    (deadline-exceeded at a step boundary), ``quarantine`` (poisoned
+    request isolated)."""
+
+    kind: str
+    rid: str
+    trace_id: Optional[str] = None
+    tokens: Optional[List[int]] = None
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    ttft_s: Optional[float] = None
+    t_read: Optional[float] = None
+    wall_s: Optional[float] = None
+
+
+class _Slot:
+    __slots__ = ("req", "generated", "t_first", "last_stream", "budget")
+
+    def __init__(self, req: GenRequest, budget: int):
+        self.req = req
+        self.generated: List[int] = []
+        self.t_first: Optional[float] = None
+        self.last_stream = 0
+        self.budget = budget
+
+
+class _Lane:
+    """One capacity bucket: fixed (max_active, bucket) state buffers plus
+    the host-side slot map."""
+
+    def __init__(self, bucket: int, max_active: int):
+        self.bucket = int(bucket)
+        self.max_active = int(max_active)
+        self.slots: List[Optional[_Slot]] = [None] * self.max_active
+        self.free: deque = deque(range(self.max_active))
+        self.state = None                  # device pytree, lazily allocated
+        self.tokens = np.zeros((self.max_active,), np.int32)
+
+    @property
+    def active(self) -> int:
+        return self.max_active - len(self.free)
+
+
+class ContinuousBatcher:
+    """Token-level decode scheduler over an ``InferenceModel`` whose inner
+    layer exposes ``init_decode``/``decode_step`` (see module docstring).
+
+    Thread contract: ``submit`` may be called from any thread (bounded
+    waiting deque); ``step``/``warm`` must run on ONE thread (the engine's
+    ``serving-generate`` worker)."""
+
+    MAX_WAITING = 1024
+
+    def __init__(self, model, gen: GenerationParams):
+        inner = getattr(model, "_model", None)
+        if inner is None or not hasattr(inner, "init_decode") \
+                or not hasattr(inner, "decode_step"):
+            raise ValueError(
+                "generation needs a model whose topology implements "
+                "init_decode/decode_step (models/seq2seq.Seq2seq, "
+                "models/textmodels.TransformerLM)")
+        self.model = model
+        self.inner = inner
+        self.gen = gen
+        import inspect
+        sig = inspect.signature(inner.init_decode)
+        # cache models (fixed-length KV caches) take cache_len and their
+        # prefill yields first-token logits; bare-state models (LSTM
+        # stacks) take neither and start from gen.start_id
+        self._cache_model = "cache_len" in sig.parameters
+        self._vocab = int(getattr(inner, "vocab_size", 0) or 0)
+        model_cap = int(getattr(inner, "max_len", 0) or 0)
+        # a cache lane must fit under the model's max_len AND hold at
+        # least the smallest prefill bucket (prefill allocates the cache
+        # at lane capacity, so cache_len >= prompt bucket must hold)
+        self._lanes = [
+            _Lane(b, gen.max_active_slots) for b in gen.bucket_lens
+            if not (self._cache_model
+                    and ((model_cap and b > model_cap)
+                         or b < gen.prefill_buckets[0]))]
+        if not self._lanes:
+            raise ValueError(
+                f"no usable decode lane: bucket_lens={gen.bucket_lens} "
+                f"all exceed the model's max_len={model_cap} or fall "
+                f"below the smallest prefill bucket "
+                f"{gen.prefill_buckets[0]}")
+        if len(self._lanes) < len(gen.bucket_lens):
+            logger.warning(
+                "generate: dropped %d unusable decode lane(s) from "
+                "bucket_lens=%s (model max_len=%s, smallest prefill "
+                "bucket %d)", len(gen.bucket_lens) - len(self._lanes),
+                gen.bucket_lens, model_cap or "n/a",
+                gen.prefill_buckets[0])
+        self._waiting: deque = deque()
+        self._waiting_lock = threading.Lock()
+        # compiled programs: ("prefill", pb, lane_bucket) |
+        # ("decode_step", lane_bucket) | ("insert", lane_bucket)
+        self._programs: Dict[tuple, object] = {}
+        self.compiles = 0
+        self.decode_steps = 0
+        self.generated_tokens = 0
+        self.admitted = 0
+        self.finished = 0
+        self.quarantined = 0
+        self.shed = 0
+        # COMPILE_STATS listeners: steady-state zero-compile evidence
+        from analytics_zoo_tpu.inference import aot
+        aot.install_compile_listeners()
+        # lane buffers allocated EAGERLY: the warm-up thread and the
+        # generate worker both touch lane.state, and lazy allocation would
+        # let one overwrite the other's freshly-inserted request state.
+        # (Program compiles stay lock-free — a rare duplicate compile is
+        # benign, and serializing them would queue a live request behind
+        # the whole warm-up set.)
+        for lane in self._lanes:
+            self._ensure_lane_state(lane)
+
+    # -- program construction (compile-once) ----------------------------------
+    def _params(self):
+        return self.model._params
+
+    def _jit_key_fns(self, lane_bucket: int):
+        import jax
+        inner = self.inner
+
+        if self._cache_model:
+            def prefill(p, prompt, lengths):
+                return inner.init_decode(p, prompt, lengths,
+                                         cache_len=lane_bucket)
+        else:
+            def prefill(p, prompt, lengths):
+                return inner.init_decode(p, prompt, lengths)
+
+        K = self.gen.decode_quantum
+
+        def step(p, state, tokens):
+            # K decode steps under one lax.scan: one dispatch + one host
+            # sync per K tokens.  No in-program EOS logic — the host sees
+            # all K tokens per slot and discards everything past a row's
+            # EOS/budget; a freed slot's state is fully overwritten by the
+            # next insert, so post-finish garbage never leaks.
+            def body(carry, _):
+                st, tok = carry
+                logits, st2 = inner.decode_step(p, st, tok)
+                nxt = jax.numpy.argmax(logits, axis=-1).astype("int32")
+                return (st2, nxt), nxt
+
+            (st, _), toks = jax.lax.scan(body, (state, tokens), None,
+                                         length=K)
+            return toks, st            # toks: (K, max_active)
+
+        def insert(state, sub, row, slot):
+            # one admitted request: copy `sub` row `row` (an admission
+            # batch member) into lane slot `slot`
+            return jax.tree.map(lambda L, s: L.at[slot].set(s[row]),
+                                state, sub)
+
+        return (jax.jit(prefill), jax.jit(step), jax.jit(insert))
+
+    def _lane_fns(self, lane: _Lane):
+        key = ("fns", lane.bucket)
+        fns = self._programs.get(key)
+        if fns is None:
+            fns = self._jit_key_fns(lane.bucket)
+            self._programs[key] = fns
+        return fns
+
+    def _compiled(self, key: tuple, fn, *args):
+        """AOT-compiled executable for one fixed-shape program, compiled
+        exactly once; ``warm()`` walks the same path, so a warmed program
+        is the very executable the hot path runs."""
+        exe = self._programs.get(key)
+        if exe is None:
+            exe = fn.lower(*args).compile()
+            self._programs[key] = exe
+            self.compiles += 1
+        return exe
+
+    def _commit_state(self, state):
+        """Commit a lane state buffer over the serving mesh (PR 6): slot
+        axis over ``data`` when it divides, replicated otherwise.
+        Single-chip models pass through."""
+        mesh = getattr(self.model, "_mesh", None)
+        if mesh is None:
+            return state
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dd = int(mesh.shape.get("data", 1))
+        A = self.gen.max_active_slots
+        shard_rows = dd > 1 and A % dd == 0
+
+        def place(a):
+            spec = P("data", *([None] * (a.ndim - 1))) \
+                if (shard_rows and a.ndim >= 1) else P()
+            return jax.device_put(a, NamedSharding(mesh, spec))
+
+        return jax.tree.map(place, state)
+
+    def _ensure_lane_state(self, lane: _Lane):
+        if lane.state is not None:
+            return
+        import jax
+        pb = self.gen.prefill_buckets[0]
+        prefill, _, _ = self._lane_fns(lane)
+        A = lane.max_active
+        shapes = jax.eval_shape(
+            prefill, self._params(),
+            jax.ShapeDtypeStruct((A, pb), np.int32),
+            jax.ShapeDtypeStruct((A,), np.int32))
+        state_shapes = shapes[0] if self._is_pair(shapes) else shapes
+        lane.state = self._commit_state(jax.tree.map(
+            lambda sd: np.zeros(sd.shape, sd.dtype), state_shapes))
+        lane.state = jax.device_put(lane.state) \
+            if getattr(self.model, "_mesh", None) is None else lane.state
+
+    @staticmethod
+    def _is_pair(res) -> bool:
+        """(state, logits) vs bare state: cache models return a 2-tuple
+        whose second element is a rank-2 logits array."""
+        return (isinstance(res, tuple) and len(res) == 2
+                and hasattr(res[1], "shape")
+                and getattr(res[1], "ndim", 0) == 2)
+
+    # -- admission ------------------------------------------------------------
+    def submit(self, req: GenRequest) -> bool:
+        """Queue one request for the next step boundary.  False = waiting
+        room full (caller should leave the record staged / backpressure)."""
+        with self._waiting_lock:
+            if len(self._waiting) >= self.MAX_WAITING:
+                return False
+            self._waiting.append(req)
+            return True
+
+    @property
+    def waiting(self) -> int:
+        with self._waiting_lock:
+            return len(self._waiting)
+
+    @property
+    def active(self) -> int:
+        return sum(lane.active for lane in self._lanes)
+
+    @property
+    def slots_total(self) -> int:
+        return sum(lane.max_active for lane in self._lanes)
+
+    def _req_budget(self, req: GenRequest) -> int:
+        """Per-request token budget: the deployment cap, lowerable (never
+        raisable) by the record's own max_tokens.  The ONE clamp both
+        lane selection and the slot budget use — they must agree, or a
+        request could land in a lane too small for its budget."""
+        budget = self.gen.max_tokens
+        if req.max_tokens is not None:
+            budget = max(1, min(int(req.max_tokens), budget))
+        return budget
+
+    def _budget_for(self, req: GenRequest, lane: _Lane) -> int:
+        budget = self._req_budget(req)
+        if self._cache_model:
+            budget = min(budget, lane.bucket - len(req.prompt))
+        return max(1, budget)
+
+    def _pick_lane(self, req: GenRequest) -> Optional[_Lane]:
+        """Smallest lane whose capacity holds prompt + budget AND the
+        prompt's padded prefill bucket (prefill allocates the cache at
+        the lane capacity, so ``cache_len >= prefill bucket`` must hold);
+        bare-state models (no length axis) use the first lane."""
+        if not self._cache_model:
+            return self._lanes[0]
+        want = len(req.prompt) + self._req_budget(req)
+        pb = self._prefill_bucket(len(req.prompt))
+        if pb is not None:
+            want = max(want, pb)
+        for lane in self._lanes:
+            if lane.bucket >= want:
+                return lane
+        return None
+
+    def _validate(self, req: GenRequest) -> Optional[str]:
+        p = np.asarray(req.prompt)
+        if p.ndim != 1 or p.size == 0:
+            return f"prompt must be a non-empty 1-D token sequence, got " \
+                   f"shape {p.shape}"
+        if p.size > self.gen.max_prompt_len:
+            return f"prompt length {p.size} > max_prompt_len " \
+                   f"{self.gen.max_prompt_len}"
+        if not np.all(np.isfinite(p)):
+            return "prompt contains non-finite token ids"
+        ids = p.astype(np.int64)
+        if self._vocab and (ids.min() < 0 or ids.max() >= self._vocab):
+            return f"token id out of range [0, {self._vocab})"
+        return None
+
+    def _prefill_bucket(self, n: int) -> Optional[int]:
+        for b in self.gen.prefill_buckets:
+            if b >= n:
+                return b
+        return None
+
+    def _batch_bucket(self, n: int) -> int:
+        """Admission-batch bucket: smallest pow-2 >= n, capped at the
+        slot-count bucket (the grab loop never claims more than a lane's
+        slots anyway)."""
+        return min(_pow2_ceil(n), _pow2_ceil(self.gen.max_active_slots))
+
+    def _admit_batch(self, lane: _Lane, pb: int, members, events) -> int:
+        """Prefill + insert a same-(lane, prompt-bucket) admission group
+        in ONE device call.  ``members``: (req, slot) pairs, slots already
+        claimed.  B=1 prefill costs ~the same wall as B=8 (call overhead
+        dominates at serving widths), so batching admissions is what keeps
+        a churning request mix from spending its steps on prefill calls.
+        Padding rows replicate row 0's prompt (any valid prompt works —
+        their states are computed and discarded, never inserted).
+
+        A failing batch falls back to singleton admission so a poisoned
+        request that slipped past validation quarantines ALONE."""
+        import jax
+        n = len(members)
+        bb = self._batch_bucket(n)
+        padded = np.zeros((bb, pb), np.int32)
+        lengths = np.ones((bb,), np.int32)
+        for j, (req, _) in enumerate(members):
+            prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+            padded[j, :prompt.size] = prompt
+            lengths[j] = prompt.size
+        for j in range(n, bb):
+            padded[j] = padded[0]
+            lengths[j] = lengths[0]
+        prefill, _, insert = self._lane_fns(lane)
+        try:
+            self._ensure_lane_state(lane)
+            exe = self._compiled(("prefill", bb, pb, lane.bucket), prefill,
+                                 self._params(), padded, lengths)
+            res = exe(self._params(), padded, lengths)
+            if self._is_pair(res):
+                sub, logits0 = res
+                toks0 = np.asarray(jax.numpy.argmax(logits0, axis=-1))
+            else:
+                sub, toks0 = res, None
+            ins = self._compiled(("insert", bb, lane.bucket), insert,
+                                 lane.state, sub, np.int32(0), np.int32(0))
+        except Exception as e:  # noqa: BLE001 — batch-level failure
+            if n == 1:
+                req, slot = members[0]
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                lane.free.append(slot)
+                return 0
+            # isolate the poison: singleton admissions, per-slot blast
+            # radius — neighbours' state buffers were never touched
+            return sum(self._admit_batch(lane, pb, [mem], events)
+                       for mem in members)
+        admitted = 0
+        for j, (req, slot) in enumerate(members):
+            try:
+                lane.state = ins(lane.state, sub, np.int32(j),
+                                 np.int32(slot))
+            except Exception as e:  # noqa: BLE001 — per-row insert failure
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                lane.free.append(slot)
+                continue
+            info = _Slot(req, budget=self._budget_for(req, lane))
+            lane.slots[slot] = info
+            self.admitted += 1
+            admitted += 1
+            if toks0 is not None:
+                # cache models emit their first token AT prefill: TTFT
+                # stops here, and the token feeds the first decode step
+                info.t_first = time.monotonic()
+                events.append(GenEvent(
+                    "first_token", req.rid, trace_id=req.trace_id,
+                    ttft_s=info.t_first - req.t_submit,
+                    t_read=req.t_read))
+                lane.tokens[slot] = int(toks0[j])
+                self._account_token(lane, slot, info, int(toks0[j]),
+                                    events)
+            else:
+                lane.tokens[slot] = self.gen.start_id
+        return admitted
+
+    def _admit(self, events: List[GenEvent]) -> int:
+        """Claim free slots for waiting requests and admit them in
+        batched prefill groups.  Stops at the first head-of-line request
+        whose lane is full (FIFO; retried next boundary)."""
+        grabbed: List[tuple] = []        # (req, lane, slot)
+        while True:
+            with self._waiting_lock:
+                req = self._waiting.popleft() if self._waiting else None
+            if req is None:
+                break
+            if self._expired(req.deadline_ns):
+                self.shed += 1
+                events.append(GenEvent(
+                    "shed", req.rid, trace_id=req.trace_id,
+                    t_read=req.t_read))
+                continue
+            err = self._validate(req)
+            if err is not None:
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"ValueError: {err}", t_read=req.t_read))
+                continue
+            lane = self._pick_lane(req)
+            if lane is None:
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error="ValueError: no decode lane holds prompt + "
+                          f"max_tokens (buckets {self.gen.bucket_lens})",
+                    t_read=req.t_read))
+                continue
+            if not lane.free:
+                # every slot of the right lane busy: the request stays at
+                # the head for the next boundary (FIFO per lane is close
+                # enough across lanes at this queue depth)
+                with self._waiting_lock:
+                    self._waiting.appendleft(req)
+                break
+            grabbed.append((req, lane, lane.free.popleft()))
+        if not grabbed:
+            return 0
+        groups: Dict[tuple, list] = {}
+        for req, lane, slot in grabbed:
+            prompt_len = int(np.asarray(req.prompt).reshape(-1).size)
+            pb = self._prefill_bucket(prompt_len)
+            if pb is None:
+                # defensive: __post_init__ extends the ladder to cover
+                # max_prompt_len, so this is unreachable from config —
+                # but an uncovered prompt must quarantine, not crash the
+                # worker with its slot claimed
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"ValueError: no prefill bucket holds prompt "
+                          f"length {prompt_len} (buckets "
+                          f"{self.gen.prefill_buckets})",
+                    t_read=req.t_read))
+                lane.free.append(slot)
+                continue
+            groups.setdefault((lane.bucket, pb), (lane, pb, []))[2] \
+                .append((req, slot))
+        return sum(self._admit_batch(lane, pb, members, events)
+                   for lane, pb, members in groups.values())
+
+    # -- step boundary --------------------------------------------------------
+    @staticmethod
+    def _expired(deadline_ns) -> bool:
+        if deadline_ns is None:
+            return False
+        try:
+            return time.time_ns() > int(deadline_ns)
+        except (TypeError, ValueError, OverflowError):
+            return False      # gateway/engine validated upstream
+
+    def _free(self, lane: _Lane, slot: int) -> None:
+        lane.slots[slot] = None
+        lane.free.append(slot)
+
+    def _finish(self, lane: _Lane, slot: int, info: _Slot, reason: str,
+                events: List[GenEvent]) -> None:
+        self.finished += 1
+        now = time.monotonic()
+        events.append(GenEvent(
+            "finish", info.req.rid, trace_id=info.req.trace_id,
+            tokens=list(info.generated), finish_reason=reason,
+            ttft_s=(info.t_first - info.req.t_submit
+                    if info.t_first is not None else None),
+            t_read=info.req.t_read, wall_s=now - info.req.t_submit))
+        self._free(lane, slot)
+
+    def _account_token(self, lane: _Lane, slot: int, info: _Slot,
+                       tok: int, events: List[GenEvent]) -> None:
+        """Fold one emitted token into the slot: EOS / budget finish the
+        request immediately (slot freed THIS boundary), stream_interval
+        flushes partials."""
+        eos = self.gen.eos_id
+        if eos is not None and tok == eos:
+            self._finish(lane, slot, info, "eos", events)
+            return
+        info.generated.append(int(tok))
+        self.generated_tokens += 1
+        if len(info.generated) >= info.budget:
+            self._finish(lane, slot, info, "length", events)
+            return
+        si = self.gen.stream_interval
+        if si and len(info.generated) - info.last_stream >= si:
+            info.last_stream = len(info.generated)
+            events.append(GenEvent(
+                "partial", info.req.rid, trace_id=info.req.trace_id,
+                tokens=list(info.generated), t_read=info.req.t_read))
+
+    def _shed_active(self, events: List[GenEvent]) -> None:
+        for lane in self._lanes:
+            for slot, info in enumerate(lane.slots):
+                if info is None or not self._expired(info.req.deadline_ns):
+                    continue
+                self.shed += 1
+                events.append(GenEvent(
+                    "shed", info.req.rid, trace_id=info.req.trace_id,
+                    tokens=list(info.generated), t_read=info.req.t_read))
+                self._free(lane, slot)
+
+    def step(self) -> List[GenEvent]:
+        """One decode-step boundary: shed expired, admit into free slots,
+        run one token step per non-empty lane, fold the emitted tokens.
+        Returns the events the engine must act on; an idle scheduler
+        returns [] without touching the device."""
+        events: List[GenEvent] = []
+        self._shed_active(events)
+        self._admit(events)
+        for lane in self._lanes:
+            if lane.active == 0:
+                continue
+            _, step, _ = self._lane_fns(lane)
+            tokens = lane.tokens
+            exe = self._compiled(("decode_step", lane.bucket), step,
+                                 self._params(), lane.state, tokens)
+            block, lane.state = exe(self._params(), lane.state, tokens)
+            block = np.asarray(block)          # (decode_quantum, A)
+            self.decode_steps += int(block.shape[0])   # token-level steps
+            now = time.monotonic()
+            for slot, info in enumerate(lane.slots):
+                if info is None:
+                    continue
+                if info.t_first is None:
+                    info.t_first = now
+                    events.append(GenEvent(
+                        "first_token", info.req.rid,
+                        trace_id=info.req.trace_id,
+                        ttft_s=info.t_first - info.req.t_submit,
+                        t_read=info.req.t_read))
+                for k in range(block.shape[0]):
+                    self._account_token(lane, slot, info,
+                                        int(block[k, slot]), events)
+                    if lane.slots[slot] is not info:
+                        break      # finished mid-quantum: discard the rest
+            # copy: the device block is read-only, and the next boundary's
+            # admission writes freshly-claimed slots into this row
+            lane.tokens = np.array(block[-1])
+        return events
+
+    @property
+    def idle(self) -> bool:
+        return self.active == 0 and self.waiting == 0
+
+    # -- warm-up (PR 11 integration) ------------------------------------------
+    def warmup_manifest(self):
+        """The (prefill-bucket x decode-step) program set for this
+        deployment — delegated to ``aot.generation_manifest`` so the
+        serving warm-up and ``manager warmup`` derive the same set."""
+        from analytics_zoo_tpu.inference import aot
+        return aot.generation_manifest(
+            self.gen.prefill_buckets,
+            [lane.bucket for lane in self._lanes],
+            prefill_batches=_pow2_ladder(1, self.gen.max_active_slots),
+            cache_model=self._cache_model)
+
+    def warm(self, manifest=None, progress: Optional[Callable] = None,
+             stop: Optional[Callable[[], bool]] = None) -> Dict:
+        """Compile every scheduler program ahead of traffic.  Same stats
+        document shape as ``aot.warm_up`` so the engine's warm-up thread
+        and ``/readyz`` progress machinery drive either."""
+        from analytics_zoo_tpu.inference import aot
+        if manifest is None:
+            manifest = self.warmup_manifest()
+        before = aot.COMPILE_STATS.snapshot()
+        t0 = time.monotonic()
+        compiled = skipped = failed = 0
+        stopped = False
+        lanes = {lane.bucket: lane for lane in self._lanes}
+        for i, entry in enumerate(manifest):
+            if stop is not None and stop():
+                stopped = True
+                break
+            try:
+                fresh = self._warm_entry(entry, lanes)
+                compiled += 1 if fresh else 0
+                skipped += 0 if fresh else 1
+            except Exception as e:  # noqa: BLE001 — one bad entry must not
+                failed += 1         # strand the set; the live path compiles
+                logger.warning("generate: warm-up entry %s failed (%s: %s)",
+                               entry, type(e).__name__, e)
+            if progress is not None:
+                progress(i + 1, len(manifest), entry)
+        after = aot.COMPILE_STATS.snapshot()
+        return {"programs": len(manifest), "compiled": compiled,
+                "skipped": skipped, "failed": failed, "stopped": stopped,
+                "seconds": round(time.monotonic() - t0, 3),
+                "compile_stats": {k: round(after[k] - before[k], 3)
+                                  for k in after}}
+
+    def _warm_entry(self, entry, lanes: Dict[int, "_Lane"]) -> bool:
+        import jax
+        lane = lanes.get(entry.lane_bucket)
+        if lane is None:
+            raise ValueError(f"no lane with bucket {entry.lane_bucket}")
+        self._ensure_lane_state(lane)
+        prefill, step, insert = self._lane_fns(lane)
+        if entry.kind == "prefill":
+            pb = int(entry.prefill_bucket)
+            bb = int(entry.prefill_batch or 1)
+            key = ("prefill", bb, pb, lane.bucket)
+            fresh = key not in self._programs
+            dummy = np.zeros((bb, pb), np.int32)
+            self._compiled(key, prefill, self._params(), dummy,
+                           np.ones((bb,), np.int32))
+            return fresh
+        if entry.kind == "decode_step":
+            key = ("decode_step", lane.bucket)
+            fresh = key not in self._programs
+            self._compiled(key, step, self._params(), lane.state,
+                           lane.tokens)
+            return fresh
+        if entry.kind == "insert":
+            # insert needs a prefilled sub-state: derive it abstractly so
+            # warming never runs a real prefill
+            bb = int(entry.prefill_batch or 1)
+            key = ("insert", bb, lane.bucket)
+            fresh = key not in self._programs
+            pb = self.gen.prefill_buckets[0]
+            shapes = jax.eval_shape(
+                prefill, self._params(),
+                jax.ShapeDtypeStruct((bb, pb), np.int32),
+                jax.ShapeDtypeStruct((bb,), np.int32))
+            sub_shapes = shapes[0] if self._is_pair(shapes) else shapes
+            sub = jax.tree.map(lambda sd: np.zeros(sd.shape, sd.dtype),
+                               sub_shapes)
+            self._compiled(key, insert, lane.state, sub, np.int32(0),
+                           np.int32(0))
+            return fresh
+        raise ValueError(f"unknown warm-up entry kind {entry.kind!r}")
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> Dict:
+        return {"slots_total": self.slots_total,
+                "active_slots": self.active,
+                "waiting": self.waiting,
+                "decode_steps": self.decode_steps,
+                "generated_tokens": self.generated_tokens,
+                "admitted": self.admitted,
+                "finished": self.finished,
+                "quarantined": self.quarantined,
+                "shed": self.shed,
+                "compiles": self.compiles,
+                "lanes": [{"bucket": lane.bucket,
+                           "max_active": lane.max_active,
+                           "active": lane.active}
+                          for lane in self._lanes]}

@@ -50,7 +50,11 @@ Ingestion gateway (PR 7 tentpole — any client, any language):
   200 on a hit, else **503** with ``Retry-After`` — instead of letting a
   client exhaust gateway threads/FDs with hanging polls.  Error results (quarantine
   / deadline-shed markers) return 200 with the ``{"error": ...}`` body —
-  terminal state, not a gateway failure.
+  terminal state, not a gateway failure.  Generation deployments (PR 12)
+  stream tokens-so-far: a ``{"partial": true, "tokens": [...]}`` result is
+  NOT terminal — the long-poll keeps waiting for the final result and
+  returns the freshest partial at the deadline, so pollers see progress
+  between polls instead of ``{"ready": false}``.
 
 Per-endpoint telemetry rides the engine's PR 4 registry:
 ``gateway_request_seconds{endpoint=}`` and
@@ -292,17 +296,31 @@ class HealthServer:
                                     extra_headers=(("Retry-After", "1"),))
                             return
                     poll = 0.01
+                    partial = None
                     while True:
                         res = serving.queue.get_result(uri)
                         if res is not None:
-                            nbytes = self._reply(200, res)
-                            return
+                            if isinstance(res, dict) and res.get("partial"):
+                                # streaming partial (PR 12 continuous
+                                # batching): tokens-so-far, not terminal —
+                                # keep polling for the final result and
+                                # fall back to the freshest partial at the
+                                # deadline so the long-poll returns
+                                # progress instead of "not yet"
+                                partial = res
+                            else:
+                                nbytes = self._reply(200, res)
+                                return
                         now = time.monotonic()
                         if now >= deadline:
                             break
                         time.sleep(min(poll, deadline - now))
                         poll = min(poll * 1.5, 0.25)
-                    nbytes = self._reply(404, {"ready": False, "uri": uri})
+                    if partial is not None:
+                        nbytes = self._reply(200, partial)
+                    else:
+                        nbytes = self._reply(404,
+                                             {"ready": False, "uri": uri})
                 finally:
                     if parked:
                         gateway._longpoll_slots.release()
@@ -443,6 +461,16 @@ class HealthServer:
                                             {"error": f"'{key}' must be "
                                                       f"a base64 string"})
                                 return
+                        if "gen" in record and \
+                                not isinstance(record["gen"], dict):
+                            # generation options (PR 12): the scheduler
+                            # clamps the VALUES, but the container type is
+                            # checked here so a junk-typed field cannot
+                            # reach the read loop
+                            self._reply(400,
+                                        {"error": "'gen' must be an "
+                                                  "object"})
+                            return
                         if not self._deadline_ok(
                                 record.get("deadline_ns")):
                             self._reply(400,

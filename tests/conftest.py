@@ -64,6 +64,12 @@ def pytest_configure(config):
         "compilation cache, mmap weight store); the spawn-twice test "
         "forks fresh interpreters that re-import jax and compile, so "
         "they carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "generation: continuous-batching generation tests (token-level "
+        "scheduler, step-wise decode, streaming partials); they compile "
+        "per-bucket decode programs and drive live engines, so they "
+        "carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -76,6 +82,7 @@ MULTICHIP_DEFAULT_TIMEOUT_S = 300.0
 WIRE_DEFAULT_TIMEOUT_S = 120.0
 AUTOSCALE_DEFAULT_TIMEOUT_S = 300.0
 COLDSTART_DEFAULT_TIMEOUT_S = 300.0
+GENERATION_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -101,6 +108,8 @@ def pytest_runtest_call(item):
             seconds = AUTOSCALE_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("coldstart") is not None:
             seconds = COLDSTART_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("generation") is not None:
+            seconds = GENERATION_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

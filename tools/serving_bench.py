@@ -432,6 +432,212 @@ def _run_cold_start(args):
     return doc
 
 
+# -- continuous-batching generation A/B (PR 12) -------------------------------
+
+def _gen_requests(args):
+    """The mixed-length generation workload: prompts of varied length and
+    a cycling per-request token budget (short completions dominate, a few
+    long ones) — the regime where static batching wastes most of its
+    decode steps running every row to the batch max."""
+    g = np.random.default_rng(0)
+    budgets = [int(b) for b in args.gen_budgets.split(",") if b.strip()]
+    reqs = []
+    for i in range(args.gen_requests):
+        L = int(g.integers(2, args.gen_prompt_max + 1))
+        prompt = g.integers(0, args.gen_vocab, L).astype(np.float32)
+        reqs.append((f"gen-{i}", prompt, budgets[i % len(budgets)]))
+    return reqs, budgets
+
+
+def _enqueue_gen(queue, rid, prompt, budget):
+    """One generation record: token ids on the f32 tensor wire plus the
+    per-request ``gen`` options dict."""
+    import base64
+    arr = np.ascontiguousarray(np.asarray(prompt, "<f4"))
+    queue.xadd({"uri": rid,
+                "b64": base64.b64encode(arr).decode("ascii"),
+                "dtype": "<f4", "shape": list(arr.shape),
+                "gen": {"max_tokens": int(budget)}})
+
+
+def _run_generate(args):
+    """Continuous-vs-static generation A/B (`--model seq2seq --generate`).
+
+    Continuous: the REAL serving engine with `params.generation` — the
+    token-level scheduler over pow-2-bucketed slots, warmed first so the
+    measured lap performs ZERO XLA compiles (asserted via COMPILE_STATS).
+    Static: the pre-PR-12 batch-in/batch-out shape — fixed request
+    batches, each run through the monolithic `lax.scan` rollout for the
+    batch-max token budget, results only when the whole batch finishes.
+    Both serve identical requests and produce identical useful-token
+    counts; the A/B reports aggregate tokens/sec, TTFT p50/p99 and the
+    steady-state compile count."""
+    import jax
+    from analytics_zoo_tpu.inference import aot
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.seq2seq import Seq2seq
+    from analytics_zoo_tpu.serving.client import OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    model = Seq2seq(vocab_size=args.gen_vocab, embed_dim=args.gen_embed,
+                    hidden_sizes=(args.gen_hidden,))
+    params = model.build(jax.random.PRNGKey(0))
+    im = InferenceModel().do_load_model(model, params, {})
+    reqs, budgets = _gen_requests(args)
+    max_budget = max(budgets)
+    slots = args.gen_slots
+
+    # ---- continuous: the real engine + scheduler --------------------------
+    # ONE live engine serves every continuous lap (steady state: the
+    # compiled program set persists across laps — zero-compile evidence
+    # comes from the post-warm-lap COMPILE_STATS delta)
+    queue = InProcQueue()
+    sp = ServingParams(
+        max_batch=slots, max_wait_ms=1.0,
+        generation={"max_active_slots": slots, "max_tokens": max_budget,
+                    "start_id": 1, "max_prompt_len": args.gen_prompt_max,
+                    "stream_interval": args.gen_stream_interval,
+                    "decode_quantum": args.gen_quantum})
+    cs = ClusterServing(im, queue, sp)
+    warm = cs._batcher.warm()
+    cs.start()
+    oq = OutputQueue(queue)
+
+    def run_continuous(lap):
+        t0 = time.perf_counter()
+        for rid, prompt, budget in reqs:
+            _enqueue_gen(queue, f"L{lap}-{rid}", prompt, budget)
+        res = oq.query_many([f"L{lap}-{r[0]}" for r in reqs],
+                            timeout_s=600.0)
+        wall = time.perf_counter() - t0
+        tokens = 0
+        for rid, prompt, budget in reqs:
+            r = res[f"L{lap}-{rid}"]
+            assert r and "value" in r, \
+                f"lost generation record {rid}: {r}"
+            assert r["value"]["length"] == budget, \
+                f"{rid}: {r['value']['length']} != budget {budget}"
+            tokens += r["value"]["length"]
+        return tokens, wall
+
+    # ---- static: batch-in/batch-out monolithic rollout --------------------
+    # ONE jitted fixed-shape rollout (prompts padded to gen_prompt_max,
+    # scan length = batch-max budget, jit-cached per length) with a warm
+    # lap first, so the baseline pays no mid-lap compiles either — the A/B
+    # isolates SCHEDULING, not compile luck
+    import jax.numpy as jnp
+
+    def _rollout(p, enc, steps):
+        states = model.init_decode(p, enc)
+        tok0 = jnp.full((enc.shape[0],), 1, jnp.int32)
+
+        def body(carry, _):
+            st, tok = carry
+            logits, st2 = model.decode_step(p, st, tok)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (st2, nxt), nxt
+
+        _, toks = jax.lax.scan(body, (states, tok0), None, length=steps)
+        return jnp.swapaxes(toks, 0, 1)
+
+    rollout = jax.jit(_rollout, static_argnums=2)
+
+    def run_static(record_ttft):
+        ttfts = []
+        total = 0
+        t0 = time.perf_counter()
+        for at in range(0, len(reqs), slots):
+            batch = reqs[at:at + slots]
+            P = args.gen_prompt_max
+            enc = np.zeros((slots, P), np.float32)
+            for j, (_, prompt, _) in enumerate(batch):
+                enc[j, :len(prompt)] = prompt
+            steps = max(b for _, _, b in batch)
+            toks = np.asarray(rollout(params, enc, int(steps)))
+            assert toks.shape[1] == steps
+            t_done = time.perf_counter() - t0
+            for _, _, budget in batch:
+                total += min(budget, steps)
+                if record_ttft:
+                    # the whole batch holds until the slowest row: the
+                    # first token a static client SEES arrives at batch
+                    # completion
+                    ttfts.append(t_done)
+        return total, time.perf_counter() - t0, ttfts
+
+    # ---- interleaved laps (the PR 3/7 A/B methodology) --------------------
+    # this container's cpu-shares throttling drifts minute to minute, so
+    # back-to-back phases would compare different machines; interleaving
+    # continuous/static laps and taking per-side MEDIANS compares like
+    # with like
+    run_continuous(0)                      # warm lap (admission-batch mix)
+    run_static(record_ttft=False)          # warm lap: compile the rollout
+    c0 = aot.COMPILE_STATS.snapshot()
+    cont_laps, static_laps = [], []
+    static_ttfts: list = []
+    tokens_lap = None
+    for lap in range(1, max(1, args.gen_laps) + 1):
+        tokens, wall = run_continuous(lap)
+        tokens_lap = tokens
+        cont_laps.append(tokens / wall)
+        s_tokens, s_wall, ttfts = run_static(record_ttft=True)
+        assert s_tokens == tokens, "A/B token counts diverged"
+        static_laps.append(s_tokens / s_wall)
+        static_ttfts = ttfts            # identical laps: keep the last
+    c1 = aot.COMPILE_STATS.snapshot()
+    steady_compiles = int(c1["compile_requests"] - c0["compile_requests"])
+    # the acceptance invariant: after the warm laps, request churn must
+    # never retrace — every (prefill, insert, decode-step) program the
+    # measured laps ran was already compiled
+    assert steady_compiles == 0, \
+        f"steady-state laps performed {steady_compiles} XLA compile(s)"
+    cs.shutdown(drain_s=2.0)
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    static_ttfts.sort()
+
+    def pct(q):
+        return round(1e3 * static_ttfts[min(len(static_ttfts) - 1,
+                                            int(q * len(static_ttfts)))], 1)
+
+    ttft = cs._m_ttft.snapshot()
+    gen_stats = cs._batcher.stats()
+    continuous = {
+        "tokens": tokens_lap,
+        "tokens_per_sec": round(median(cont_laps), 2),
+        "laps_tokens_per_sec": [round(x, 2) for x in cont_laps],
+        "ttft_p50_ms": ttft.get("p50_ms"),
+        "ttft_p99_ms": ttft.get("p99_ms"),
+        "decode_steps": gen_stats["decode_steps"],
+        "warm_programs": warm["programs"],
+        "steady_compile_requests": steady_compiles,
+    }
+    static = {
+        "tokens": tokens_lap,
+        "tokens_per_sec": round(median(static_laps), 2),
+        "laps_tokens_per_sec": [round(x, 2) for x in static_laps],
+        "ttft_p50_ms": pct(0.50),
+        "ttft_p99_ms": pct(0.99),
+    }
+    out = {
+        "mode": "generate",
+        "requests": len(reqs),
+        "budgets": budgets,
+        "slots": slots,
+        "decode_quantum": args.gen_quantum,
+        "continuous": continuous,
+        "static": static,
+        "speedup_tokens_per_sec": round(
+            continuous["tokens_per_sec"] / max(static["tokens_per_sec"],
+                                               1e-9), 2),
+    }
+    return out
+
+
 # -- elastic-serving load-swing A/B (PR 10) -----------------------------------
 
 def _swing_model(max_batch):
@@ -757,7 +963,7 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--depth", type=int, default=50)
-    ap.add_argument("--model", choices=("resnet", "mlp", "bert"),
+    ap.add_argument("--model", choices=("resnet", "mlp", "bert", "seq2seq"),
                     default="resnet",
                     help="resnet: the reference protocol; mlp: a cheap "
                          "classifier over image-sized flat records, for "
@@ -879,6 +1085,44 @@ def main(argv=None):
     ap.add_argument("--cold-max-batch", type=int, default=8,
                     help="cold-start: model bucket ceiling — the warm-up "
                          "set is every (bucket, scales) program up to it")
+    ap.add_argument("--generate", action="store_true",
+                    help="continuous-batching generation A/B (PR 12): the "
+                         "token-level scheduler vs static batch-in/"
+                         "batch-out over a mixed-length workload; use with "
+                         "--model seq2seq.  Reports tokens_per_sec, TTFT "
+                         "p50/p99 and the steady-state compile count for "
+                         "both sides in --json")
+    ap.add_argument("--gen-requests", type=int, default=64,
+                    help="generation A/B: request count")
+    ap.add_argument("--gen-slots", type=int, default=8,
+                    help="generation A/B: decode slots (= the static "
+                         "baseline's batch size)")
+    ap.add_argument("--gen-budgets", default="4,6,8,10,12,16,24,256",
+                    help="generation A/B: cycling per-request max_tokens "
+                         "mixture (comma-separated).  The default is the "
+                         "canonical chat shape — mostly short completions "
+                         "plus one long tail per slot cycle, the regime "
+                         "where one slow decode holds a static batch "
+                         "hostage")
+    ap.add_argument("--gen-prompt-max", type=int, default=24,
+                    help="generation A/B: prompts sampled in [2, MAX]")
+    ap.add_argument("--gen-vocab", type=int, default=2048,
+                    help="generation A/B: vocab size")
+    ap.add_argument("--gen-hidden", type=int, default=256,
+                    help="generation A/B: decoder LSTM width")
+    ap.add_argument("--gen-embed", type=int, default=64,
+                    help="generation A/B: embedding width")
+    ap.add_argument("--gen-stream-interval", type=int, default=8,
+                    help="generation A/B: tokens between partial flushes")
+    ap.add_argument("--gen-quantum", type=int, default=8,
+                    help="generation A/B: decode_quantum — tokens decoded "
+                         "per scheduler boundary (amortizes per-call "
+                         "dispatch on CPU hosts)")
+    ap.add_argument("--gen-laps", type=int, default=3,
+                    help="generation A/B: interleaved continuous/static "
+                         "lap pairs (medians reported) — this container's "
+                         "cpu throttling drifts, so back-to-back phases "
+                         "would compare different machines")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -909,6 +1153,31 @@ def main(argv=None):
         out = _run_cold_start(args)
         print(json.dumps({k: v for k, v in out.items()
                           if k not in ("cold", "warm")}))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
+    if args.generate:
+        if args.model not in ("seq2seq",):
+            ap.error("--generate needs an autoregressive model: "
+                     "--model seq2seq")
+        if args.smoke:
+            # tier-1 smoke: tiny model + short workload — checks the
+            # scheduler end to end, not this container's speed
+            args.gen_requests = min(args.gen_requests, 12)
+            args.gen_budgets = "2,3,6"
+            args.gen_vocab, args.gen_hidden, args.gen_embed = 64, 32, 16
+            args.gen_prompt_max = min(args.gen_prompt_max, 8)
+            args.gen_laps = 1
+        out = _run_generate(args)
+        print(json.dumps(out))
         if args.json_path:
             doc = {"bench": "serving_bench", "ts": time.time(),
                    "config": {k: v for k, v in vars(args).items()
