@@ -24,6 +24,23 @@ metrics + Dapper per-request-trace shape):
   JSON for Perfetto / ``chrome://tracing`` (``tools/trace_view.py``
   summarizes a dump offline).
 
+Fleet-wide distributed tracing (PR 13, the Dapper shape): spans now carry
+``span_id``/``parent_id``/``replica_id``, and a ``SpanContext`` serializes
+to a W3C-style ``traceparent`` string (``00-<trace>-<span>-<flags>``) so a
+trace CROSSES process boundaries — the LB opens the root span and forwards
+the header, the gateway continues it and stamps the context onto the wire
+frame, and every engine stage span parents under it.  Head sampling is a
+pure function of the trace_id (``trace_sampled``) so every process in the
+fleet reaches the same verdict without coordination; error spans are
+always recorded AND kept in a small separate bounded buffer so a burst of
+per-boundary decode spans cannot evict the one quarantine span being
+diagnosed.  ``Tracer.drain_spans()`` is the export hop the per-replica
+spool writers use (``serving/tracecollect.py`` merges spools fleet-wide).
+
+``SloTracker`` attributes each latency-objective violation to its dominant
+pipeline stage (``serving_slo_violations_total{stage=}``) and maintains a
+windowed burn-rate gauge, feeding the fleet metrics merge.
+
 Pure stdlib + numpy-free: safe to import from the client, the queues, and
 the trainer without dragging in jax.
 """
@@ -544,40 +561,178 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def new_span_id() -> str:
+    """64-bit random span id (16 hex chars, the W3C parent-id width)."""
+    return uuid.uuid4().hex[:16]
+
+
+def trace_sampled(trace_id: Optional[str], rate: float) -> bool:
+    """Head-sampling verdict as a PURE function of the trace_id: every
+    process in the fleet (LB, gateway, engine, scheduler) reaches the SAME
+    keep/drop decision for one trace without any coordination or header —
+    hash the id into [0, 1) and compare against the rate.  ``rate >= 1``
+    keeps everything (the fast path serving compiles down to), ``<= 0``
+    drops everything; an unhashable/absent id is kept (better a stray span
+    than a hole in a kept trace)."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    if not trace_id:
+        return True
+    try:
+        h = int(str(trace_id)[-8:], 16)
+    except ValueError:
+        h = -1
+    if h < 0:
+        # non-hex tail, OR a client-controlled id ending in "-hhhhhhh"
+        # (int() accepts a sign, and a negative hash is < every rate —
+        # an always-sampled bypass of the volume cap): hash honestly
+        import zlib
+        h = zlib.crc32(str(trace_id).encode("utf-8")) & 0xFFFFFFFF
+    return (h / float(0x100000000)) < rate
+
+
+class SpanContext:
+    """Propagated trace context (trace_id, span_id, sampled flag) with the
+    W3C ``traceparent`` serialization::
+
+        00-<32-hex trace-id>-<16-hex span-id>-<2-hex flags>
+
+    The platform's 16-hex trace ids are left-padded to the 32-hex W3C
+    field on the wire and stripped back on parse (a genuinely 32-hex
+    foreign id is kept verbatim), so cross-vendor headers interoperate
+    while every in-platform surface keeps the compact id it logs today.
+    ``child()`` mints the next hop's context: same trace, fresh span id,
+    inherited sampling verdict — the minted span_id is the PARENT the next
+    process stamps on its spans."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None, sampled: bool = True):
+        self.trace_id = trace_id or new_trace_id()
+        self.span_id = span_id or new_span_id()
+        self.sampled = bool(sampled)
+
+    def child(self) -> "SpanContext":
+        return SpanContext(self.trace_id, new_span_id(), self.sampled)
+
+    def to_traceparent(self) -> str:
+        flags = 0x01 if self.sampled else 0x00
+        return (f"00-{str(self.trace_id).zfill(32)}-"
+                f"{str(self.span_id).zfill(16)}-{flags:02x}")
+
+    @classmethod
+    def from_traceparent(cls, value) -> Optional["SpanContext"]:
+        """Parse a ``traceparent`` header; None on anything malformed (an
+        untrusted remote header must degrade to a fresh root, never an
+        exception on the ingest path)."""
+        if not isinstance(value, str):
+            return None
+        parts = value.strip().lower().split("-")
+        if len(parts) < 4:
+            return None
+        version, trace, span, flags = parts[0], parts[1], parts[2], parts[3]
+        if len(version) != 2 or len(trace) != 32 or len(span) != 16:
+            return None
+        try:
+            int(version, 16)
+            int(trace, 16)
+            int(span, 16)
+            fl = int(flags[:2], 16)
+        except ValueError:
+            return None
+        if version == "ff" or int(trace, 16) == 0 or int(span, 16) == 0:
+            return None
+        # strip the in-platform left-pad; keep foreign 32-hex ids verbatim
+        if trace.startswith("0" * 16):
+            trace = trace[16:]
+        return cls(trace, span, sampled=bool(fl & 0x01))
+
+
 class Tracer:
     """Bounded ring buffer of spans.  A span is a plain dict:
-    ``{trace_id, uri, stage, ts, dur_s, error?}`` with ``ts`` on the
-    monotonic clock (self-consistent within one process, which is where a
-    trace lives).  ``chrome_trace()`` renders the Perfetto /
-    ``chrome://tracing`` event-list form."""
+    ``{trace_id, uri, stage, ts, dur_s, span_id?, parent_id?, replica_id?,
+    error?, ...attrs}`` with ``ts`` on the monotonic clock
+    (self-consistent within one process; ``serving/tracecollect.py``
+    normalizes across processes via each replica's wall/monotonic clock
+    pair).  ``chrome_trace()`` renders the Perfetto / ``chrome://tracing``
+    event-list form.
 
-    def __init__(self, maxlen: int = 8192):
+    Error spans (quarantine/shed) additionally land in a SMALL separate
+    bounded buffer: under generation load the ring churns at per-boundary
+    decode-span rate and would evict the one rare error span being
+    diagnosed — the side buffer keeps the last ``error_maxlen`` of them
+    alive until the next ``drain_spans()`` regardless of ring pressure."""
+
+    def __init__(self, maxlen: int = 8192, replica_id: Optional[str] = None,
+                 error_maxlen: int = 256):
         self._spans: deque = deque(maxlen=maxlen)
+        # survival buffer for error spans only (see class docstring)
+        self._error_spans: deque = deque(maxlen=error_maxlen)
         self._lock = threading.Lock()
+        self.replica_id = replica_id
 
     new_trace_id = staticmethod(new_trace_id)
+    new_span_id = staticmethod(new_span_id)
 
     def span(self, stage: str, t0_s: float, t1_s: float,
              trace_id: Optional[str] = None, uri=None,
-             error: Optional[str] = None) -> Dict:
+             error: Optional[str] = None,
+             span_id: Optional[str] = None,
+             parent_id: Optional[str] = None,
+             attrs: Optional[Dict] = None) -> Dict:
         s = {"trace_id": trace_id, "uri": uri, "stage": stage,
              "ts": float(t0_s), "dur_s": max(float(t1_s) - float(t0_s), 0.0)}
+        if span_id is not None:
+            s["span_id"] = span_id
+        if parent_id is not None:
+            s["parent_id"] = parent_id
+        if self.replica_id is not None:
+            s["replica_id"] = self.replica_id
+        if attrs:
+            for k, v in attrs.items():
+                s.setdefault(k, v)
         if error is not None:
             s["error"] = str(error)
         with self._lock:
             self._spans.append(s)
+            if error is not None:
+                self._error_spans.append(s)
         return s
+
+    def _merged(self) -> List[Dict]:
+        """Ring + error-buffer spans (lock held by caller): error spans
+        evicted from the ring are appended after it, original order kept
+        within each buffer, duplicates (still in both) reported once."""
+        out = list(self._spans)
+        ring_ids = {id(s) for s in out}
+        out.extend(s for s in self._error_spans if id(s) not in ring_ids)
+        return out
 
     def spans(self, trace_id: Optional[str] = None) -> List[Dict]:
         with self._lock:
-            out = list(self._spans)
+            out = self._merged()
         if trace_id is not None:
             out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def drain_spans(self) -> List[Dict]:
+        """Atomically take every buffered span (ring AND the error side
+        buffer) and clear both — the export hop the per-replica spool
+        writers call (``serving/tracecollect.append_spans``).  Spans
+        recorded concurrently land in the next drain."""
+        with self._lock:
+            out = self._merged()
+            self._spans.clear()
+            self._error_spans.clear()
         return out
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._error_spans.clear()
 
     def stages_for(self, trace_id: str) -> List[str]:
         return [s["stage"] for s in self.spans(trace_id)]
@@ -596,8 +751,11 @@ class Tracer:
                   "dur": round(s["dur_s"] * 1e6, 3),
                   "pid": pid, "tid": tid,
                   "args": {"trace_id": s["trace_id"], "uri": s["uri"]}}
-            if "error" in s:
-                ev["args"]["error"] = s["error"]
+            # PR 13 fields (span/parent ids, replica identity, span attrs
+            # like tokens-emitted) ride in args so Perfetto shows them
+            for k, v in s.items():
+                if k not in ("trace_id", "uri", "stage", "ts", "dur_s"):
+                    ev["args"][k] = v
             events.append(ev)
         meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                  "args": {"name": stage}} for stage, tid in tids.items()]
@@ -636,3 +794,104 @@ class SpanTimer:
         self._tracer.span(self.stage, self._t0, self._clock(),
                           trace_id=self.trace_id, uri=self.uri, error=err)
         return False
+
+
+# -- SLO attribution (PR 13) ---------------------------------------------------
+
+class SloTracker:
+    """Latency-objective bookkeeping for one serving replica: every
+    completed record's end-to-end latency is judged against the objective,
+    a violation is ATTRIBUTED to its dominant pipeline stage
+    (``serving_slo_violations_total{stage=}`` — "we missed the SLO because
+    of queue-wait", not just "we missed"), and a rolling window drives the
+    burn-rate gauge::
+
+        burn = violating fraction over the window / error budget
+
+    where the error budget is ``1 - target`` (target 0.99 -> budget 1%; a
+    burn rate of 1.0 means the budget is being spent exactly as fast as it
+    accrues, >1 means the SLO will be blown).  Counters/gauges land in the
+    registry the engine exports, so the fleet metrics merge aggregates
+    them like every other serving series (burn rate merges as MAX — see
+    ``serving/fleet.py``)."""
+
+    def __init__(self, registry: MetricsRegistry, latency_ms: float,
+                 window_s: float = 60.0, target: float = 0.99):
+        self.latency_ms = float(latency_ms)
+        self.window_s = max(1.0, float(window_s))
+        self.target = min(max(float(target), 0.0), 0.999999)
+        self._m_violations = registry.counter(
+            "serving_slo_violations_total",
+            "Latency-SLO violations, attributed to the dominant stage",
+            labels=("stage",))
+        # materialized at zero for the stages every deployment has, so the
+        # series are scrapeable before the first violation
+        for stage in ("queue_wait", "predict", "write", "pipeline",
+                      "decode"):
+            self._m_violations.labels(stage=stage).inc(0)
+        self._g_burn = registry.gauge(
+            "serving_slo_burn_rate",
+            "Error-budget burn rate over the SLO window "
+            "(1.0 = spending the budget exactly as it accrues)")
+        self._g_burn.set(0.0)
+        self._g_objective = registry.gauge(
+            "serving_slo_latency_objective_ms",
+            "Configured latency objective")
+        self._g_objective.set(self.latency_ms)
+        self._window: deque = deque()      # (monotonic ts, violated: bool)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, registry: MetricsRegistry,
+                    cfg: Optional[Dict]) -> Optional["SloTracker"]:
+        """``serving_slo:`` config block -> tracker (None when absent or
+        unusable): ``{latency_ms: 500, window_s: 60, target: 0.99}``."""
+        if not isinstance(cfg, dict):
+            return None
+        try:
+            latency_ms = float(cfg["latency_ms"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if latency_ms <= 0:
+            return None
+        try:
+            window_s = float(cfg.get("window_s", 60.0))
+            target = float(cfg.get("target", 0.99))
+        except (TypeError, ValueError):
+            window_s, target = 60.0, 0.99
+        return cls(registry, latency_ms, window_s=window_s, target=target)
+
+    def observe(self, e2e_s: float, stages: Optional[Dict] = None,
+                now: Optional[float] = None) -> Optional[str]:
+        """Judge one completed record.  ``stages`` maps stage name ->
+        seconds spent there; on a violation the LARGEST contributor is
+        charged.  Returns the charged stage (None = no violation)."""
+        now = time.monotonic() if now is None else float(now)
+        violated = float(e2e_s) * 1e3 > self.latency_ms
+        charged = None
+        if violated:
+            valid = {k: float(v) for k, v in (stages or {}).items()
+                     if isinstance(v, (int, float)) and v == v and v >= 0}
+            charged = max(valid, key=valid.get) if valid else "unattributed"
+            self._m_violations.labels(stage=charged).inc()
+        with self._lock:
+            self._window.append((now, violated))
+            cutoff = now - self.window_s
+            while self._window and self._window[0][0] < cutoff:
+                self._window.popleft()
+            total = len(self._window)
+            bad = sum(1 for _, v in self._window if v)
+        budget = 1.0 - self.target
+        self._g_burn.set((bad / total) / budget if total else 0.0)
+        return charged
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            total = len(self._window)
+            bad = sum(1 for _, v in self._window if v)
+        return {"latency_ms": self.latency_ms,
+                "window_s": self.window_s,
+                "target": self.target,
+                "window_records": total,
+                "window_violations": bad,
+                "burn_rate": round(self._g_burn.value, 4)}

@@ -50,10 +50,14 @@ def _stamp_deadline(record: Dict, timeout_s: Optional[float]) -> Dict:
     """Wire metadata stamped at enqueue: ``deadline_ns`` (when a budget was
     given) and — PR 4 — a ``trace_id`` riding next to it, so the engine's
     per-stage spans, quarantine errors, and the client's own deadline
-    warnings all correlate on one id."""
+    warnings all correlate on one id.  PR 13 adds the ingest timestamp
+    (``trace_ctx.ts``, wall-clock ns): the engine computes the QUEUE-WAIT
+    span (enqueue -> claim) from it, so native producers get the same
+    latency attribution the HTTP gateway stamps for remote ones."""
     if timeout_s is not None:
         record["deadline_ns"] = time.time_ns() + int(timeout_s * 1e9)
     record.setdefault("trace_id", new_trace_id())
+    record.setdefault("trace_ctx", {"ts": time.time_ns()})
     return record
 
 
@@ -215,7 +219,8 @@ class InputQueue:
                 uri, arr,
                 deadline_ns=record.get("deadline_ns"),
                 trace_id=record["trace_id"],
-                shm_ref=shm_ref)
+                shm_ref=shm_ref,
+                trace_ctx=record.get("trace_ctx"))
             return self._xadd_frame(frame, record["trace_id"])
         if wire != "f32":
             raise ValueError(f"unknown wire format {wire!r} "

@@ -99,8 +99,10 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from analytics_zoo_tpu.common.observability import (MetricsRegistry, Tracer,
-                                                    new_trace_id)
+from analytics_zoo_tpu.common.observability import (MetricsRegistry,
+                                                    SloTracker, SpanContext,
+                                                    Tracer, new_trace_id,
+                                                    trace_sampled)
 from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                  CircuitBreakerOpen,
                                                  RetryPolicy,
@@ -333,7 +335,9 @@ class ServingParams:
                  gateway: bool = True,
                  warmup=False,
                  compile_cache_dir: Optional[str] = None,
-                 generation=None):
+                 generation=None,
+                 trace_sample: float = 1.0,
+                 serving_slo=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -423,6 +427,22 @@ class ServingParams:
         # model must expose init_decode/decode_step.
         self.generation = generation if isinstance(generation, dict) \
             else ({} if generation else None)
+        # fleet-wide distributed tracing (PR 13).  `trace_sample`: HEAD
+        # sampling rate in [0, 1] — the keep/drop verdict is a pure
+        # function of the trace_id (common/observability.trace_sampled),
+        # so the LB, gateway and every replica agree without coordination.
+        # Generation workloads emit per-boundary decode spans, so the
+        # sampling knob exists BEFORE per-token span volume does.  Error
+        # spans (quarantine/shed) are always recorded regardless of rate.
+        try:
+            self.trace_sample = min(max(float(trace_sample), 0.0), 1.0)
+        except (TypeError, ValueError):
+            self.trace_sample = 1.0
+        # SLO attribution (PR 13): {"latency_ms": 500, "window_s": 60,
+        # "target": 0.99} drives serving_slo_violations_total{stage=} and
+        # the serving_slo_burn_rate gauge.  None = off.
+        self.serving_slo = serving_slo if isinstance(serving_slo, dict) \
+            else None
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -470,7 +490,9 @@ class ServingParams:
             gateway=bool(p.get("gateway", True)),
             warmup=p.get("warmup", False),
             compile_cache_dir=p.get("compile_cache_dir"),
-            generation=p.get("generation"))
+            generation=p.get("generation"),
+            trace_sample=p.get("trace_sample", 1.0),
+            serving_slo=p.get("serving_slo"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -547,10 +569,29 @@ class ClusterServing:
         # observability.get_registry() to pool process-wide
         self.registry = registry or MetricsRegistry()
         self.tracer = tracer or Tracer()
+        # fleet tracing (PR 13): every span this replica records names it,
+        # so the fleet-merged timeline attributes work per process
+        if self.tracer.replica_id is None:
+            self.tracer.replica_id = self.replica_id
+        # per-trace propagated context: trace_id -> (parent span id,
+        # sampled flag) parsed from the record's trace_ctx at read.  The
+        # span wrapper consults it so EVERY stage span parents under the
+        # gateway/LB span without threading context through the pipeline
+        # tuples.  Bounded (trimmed oldest-half past the cap).
+        self._trace_meta: Dict[str, Tuple[Optional[str], bool]] = {}
+        # rid -> queue-wait seconds measured at claim (SLO attribution)
+        self._qwait: Dict[str, float] = {}
         # span recording is per-record hot-path work; params.tracing=False
-        # compiles the switch down to a no-op callable
-        self._span = (self.tracer.span if self.params.tracing
+        # compiles the switch down to a no-op callable.  With tracing on,
+        # the wrapper applies head sampling (pure function of trace_id —
+        # fleet-consistent) and the parent lookup; error spans always
+        # record so a sampled-out poisoned record stays diagnosable.
+        self._span = (self._record_span if self.params.tracing
                       else (lambda *a, **kw: None))
+        # SLO attribution (PR 13): judge each completed record against the
+        # configured latency objective, charging the dominant stage
+        self._slo = SloTracker.from_config(self.registry,
+                                           self.params.serving_slo)
         self._t_start = time.monotonic()     # re-stamped by start()
         self._snapshot_seq = itertools.count(1)
         p = self.params
@@ -728,6 +769,78 @@ class ClusterServing:
 
     def _heartbeat_age(self) -> float:
         return time.monotonic() - self._hb_ts
+
+    # -- distributed tracing (PR 13) -----------------------------------------
+    _TRACE_META_CAP = 8192
+
+    def _record_span(self, stage, t0, t1, trace_id=None, uri=None,
+                     error=None, parent_id=None, attrs=None):
+        """The engine's span hop: head sampling + cross-process parenting.
+        Error spans bypass sampling — a quarantine in a sampled-out trace
+        must still be diagnosable (and lands in the tracer's error side
+        buffer either way)."""
+        meta = self._trace_meta.get(trace_id) if trace_id else None
+        if error is None:
+            if meta is not None:
+                if not meta[1]:
+                    return None
+            elif not trace_sampled(trace_id, self.params.trace_sample):
+                return None
+        if parent_id is None and meta is not None:
+            parent_id = meta[0]
+        return self.tracer.span(stage, t0, t1, trace_id=trace_id, uri=uri,
+                                error=error, parent_id=parent_id,
+                                attrs=attrs)
+
+    def _note_trace_ctx(self, rid, rec: Dict, t_claim: float) -> None:
+        """Fold a record's propagated ``trace_ctx`` into this replica:
+        remember (parent span id, sampled) for the span wrapper, and
+        record the QUEUE-WAIT span — gateway/client ingest to this claim,
+        measured as one wall-clock delta so no cross-process clock pair is
+        needed inside the engine.  Absent/malformed context (legacy
+        producers, old frames) degrades to no parent and no queue-wait
+        span, never an error."""
+        tc = rec.get("trace_ctx")
+        if not isinstance(tc, dict):
+            return
+        tid = rec.get("trace_id")
+        ctx = SpanContext.from_traceparent(tc.get("tp"))
+        if ctx is not None:
+            if tid is None:
+                tid = rec["trace_id"] = ctx.trace_id
+            if len(self._trace_meta) >= self._TRACE_META_CAP:
+                for k in list(self._trace_meta)[
+                        : self._TRACE_META_CAP // 2]:
+                    self._trace_meta.pop(k, None)
+            self._trace_meta[tid] = (ctx.span_id, ctx.sampled)
+        ts = tc.get("ts")
+        if isinstance(ts, (int, float)) and 0 < ts < float("inf"):
+            wait_s = max((time.time_ns() - ts) / 1e9, 0.0)
+            # clamp pathological skew (a producer clock far ahead/behind
+            # would paint a day-long queue-wait bar across the timeline)
+            wait_s = min(wait_s, 3600.0)
+            self._qwait[rid] = wait_s
+            if len(self._qwait) > self._TRACE_META_CAP:
+                for k in list(self._qwait)[: self._TRACE_META_CAP // 2]:
+                    self._qwait.pop(k, None)
+            self._span("queue_wait", t_claim - wait_s, t_claim,
+                       trace_id=tid, uri=rid)
+
+    def _slo_observe(self, rid, e2e_s: float,
+                     stages: Optional[Dict] = None) -> None:
+        """Feed one completed record to the SLO tracker (no-op when no
+        ``serving_slo`` block is configured).  Queue-wait measured at
+        claim is folded in both as a stage and into the judged latency,
+        so "we missed the SLO queueing" is attributable."""
+        if self._slo is None:
+            self._qwait.pop(rid, None)
+            return
+        qwait = self._qwait.pop(rid, None)
+        stages = dict(stages or {})
+        if qwait is not None:
+            stages["queue_wait"] = qwait
+            e2e_s = float(e2e_s) + qwait
+        self._slo.observe(e2e_s, stages)
 
     # -- lease lifecycle (PR 5 horizontal replicas) --------------------------
     def _ack(self, rids: List[str]) -> None:
@@ -1196,6 +1309,9 @@ class ClusterServing:
             # in OUR pipeline the reclaim sweep must not mistake it for a
             # dead replica's orphan (cleared on ack)
             self._inflight[rid] = t_read
+            # propagated span context (PR 13): parent/sampled for this
+            # trace + the queue-wait span from the stamped ingest time
+            self._note_trace_ctx(rid, rec, t_read)
             # every record that enters the pipeline gets a trace: producers
             # that bypass the client (raw xadd) are stamped at read instead
             rec.setdefault("trace_id", new_trace_id())
@@ -1384,6 +1500,14 @@ class ClusterServing:
                                  trace_id=tmap.get(rid), uri=rid)
                 try:
                     value = {"value": self.postprocess(np.asarray(row))}
+                    if tmap.get(rid) is not None:
+                        # PR 13: the trace rides the SUCCESS result too
+                        # (error markers and generation finishes already
+                        # carried it) — the gateway's result_poll span and
+                        # the LB's lb_result span join the trace through
+                        # it, closing the client-facing end of the
+                        # reconstructed timeline
+                        value["trace_id"] = tmap[rid]
                     deliveries = self._redelivered.pop(rid, None)
                     if deliveries:
                         # at-least-once made visible: the client can tell a
@@ -1402,6 +1526,15 @@ class ClusterServing:
                                  trace_id=tmap.get(rid), uri=rid)
         if n and inflight.t_read is not None:
             self._e2e.record(now - inflight.t_read, n=n)
+            # SLO attribution (PR 13): per-record stage decomposition —
+            # queue_wait (folded in by _slo_observe), host pipeline
+            # (preprocess + stage wait), device predict, result write
+            t_read = inflight.t_read
+            for rid, _ in pairs:
+                self._slo_observe(rid, now - t_read, {
+                    "pipeline": max(inflight.t_dispatch - t_read, 0.0),
+                    "predict": max(t_done - inflight.t_dispatch, 0.0),
+                    "write": max(now - t_done, 0.0)})
         if n and self._cold_start_s is None:
             # construction-to-serving-capable, the number the autoscaler's
             # actuation lag is made of.  Stamped by whichever comes first:
@@ -1753,13 +1886,22 @@ class ClusterServing:
 
     def _gen_tick(self) -> None:
         """One decode-step boundary + its bookkeeping (stage timer,
-        decode-step counter, tokens/sec window)."""
+        decode-step counter, tokens/sec window, per-boundary decode
+        spans)."""
         b = self._batcher
         t0 = time.monotonic()
         events = b.step()
         now = time.monotonic()
         if b.active or events:
             self._stages["predict"].record(now - t0)
+        # per-boundary decode spans (PR 13): one span per request per
+        # boundary, carrying tokens-emitted — the spans TTFT decomposes
+        # into (prefill -> first boundary -> ...).  This is the per-token
+        # span volume trace_sample exists to govern; the span wrapper
+        # applies the same head-sampling verdict fleet-wide.
+        for rid, tid, emitted in b.last_boundary:
+            self._span("decode", t0, now, trace_id=tid, uri=rid,
+                       attrs={"tokens": emitted})
         steps = b.decode_steps
         if steps > self._last_steps:
             self._m_decode_steps.inc(steps - self._last_steps)
@@ -1789,6 +1931,13 @@ class ClusterServing:
             if ev.kind == "first_token":
                 if ev.ttft_s is not None:
                     self._m_ttft.record(ev.ttft_s)
+                    # prefill span (PR 13): scheduler admission wait +
+                    # prefill program, ending at the first token — the
+                    # hop between queue_wait and the first decode
+                    # boundary in the TTFT decomposition
+                    now0 = time.monotonic()
+                    self._span("prefill", now0 - ev.ttft_s, now0,
+                               trace_id=ev.trace_id, uri=ev.rid)
             elif ev.kind == "partial":
                 value = {"partial": True, "tokens": ev.tokens,
                          "n": len(ev.tokens)}
@@ -1844,6 +1993,12 @@ class ClusterServing:
             self._span("write", now, now, trace_id=ev.trace_id, uri=ev.rid)
             if ev.t_read is not None:
                 self._e2e.record(now - ev.t_read)
+                # SLO attribution: decode wall vs everything else; the
+                # queue-wait measured at claim folds in via _slo_observe
+                stages = {}
+                if ev.wall_s is not None:
+                    stages["decode"] = max(float(ev.wall_s), 0.0)
+                self._slo_observe(ev.rid, now - ev.t_read, stages)
         if n and self._cold_start_s is None:
             self._cold_start_s = now - self._t_construct
             self._g_cold.set(self._cold_start_s)
@@ -1927,6 +2082,10 @@ class ClusterServing:
              "uptime_s": round(time.monotonic() - self._t_start, 3),
              "pid": os.getpid(),
              "snapshot_seq": next(self._snapshot_seq),
+             # wall/monotonic clock pair (PR 13): spans carry monotonic
+             # timestamps; the fleet trace collector normalizes each
+             # replica's spans onto the wall clock through this pair
+             "clock": {"wall": time.time(), "monotonic": time.monotonic()},
              # replica identity + failover counters (PR 5)
              "replica_id": self.replica_id,
              "heartbeat_age_s": round(self._heartbeat_age(), 3),
@@ -1953,6 +2112,11 @@ class ClusterServing:
             # continuous batching (PR 12): slot occupancy + token counters
             # ride the health doc into fleet aggregation
             h["generation"] = self._batcher.stats()
+        if self._slo is not None:
+            # SLO attribution (PR 13): objective + windowed burn rate ride
+            # the health doc so fleet aggregation / FleetSignals can
+            # consume them without a separate scrape
+            h["slo"] = self._slo.snapshot()
         h["ready"] = self._readiness(h)
         return h
 

@@ -39,10 +39,14 @@ import urllib.error
 import urllib.request
 from typing import Dict, Iterable, List, Optional, Tuple
 
-# gauges that report a SHARED resource (the one queue every replica reads):
-# summing them across replicas would multiply the truth by the fleet size
+# gauges that report a SHARED resource (the one queue every replica reads)
+# or a RATIO (the SLO burn rate, PR 13 — summing per-replica burn rates
+# would overstate the fleet's budget spend; the max is the conservative
+# fleet verdict): merged as MAX, never summed
 SHARED_MAX_METRICS = frozenset({"serving_queue_depth",
-                                "serving_dead_letters"})
+                                "serving_dead_letters",
+                                "serving_slo_burn_rate",
+                                "serving_slo_latency_objective_ms"})
 
 
 def read_scale(pidfile: str, default: int = 0) -> int:
@@ -127,6 +131,8 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     alive = 0
     warming = 0                      # replicas still compiling (PR 11)
     cold_start: Optional[float] = None   # slowest measured cold start
+    slo_burn: Optional[float] = None     # worst replica burn rate (PR 13)
+    slo_violations = 0
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -154,6 +160,13 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
         cs = doc.get("cold_start_s")
         if isinstance(cs, (int, float)):
             cold_start = cs if cold_start is None else max(cold_start, cs)
+        slo = doc.get("slo") or {}
+        br = slo.get("burn_rate")
+        if isinstance(br, (int, float)):
+            slo_burn = br if slo_burn is None else max(slo_burn, br)
+        wv = slo.get("window_violations")
+        if isinstance(wv, int):
+            slo_violations += wv
     return {"replicas_total": len(docs),
             "replicas_alive": alive,
             "replicas_warming": warming,
@@ -170,13 +183,21 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
                                           for d in docs.values()),
             "predict_p99_ms": _opt_max(_stage_p99(d, "predict")
                                        for d in docs.values()),
+            # SLO attribution (PR 13): worst replica burn rate + windowed
+            # violation count — the signal a per-model autoscaler
+            # (ROADMAP item 1) will judge overload on
+            "slo_burn_rate": slo_burn,
+            "slo_window_violations": slo_violations,
             "knobs": knobs}
 
 
-def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
+def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
     """``manager metrics --all-replicas`` JSON: the familiar per-engine
     metrics document shape, fleet-wide, plus a per-replica breakdown so an
-    imbalanced fleet is visible at a glance."""
+    imbalanced fleet is visible at a glance.  ``lb`` (PR 13 satellite): the
+    front door's telemetry snapshot (``lb_snapshot``) — its
+    requests/retries/member gauges join the document instead of staying
+    invisible in the supervisor process."""
     agg = aggregate_health(docs)
     per_replica = {}
     for i, doc in sorted(docs.items()):
@@ -200,9 +221,9 @@ def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
         if doc.get("cold_start_s") is not None:
             member["cold_start_s"] = doc["cold_start_s"]
         per_replica[doc.get("replica_id") or f"replica-{i}"] = member
-    return {"replicas": {"total": agg["replicas_total"],
-                         "alive": agg["replicas_alive"],
-                         "warming": agg["replicas_warming"]},
+    out = {"replicas": {"total": agg["replicas_total"],
+                        "alive": agg["replicas_alive"],
+                        "warming": agg["replicas_warming"]},
             "cold_start_s": agg["cold_start_s"],
             "served": agg["served"],
             "quarantined": agg["quarantined"],
@@ -217,7 +238,14 @@ def fleet_metrics(docs: Dict[int, Dict]) -> Dict:
                 (d.get("stages", {}).get("e2e") or {}).get("p50_ms")
                 for d in docs.values()),
                 "p99": agg["e2e_p99_ms"]},
-            "per_replica": per_replica}
+           "per_replica": per_replica}
+    if agg.get("slo_burn_rate") is not None:
+        out["slo"] = {"burn_rate": agg["slo_burn_rate"],
+                      "window_violations": agg["slo_window_violations"]}
+    summary = lb_summary(lb)
+    if summary is not None:
+        out["lb"] = summary
+    return out
 
 
 # -- Prometheus exposition merge ------------------------------------------------
@@ -325,6 +353,55 @@ def merge_prometheus(texts: Iterable[str],
                 sval = repr(float(v))
             out.append(f"{name}{labels} {sval}")
     return "\n".join(out) + "\n"
+
+
+def lb_snapshot(pidfile: str) -> Optional[Dict]:
+    """The LB telemetry snapshot the supervisor persists each pass
+    (``<pidfile>.lb.json``: registry snapshot + Prometheus exposition) —
+    how ``manager metrics --all-replicas`` sees the front door without
+    reaching into the supervisor process."""
+    try:
+        with open(pidfile + ".lb.json") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def lb_summary(snap: Optional[Dict]) -> Optional[Dict]:
+    """Compact LB block for the fleet metrics document (PR 13 satellite):
+    requests by endpoint/code, re-routes, member rotation state — the
+    series that were invisible to the fleet doc while they lived only in
+    the supervisor's in-process registry."""
+    if not isinstance(snap, dict):
+        return None
+    reg = snap.get("snapshot") or {}
+
+    def values(name):
+        return (reg.get(name) or {}).get("values") or []
+
+    requests: Dict[str, float] = {}
+    total = 0.0
+    for v in values("lb_requests_total"):
+        labels = v.get("labels") or {}
+        key = f"{labels.get('endpoint', '?')}:{labels.get('code', '?')}"
+        val = float(v.get("value", 0) or 0)
+        requests[key] = requests.get(key, 0.0) + val
+        total += val
+    retries = sum(float(v.get("value", 0) or 0)
+                  for v in values("lb_retries_total"))
+
+    def gauge(name):
+        vals = values(name)
+        return float(vals[0].get("value", 0) or 0) if vals else None
+
+    return {"url": snap.get("url"),
+            "ts": snap.get("ts"),
+            "requests_total": total,
+            "requests": requests,
+            "retries_total": retries,
+            "members_total": gauge("lb_members_total"),
+            "members_ready": gauge("lb_members_ready")}
 
 
 def autoscaler_snapshot(pidfile: str) -> Optional[Dict]:

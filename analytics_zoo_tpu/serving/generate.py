@@ -265,6 +265,11 @@ class ContinuousBatcher:
                 gen.prefill_buckets[0])
         self._waiting: deque = deque()
         self._waiting_lock = threading.Lock()
+        # per-boundary decode accounting (PR 13 tracing): after each
+        # step(), (rid, trace_id, tokens_emitted_this_boundary) for every
+        # slot that ran a decode step — the engine turns these into the
+        # per-boundary decode spans TTFT decomposition needs
+        self.last_boundary: List[Tuple] = []
         # compiled programs: ("prefill", pb, lane_bucket) |
         # ("decode_step", lane_bucket) | ("insert", lane_bucket)
         self._programs: Dict[tuple, object] = {}
@@ -684,6 +689,7 @@ class ContinuousBatcher:
         Returns the events the engine must act on; an idle scheduler
         returns [] without touching the device."""
         events: List[GenEvent] = []
+        self.last_boundary = []
         self._shed_active(events)
         self._admit(events)
         for lane in self._lanes:
@@ -707,11 +713,18 @@ class ContinuousBatcher:
                         trace_id=info.req.trace_id,
                         ttft_s=info.t_first - info.req.t_submit,
                         t_read=info.req.t_read))
+                n0 = len(info.generated)
                 for k in range(block.shape[0]):
                     self._account_token(lane, slot, info,
                                         int(block[k, slot]), events)
                     if lane.slots[slot] is not info:
                         break      # finished mid-quantum: discard the rest
+                # boundary accounting for the per-boundary decode spans
+                # (valid whether the request finished this boundary or
+                # not — `info` outlives the slot free)
+                self.last_boundary.append(
+                    (info.req.rid, info.req.trace_id,
+                     len(info.generated) - n0))
             # copy: the device block is read-only, and the next boundary's
             # admission writes freshly-claimed slots into this row
             lane.tokens = np.array(block[-1])

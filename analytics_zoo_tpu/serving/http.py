@@ -258,6 +258,53 @@ class HealthServer:
                 except Exception as e:  # noqa: BLE001 — probe must answer
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+            @staticmethod
+            def _trace_state():
+                """(tracer, sample_rate) — None tracer when the serving
+                is a stub without one, or span recording is off."""
+                tracer = getattr(serving, "tracer", None)
+                params = getattr(serving, "params", None)
+                if tracer is None or not getattr(params, "tracing", True):
+                    return None, 0.0
+                try:
+                    rate = float(getattr(params, "trace_sample", 1.0))
+                except (TypeError, ValueError):
+                    rate = 1.0
+                return tracer, rate
+
+            def _result_poll_span(self, t0: float, uri: str, res) -> None:
+                """PR 13: a terminal result fetched through the gateway
+                records a ``result_poll`` span under the record's trace,
+                so the reconstructed timeline covers the client's wait on
+                THIS side of the wire too."""
+                from analytics_zoo_tpu.common.observability import (
+                    SpanContext, trace_sampled)
+                tracer, rate = self._trace_state()
+                if tracer is None or not isinstance(res, dict):
+                    return
+                tid = res.get("trace_id")
+                if not tid:
+                    return
+                # verdict priority: the poll's OWN traceparent (clients
+                # continuing an explicitly-unsampled context must stay
+                # dark even when the poll lands on a replica that never
+                # saw the enqueue — the LB re-route shape), then the
+                # engine's per-trace memory, then the fleet-pure hash
+                inbound = SpanContext.from_traceparent(
+                    self.headers.get("traceparent"))
+                if inbound is not None and inbound.trace_id == tid:
+                    if not inbound.sampled:
+                        return
+                else:
+                    meta = getattr(serving, "_trace_meta", {}).get(tid)
+                    if meta is not None:
+                        if not meta[1]:
+                            return
+                    elif not trace_sampled(tid, rate):
+                        return
+                tracer.span("result_poll", t0, time.monotonic(),
+                            trace_id=tid, uri=uri)
+
             def _get_result(self, parts) -> None:
                 """GET /v1/result/<uri>[?timeout_s=S] — long-poll the
                 result table with backoff; bounded by LONGPOLL_CAP_S, with
@@ -288,6 +335,12 @@ class HealthServer:
                             res = serving.queue.get_result(uri)
                             if res is not None:
                                 nbytes = self._reply(200, res)
+                                if not (isinstance(res, dict)
+                                        and res.get("partial")):
+                                    # overload is exactly when trace-based
+                                    # diagnosis matters: the fast path
+                                    # records the leg too
+                                    self._result_poll_span(t0, uri, res)
                             else:
                                 nbytes = self._reply(
                                     503,
@@ -310,6 +363,7 @@ class HealthServer:
                                 partial = res
                             else:
                                 nbytes = self._reply(200, res)
+                                self._result_poll_span(t0, uri, res)
                                 return
                         now = time.monotonic()
                         if now >= deadline:
@@ -340,9 +394,16 @@ class HealthServer:
             def _enqueue(self, parts) -> None:
                 """POST /v1/enqueue[?timeout_s=S] — binary frame or JSON
                 record, edge validation + admission + trace/deadline
-                stamping."""
-                from analytics_zoo_tpu.common.observability import \
-                    new_trace_id
+                stamping.  PR 13: an inbound ``traceparent`` header (the
+                LB's root span, or any W3C-compliant upstream) is
+                CONTINUED — its trace_id becomes the record's, and the
+                gateway's own span parents under it; either way the
+                propagated context (traceparent naming the gateway span
+                as the engine's parent + the ingest timestamp the
+                queue-wait span is computed from) is stamped into the
+                record / frame header."""
+                from analytics_zoo_tpu.common.observability import (
+                    SpanContext, new_span_id, new_trace_id, trace_sampled)
                 from analytics_zoo_tpu.serving import wire as _wire
                 from analytics_zoo_tpu.serving.queues import (QueueClosed,
                                                               QueueFull)
@@ -377,18 +438,57 @@ class HealthServer:
                              or "").lower()
                     binary = "octet-stream" in ctype \
                         or _wire.is_frame(body)
-                    trace_id = new_trace_id()
+                    # continue an upstream trace (LB root span) when the
+                    # header parses; a malformed traceparent from an
+                    # untrusted client degrades to a fresh root
+                    inbound = SpanContext.from_traceparent(
+                        self.headers.get("traceparent"))
+                    trace_id = inbound.trace_id if inbound is not None \
+                        else new_trace_id()
+                    tracer, sample_rate = self._trace_state()
+                    gw_span = new_span_id()
+
+                    def _sampled_for(tid):
+                        # the inbound verdict is authoritative only for
+                        # the trace it was computed FOR: a client-stamped
+                        # frame id that displaced the LB's root id gets
+                        # its own pure-hash verdict, keeping the
+                        # fleet-consistency invariant at partial rates
+                        if inbound is not None \
+                                and tid == inbound.trace_id:
+                            return inbound.sampled
+                        return trace_sampled(tid, sample_rate)
+
+                    def _mk_ctx(hdr):
+                        # the context names the frame's FINAL trace_id
+                        # (a client-stamped one wins over the gateway's)
+                        tid = hdr.get("trace_id") or trace_id
+                        return {"tp": SpanContext(
+                                    tid, gw_span,
+                                    _sampled_for(tid)).to_traceparent(),
+                                "ts": time.time_ns()}
+
                     if binary:
                         try:
                             # edge validation: a malformed frame is
                             # rejected HERE with the reason, never
                             # enqueued to poison the stream; restamp
                             # issues the ingest trace_id / edge deadline
-                            # without clobbering client-set ones
+                            # / span context without clobbering
+                            # client-set ones
+                            # overwrite_trace_ctx: every frame arriving
+                            # HERE is remote by definition (native
+                            # producers enqueue directly) — a client-
+                            # supplied context would forge the queue-wait
+                            # ingest timestamp (and through it the SLO
+                            # burn the fleet merges as MAX) and
+                            # mis-parent every engine span
                             frame, header = \
                                 _wire.restamp_frame_with_header(
                                     body, trace_id=trace_id,
-                                    deadline_ns=deadline_ns)
+                                    deadline_ns=deadline_ns,
+                                    trace_ctx_fn=_mk_ctx,
+                                    overwrite_trace_ctx=True)
                         except _wire.FrameError as e:
                             self._reply(400, {"error": f"malformed "
                                                        f"frame: {e}"})
@@ -410,6 +510,14 @@ class HealthServer:
                             # can never look up
                             self._reply(400, {"error": "frame uri must "
                                                        "be a string"})
+                            return
+                        if not isinstance(header.get("trace_id"), str):
+                            # a non-str client trace_id splits the trace
+                            # at the LB (its sniffer requires str) and
+                            # flows into results/spans as a junk key
+                            self._reply(400,
+                                        {"error": "frame trace_id must "
+                                                  "be a string"})
                             return
                         record, uri = frame, header["uri"]
                         trace_id = header.get("trace_id", trace_id)
@@ -461,6 +569,15 @@ class HealthServer:
                                             {"error": f"'{key}' must be "
                                                       f"a base64 string"})
                                 return
+                        if "trace_id" in record and \
+                                not isinstance(record["trace_id"], str):
+                            # same edge stance as the frame path: a
+                            # non-str trace_id splits the trace at the
+                            # LB's sniffer and pollutes spans/results
+                            self._reply(400,
+                                        {"error": "'trace_id' must be "
+                                                  "a string"})
+                            return
                         if "gen" in record and \
                                 not isinstance(record["gen"], dict):
                             # generation options (PR 12): the scheduler
@@ -486,6 +603,11 @@ class HealthServer:
                         record["uri"] = str(record["uri"])
                         record.setdefault("trace_id", trace_id)
                         trace_id = record["trace_id"]
+                        # the gateway is the trust edge for the span
+                        # context: overwrite whatever the remote client
+                        # sent (a junk ts would skew queue-wait; a forged
+                        # parent would mis-thread the timeline)
+                        record["trace_ctx"] = _mk_ctx(record)
                         if deadline_ns is not None:
                             record.setdefault("deadline_ns", deadline_ns)
                         uri, deadline_ns = record["uri"], \
@@ -513,6 +635,20 @@ class HealthServer:
                         if deadline_ns is not None:
                             doc["deadline_ns"] = int(deadline_ns)
                         self._reply(200, doc)
+                        # gateway span (PR 13): this replica's ingest hop,
+                        # parented under the LB root when one came in —
+                        # its span id is the parent every engine stage
+                        # span of this record hangs from.  trace_id here
+                        # is the FINAL id (client-stamped wins), so the
+                        # verdict matches what _mk_ctx propagated
+                        if tracer is not None and _sampled_for(trace_id):
+                            tracer.span(
+                                "gateway", t0, time.monotonic(),
+                                trace_id=trace_id, uri=uri,
+                                span_id=gw_span,
+                                parent_id=(inbound.span_id
+                                           if inbound is not None
+                                           else None))
                 finally:
                     gateway._observe("enqueue", t0, length)
 

@@ -52,7 +52,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
-from analytics_zoo_tpu.common.observability import MetricsRegistry
+from analytics_zoo_tpu.common.observability import (MetricsRegistry,
+                                                    SpanContext, Tracer,
+                                                    new_trace_id,
+                                                    trace_sampled)
 from analytics_zoo_tpu.serving.http import LONGPOLL_CAP_S, MAX_BODY_BYTES
 
 logger = logging.getLogger(__name__)
@@ -117,13 +120,29 @@ class LoadBalancer:
                  host: str = "127.0.0.1", port: int = 0,
                  registry: Optional[MetricsRegistry] = None,
                  probe_interval_s: float = 0.5,
-                 probe_timeout_s: float = 1.0):
+                 probe_timeout_s: float = 1.0,
+                 tracer: Optional[Tracer] = None,
+                 trace_sample: float = 1.0,
+                 span_spool: Optional[str] = None):
         self.member_source = member_source
         self.host = host
         self.port = port                    # actual port after start()
         self.registry = registry or MetricsRegistry()
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
+        # fleet tracing (PR 13): the front door opens the ROOT span of
+        # every proxied request and forwards the context as a W3C
+        # `traceparent` header — the gateway continues it, the engine
+        # parents its stage spans under it.  Head sampling uses the same
+        # pure-function verdict as every other process; `span_spool`
+        # names the jsonl file `drain_spans_to_spool()` appends to (the
+        # manager supervisor / standalone CLI call it periodically).
+        self.tracer = tracer or Tracer(replica_id="lb")
+        try:
+            self.trace_sample = min(max(float(trace_sample), 0.0), 1.0)
+        except (TypeError, ValueError):
+            self.trace_sample = 1.0
+        self.span_spool = span_spool
         self._members: Dict[str, _Member] = {}
         self._members_lock = threading.Lock()
         self._rr = 0                        # least-inflight tie-breaker
@@ -206,11 +225,13 @@ class LoadBalancer:
     @staticmethod
     def _forward(member: _Member, method: str, path_qs: str,
                  body: Optional[bytes], ctype: Optional[str],
-                 timeout: float):
+                 timeout: float, headers=()):
         req = urllib.request.Request(member.url + path_qs, data=body,
                                      method=method)
         if ctype:
             req.add_header("Content-Type", ctype)
+        for k, v in headers or ():
+            req.add_header(k, v)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status, resp.read(), resp.headers
@@ -227,7 +248,7 @@ class LoadBalancer:
 
     def _proxy(self, endpoint: str, method: str, path: str, query: str,
                body: Optional[bytes], ctype: Optional[str],
-               deadline: float, retry_503: bool):
+               deadline: float, retry_503: bool, headers=()):
         """Try members until one answers: transport failures and (when
         ``retry_503``) 503s mark the member out and re-route; anything else
         passes through.  A result long-poll's ``timeout_s`` is REWRITTEN to
@@ -264,8 +285,9 @@ class LoadBalancer:
             with member.lock:
                 member.inflight += 1
             try:
-                status, payload, headers = self._forward(
-                    member, method, path_qs, body, ctype, timeout)
+                status, payload, resp_headers = self._forward(
+                    member, method, path_qs, body, ctype, timeout,
+                    headers=headers)
             except _Transport as e:
                 member.mark(False)
                 self._m_retries.labels(endpoint=endpoint).inc()
@@ -278,18 +300,101 @@ class LoadBalancer:
             if status >= 500 or (status == 503 and retry_503):
                 # a 5xx (or a draining member's 503) may succeed elsewhere;
                 # keep the answer in case every member says the same
-                last = (status, payload, headers, attempts)
+                last = (status, payload, resp_headers, attempts)
                 if status == 503:
                     member.mark(False)
                 self._m_retries.labels(endpoint=endpoint).inc()
                 continue
-            return status, payload, headers, attempts
+            return status, payload, resp_headers, attempts
         if last is not None:
             return last
         return (503,
                 json.dumps({"error": "no replica gateway available"})
                 .encode(),
                 {"Retry-After": "1"}, attempts)
+
+    # -- distributed tracing (PR 13) ------------------------------------------
+    _SNIFF_CAP = 262144                    # biggest reply body worth parsing
+
+    @staticmethod
+    def _parse_reply(payload: bytes) -> Optional[Dict]:
+        """Gateway JSON reply body (enqueue ack / result) — how the front
+        door joins its spans to a trace whose id may have been decided
+        downstream (client-stamped frames win over the LB's root id), and
+        how it tells terminal results from streaming partials.
+        Best-effort: non-JSON / oversized bodies just yield None."""
+        if not payload or len(payload) > LoadBalancer._SNIFF_CAP:
+            return None
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    @staticmethod
+    def _sniff_trace_id(payload: bytes) -> Optional[str]:
+        doc = LoadBalancer._parse_reply(payload)
+        tid = doc.get("trace_id") if doc else None
+        return tid if isinstance(tid, str) and tid else None
+
+    def _record_root_span(self, stage: str, t0: float, ctx: SpanContext,
+                          result, uri=None, inbound: bool = False,
+                          parent_id=None) -> None:
+        """The front door's span, IF this trace is sampled.  The verdict:
+        an inbound traceparent's flag is authoritative (the upstream
+        already decided — recording an explicitly-unsampled trace would
+        leave orphan LB-only spans the rest of the fleet dropped);
+        otherwise the fleet-pure hash of the trace's REAL id — sniffed
+        from the reply when it differs from ours (client-stamped frame
+        ids win downstream).  A reply with no sniffable id on a context
+        we minted unsampled (header-less result polls) records nothing:
+        a random id would mint a one-span orphan trace per poll."""
+        if inbound and not ctx.sampled:
+            return
+        if not inbound and self.trace_sample <= 0.0 and not ctx.sampled:
+            return                         # spans fully off: skip the parse
+        status, payload, _, attempts = result
+        doc = self._parse_reply(payload)
+        if stage == "lb_result" and (doc is None or doc.get("partial")):
+            # only a PARSED, terminal result records the lb_result leg: a
+            # streaming partial at the long-poll deadline (PR 12) is not
+            # terminal — a 20-poll token stream must not deposit one
+            # bogus span per poll — and an unparseable/oversized body
+            # cannot be told apart from one, so it records nothing rather
+            # than flood (the gateway-side result_poll span still covers
+            # the terminal fetch)
+            return
+        tid = doc.get("trace_id") if doc else None
+        trace_id = tid if isinstance(tid, str) and tid else ctx.trace_id
+        if not inbound:
+            if trace_id == ctx.trace_id:
+                if not ctx.sampled:
+                    return
+            elif not trace_sampled(trace_id, self.trace_sample):
+                return
+        attrs = {"code": int(status), "attempts": int(attempts)}
+        if attempts > 1:
+            # the retry made visible: a re-routed request's root span says
+            # so, next to the reclaim span the serving replica records
+            attrs["rerouted"] = True
+        # parent: the CALLER's span when it sent a traceparent — the
+        # chain must not break at the fleet edge for clients carrying
+        # their own tracing
+        self.tracer.span(stage, t0, time.monotonic(), trace_id=trace_id,
+                         uri=uri, span_id=ctx.span_id,
+                         parent_id=parent_id, attrs=attrs)
+
+    def drain_spans_to_spool(self) -> int:
+        """Append every buffered span to ``span_spool`` (no-op without
+        one).  Called by the manager supervisor loop and the standalone
+        CLI — the LB's half of the fleet trace collection."""
+        if not self.span_spool:
+            return 0
+        spans = self.tracer.drain_spans()
+        if spans:
+            from analytics_zoo_tpu.serving import tracecollect
+            tracecollect.append_spans(self.span_spool, spans, source="lb")
+        return len(spans)
 
     # -- HTTP surface ---------------------------------------------------------
     def start(self) -> "LoadBalancer":
@@ -378,12 +483,32 @@ class LoadBalancer:
                                          LONGPOLL_CAP_S)
                         except ValueError:
                             budget = 0.0
+                        # result polls JOIN an existing trace (sniffed
+                        # from the terminal reply) — continue an inbound
+                        # context when one came in, otherwise let the
+                        # sniffed trace_id's own sampling verdict decide
+                        inbound = SpanContext.from_traceparent(
+                            self.headers.get("traceparent"))
+                        ctx = inbound.child() if inbound is not None \
+                            else SpanContext(sampled=False)
                         result = lb._proxy(
                             "result", "GET", parts.path, parts.query,
                             None, None,
                             deadline=t0 + budget + RESULT_MARGIN_S,
-                            retry_503=True)
+                            retry_503=True,
+                            headers=[("traceparent",
+                                      ctx.to_traceparent())])
                         self._passthrough(result, "result", t0)
+                        if result[0] == 200:
+                            from urllib.parse import unquote
+                            uri = unquote(
+                                parts.path[len("/v1/result/"):])
+                            lb._record_root_span(
+                                "lb_result", t0, ctx, result, uri=uri,
+                                inbound=inbound is not None,
+                                parent_id=(inbound.span_id
+                                           if inbound is not None
+                                           else None))
                     else:
                         self._reply_json(
                             404, {"error": f"no route {parts.path}"})
@@ -416,11 +541,32 @@ class LoadBalancer:
                         self._observe("enqueue", 413, t0)
                         return
                     body = self.rfile.read(length)
+                    # the ROOT span of the request's trace (PR 13): mint
+                    # trace + span id, decide sampling once (pure function
+                    # of the id — the whole fleet agrees), forward the
+                    # context so the gateway and engine parent under it
+                    inbound = SpanContext.from_traceparent(
+                        self.headers.get("traceparent"))
+                    if inbound is not None:
+                        ctx = inbound.child()
+                    else:
+                        tid = new_trace_id()
+                        ctx = SpanContext(
+                            tid, sampled=trace_sampled(
+                                tid, lb.trace_sample))
                     result = lb._proxy(
                         "enqueue", "POST", parts.path, parts.query,
                         body, self.headers.get("Content-Type"),
-                        deadline=t0 + ENQUEUE_TIMEOUT_S, retry_503=True)
+                        deadline=t0 + ENQUEUE_TIMEOUT_S, retry_503=True,
+                        headers=[("traceparent", ctx.to_traceparent())])
                     self._passthrough(result, "enqueue", t0)
+                    if result[0] == 200:
+                        lb._record_root_span(
+                            "lb_enqueue", t0, ctx, result,
+                            inbound=inbound is not None,
+                            parent_id=(inbound.span_id
+                                       if inbound is not None
+                                       else None))
                 except Exception as e:  # noqa: BLE001
                     self._reply_json(500,
                                      {"error": f"{type(e).__name__}: {e}"})
@@ -469,6 +615,13 @@ def main(argv=None) -> int:
                          "supervisor's scale file + config http_port")
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--probe-interval", type=float, default=0.5)
+    ap.add_argument("--span-spool", default=None, metavar="PATH",
+                    help="append the front door's trace spans to this "
+                         "jsonl spool (fleet trace collection; the "
+                         "manager-run LB spools to <pidfile>.lb.spans."
+                         "jsonl automatically)")
+    ap.add_argument("--trace-sample", type=float, default=1.0,
+                    help="head-sampling rate for the root spans")
     args = ap.parse_args(argv)
     if args.members:
         source = static_members(
@@ -487,12 +640,16 @@ def main(argv=None) -> int:
         source = manager_members(args.pidfile, http_host=params.http_host,
                                  http_port=params.http_port)
     lb = LoadBalancer(source, host=args.host, port=args.port,
-                      probe_interval_s=args.probe_interval).start()
+                      probe_interval_s=args.probe_interval,
+                      trace_sample=args.trace_sample,
+                      span_spool=args.span_spool).start()
     print(json.dumps({"lb": lb.url}), flush=True)
     try:
         while True:
-            time.sleep(3600)
+            time.sleep(1.0)
+            lb.drain_spans_to_spool()
     except KeyboardInterrupt:
+        lb.drain_spans_to_spool()
         lb.stop()
     return 0
 

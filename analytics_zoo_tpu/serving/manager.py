@@ -77,6 +77,24 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # null = <pidfile>.xla_cache
                                         # (created by the manager), a
                                         # path pins it, "off" disables
+      trace_sample: 1.0                 # distributed tracing (PR 13):
+                                        # head-sampling rate in [0, 1] —
+                                        # the keep/drop verdict is a pure
+                                        # function of the trace_id, so
+                                        # LB/gateway/replicas agree
+                                        # without coordination.  Error
+                                        # spans always record.  0
+                                        # disables span volume entirely
+                                        # (metrics stay on).
+      serving_slo: null                 # SLO attribution (PR 13):
+                                        # {latency_ms: 500, window_s: 60,
+                                        # target: 0.99} judges every
+                                        # completed record, attributes
+                                        # each violation to its dominant
+                                        # stage
+                                        # (serving_slo_violations_total)
+                                        # and drives the windowed
+                                        # serving_slo_burn_rate gauge
     autoscaler:                         # closed-loop autoscaling (PR 10),
       slo_p99_ms: 500                   # used with `start --replicas N
       min_replicas: 1                   # --autoscale`; every
@@ -143,7 +161,20 @@ CLI (used by scripts/cluster-serving/*.sh):
         # --prom merges the per-replica text expositions (counters and
         # histogram series sum; shared-queue gauges take the max) and
         # appends the controller's own exposition when the autoscaler is
-        # running.
+        # running, plus (PR 13) the LB front door's own series from
+        # <pidfile>.lb.json.
+    python -m analytics_zoo_tpu.serving.manager trace <trace_id>
+    python -m analytics_zoo_tpu.serving.manager trace --slowest N
+    python -m analytics_zoo_tpu.serving.manager trace --chrome fleet.json
+        # PR 13: fleet-wide trace reconstruction.  Every process spools
+        # its drained spans next to its health snapshot
+        # (<pidfile>.rN.spans.jsonl per replica, <pidfile>.lb.spans.jsonl
+        # for the front door); `trace <id>` merges them — monotonic clocks
+        # normalized per process — and prints one request's cross-process
+        # timeline (lb -> gateway -> queue-wait -> preprocess -> predict
+        # -> write -> result-poll, parented spans, untracked gaps,
+        # errors).  --slowest ranks traces by fleet e2e; --chrome exports
+        # the merged timeline with one Perfetto track per process.
 """
 
 from __future__ import annotations
@@ -357,6 +388,29 @@ def _write_health(serving, path: str) -> None:
         pass
 
 
+def _lb_path(pidfile: str) -> str:
+    """LB telemetry snapshot (PR 13): the supervisor persists the front
+    door's registry snapshot + Prometheus exposition here each pass, so
+    ``manager metrics --all-replicas`` can include the LB's own series
+    (lb_requests_total / lb_retries_total were otherwise invisible to the
+    fleet doc)."""
+    return pidfile + ".lb.json"
+
+
+def _drain_spans(serving, pidfile: str) -> None:
+    """Span spool hop (PR 13): drain this replica's tracer ring into the
+    per-replica spool next to the health snapshot.  Best-effort — a full
+    disk must not kill the serving loop."""
+    try:
+        from analytics_zoo_tpu.serving import tracecollect
+        spans = serving.tracer.drain_spans()
+        if spans:
+            tracecollect.append_spans(tracecollect.spool_path(pidfile),
+                                      spans, source=serving.replica_id)
+    except Exception:  # noqa: BLE001 — tracing is never load-bearing
+        pass
+
+
 def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
                     http_port_offset: int = 0,
@@ -386,8 +440,11 @@ def _run_foreground(config_path: str, pidfile: str,
     def _terminate(signum, frame):
         # ClusterServingManager.listenTermination analog: graceful drain
         # (admission closed, /readyz flips to draining, in-flight results
-        # flushed within params.drain_s) + exit
+        # flushed within params.drain_s) + exit.  Spans recorded during
+        # the drain (final writes, sheds) flush to the spool last — the
+        # spool survives the process for post-mortem `manager trace`.
         serving.shutdown(drain_s=serving.params.drain_s)
+        _drain_spans(serving, pidfile)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -404,6 +461,7 @@ def _run_foreground(config_path: str, pidfile: str,
         # left the whole fleet rejecting enqueues.)
         serving.shutdown(drain_s=serving.params.drain_s,
                          close_admission=False)
+        _drain_spans(serving, pidfile)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -418,6 +476,10 @@ def _run_foreground(config_path: str, pidfile: str,
     serving.start()
     while True:
         _write_health(serving, health_path)
+        # fleet tracing (PR 13): the replica's export hop — drained spans
+        # land in <pidfile>.spans.jsonl, merged fleet-wide by
+        # `manager trace` / tools/trace_view.py
+        _drain_spans(serving, pidfile)
         # live knob nudges (PR 10 autoscaler fast tier): the supervisor's
         # autoscaler writes <base pidfile>.knobs.json; every replica polls
         # it once a second and applies via retune() — validated, and taken
@@ -530,10 +592,13 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
     if lb_port is not None:
         from analytics_zoo_tpu.serving.lb import (LoadBalancer,
                                                   manager_members)
+        from analytics_zoo_tpu.serving.tracecollect import spool_path
         balancer = LoadBalancer(
             manager_members(pidfile, http_host=params.http_host,
                             http_port=params.http_port),
-            host=params.http_host, port=lb_port).start()
+            host=params.http_host, port=lb_port,
+            trace_sample=params.trace_sample,
+            span_spool=spool_path(pidfile + ".lb")).start()
 
     def _spawn(index: int):
         last_spawn[index] = time.monotonic()
@@ -572,6 +637,10 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
         if scaler is not None:
             scaler.stop()
         if balancer is not None:
+            try:
+                balancer.drain_spans_to_spool()
+            except Exception:  # noqa: BLE001
+                pass
             balancer.stop()
         for index in list(children):
             for p in (_replica_pidfile(pidfile, index),
@@ -581,11 +650,13 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                 except OSError:
                     pass
         for p in (pidfile, scale_path, _knobs_path(pidfile),
-                  _autoscaler_path(pidfile)):
+                  _autoscaler_path(pidfile), _lb_path(pidfile)):
             try:
                 os.unlink(p)
             except OSError:
                 pass
+        # span spools deliberately survive shutdown: `manager trace` is a
+        # post-mortem tool as much as a live one
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, _terminate)
@@ -639,6 +710,26 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                 os.replace(snap_path + ".tmp", snap_path)
             except OSError:
                 pass
+        if balancer is not None:
+            # PR 13: the front door's half of fleet observability — its
+            # root spans to the LB spool, its registry (lb_requests_total
+            # / lb_retries_total / member gauges + exposition) to
+            # <pidfile>.lb.json so `manager metrics --all-replicas`
+            # includes the LB instead of leaving it invisible
+            try:
+                balancer.drain_spans_to_spool()
+            except Exception:  # noqa: BLE001 — never load-bearing
+                pass
+            try:
+                lb_path = _lb_path(pidfile)
+                with open(lb_path + ".tmp", "w") as f:
+                    json.dump({"url": balancer.url, "ts": time.time(),
+                               "snapshot": balancer.registry.snapshot(),
+                               "prom": balancer.registry.to_prometheus()},
+                              f)
+                os.replace(lb_path + ".tmp", lb_path)
+            except (OSError, TypeError, ValueError):
+                pass
         time.sleep(0.5)
 
 
@@ -647,9 +738,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="cluster-serving")
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
-                             "replay", "metrics", "scale", "warmup"])
+                             "replay", "metrics", "scale", "warmup",
+                             "trace"])
     ap.add_argument("value", nargs="?", default=None,
-                    help="scale: target replica count")
+                    help="scale: target replica count; trace: the "
+                         "trace_id to reconstruct")
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
@@ -682,6 +775,13 @@ def main(argv=None):
                     help="start --replicas: skip the supervisor's "
                          "throwaway warm-up pass (replicas then compile "
                          "for themselves on first boot)")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="trace: rank the N slowest traces fleet-wide "
+                         "instead of reconstructing one")
+    ap.add_argument("--chrome", default=None, metavar="PATH",
+                    help="trace: export the merged fleet timeline as "
+                         "Chrome trace-event JSON (one track per "
+                         "process) for Perfetto")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -747,6 +847,51 @@ def main(argv=None):
                           "load_seconds": im.load_seconds,
                           "load_mmap": im.load_mmap, **stats}))
         return 0 if stats["failed"] == 0 else 1
+    if args.action == "trace":
+        # fleet-wide trace reconstruction (PR 13): merge every span spool
+        # of the deployment (per-replica + LB, written next to the health
+        # snapshots), normalize each process's monotonic clock onto the
+        # wall clock, and either reconstruct ONE request's cross-process
+        # timeline, rank the slowest traces, or export the whole timeline
+        # as Chrome trace-event JSON.
+        from analytics_zoo_tpu.serving import fleet as _fleet
+        from analytics_zoo_tpu.serving import tracecollect
+        try:
+            params = serving_params(load_config(args.config))
+        except OSError:
+            params = ServingParams()
+        count = _fleet.read_scale(args.pidfile)
+        docs = _fleet.replica_docs(
+            args.pidfile, http_host=params.http_host,
+            http_port=params.http_port, count=count) if count else {}
+        by_rid = {str(d.get("replica_id") or f"replica-{i}"): d
+                  for i, d in docs.items()}
+        spans = tracecollect.collect(args.pidfile, health_docs=by_rid)
+        if not spans:
+            print(json.dumps(
+                {"error": "no span spools found (nothing matching "
+                          f"{args.pidfile}*.spans.jsonl — is tracing on "
+                          "and the deployment running/ran?)"}),
+                file=sys.stderr)
+            return 1
+        if args.chrome:
+            tracecollect.export_chrome_trace(spans, args.chrome)
+            print(json.dumps({"chrome_trace": args.chrome,
+                              "spans": len(spans)}))
+            return 0
+        if args.slowest is not None:
+            print(json.dumps(
+                {"slowest": tracecollect.slowest(spans, args.slowest),
+                 "spans": len(spans)}))
+            return 0
+        if not args.value:
+            print(json.dumps({"error": "pass a trace_id (or --slowest N "
+                                       "/ --chrome PATH)"}),
+                  file=sys.stderr)
+            return 1
+        doc = tracecollect.reconstruct(spans, args.value)
+        print(json.dumps(doc))
+        return 0 if doc.get("found") else 1
     if args.action == "metrics":
         # live metrics snapshot (PR 4).  Preferred source: the daemon's own
         # /metrics endpoint (exactly what a scraper sees, including
@@ -777,6 +922,12 @@ def main(argv=None):
                 asnap = _fleet.autoscaler_snapshot(args.pidfile)
                 if asnap and asnap.get("prom"):
                     out += asnap["prom"]   # controller series ride along
+                lbsnap = _fleet.lb_snapshot(args.pidfile)
+                if lbsnap and lbsnap.get("prom"):
+                    # PR 13 satellite: the front door's own exposition
+                    # (lb_requests_total / lb_retries_total / member
+                    # gauges) joins the fleet scrape
+                    out += lbsnap["prom"]
                 print(out, end="")
                 return 0
             docs = _fleet.replica_docs(args.pidfile,
@@ -789,7 +940,8 @@ def main(argv=None):
                               "--replicas deployment, or none written "
                               "yet)"}), file=sys.stderr)
                 return 1
-            doc = _fleet.fleet_metrics(docs)
+            doc = _fleet.fleet_metrics(docs,
+                                       lb=_fleet.lb_snapshot(args.pidfile))
             asnap = _fleet.autoscaler_snapshot(args.pidfile)
             if asnap:
                 doc["autoscaler"] = {

@@ -1,0 +1,320 @@
+"""Fleet-wide trace collection (PR 13 tentpole).
+
+Each process in the serving fleet — the LB front door, every replica
+(whose gateway shares its process), the generation scheduler — records
+spans into its own in-process ``Tracer`` ring on the MONOTONIC clock.
+This module is the collection half of the Dapper shape:
+
+- **spools** — every replica's manager loop periodically calls
+  ``Tracer.drain_spans()`` and appends the result here
+  (``append_spans``), one jsonl file per process next to its health
+  snapshot: ``<pidfile>.rN.spans.jsonl`` per replica,
+  ``<pidfile>.lb.spans.jsonl`` for the front door.  Each drain batch is
+  preceded by a CLOCK record (``{"kind": "clock", "wall": ..., "mono":
+  ...}``) captured at the drain, so the spool is self-describing.
+- **merge** — ``merge_spools`` loads every spool, normalizes each span's
+  monotonic ``ts`` onto the wall clock through the nearest preceding
+  clock record (falling back to a health-doc ``clock`` pair when a
+  legacy spool carries none), and returns one flat span list with
+  ``ts_wall`` (epoch seconds) per span.  Same-host processes share the
+  wall clock, so after normalization spans from different processes
+  order correctly on one timeline.
+- **reconstruction** — ``reconstruct(spans, trace_id)`` is the `manager
+  trace <id>` document: the request's spans across every process, time-
+  offset from the trace start, with parent links, per-process
+  attribution, the e2e wall span, and the untracked gaps (queue
+  residency, cross-process handoffs).  ``slowest(spans, n)`` ranks
+  traces by e2e.  ``chrome_trace(spans)`` renders the fleet timeline
+  with ONE pid/track per process for Perfetto.
+
+Pure stdlib: importable from the manager CLI and ``tools/trace_view.py``
+without dragging in jax or numpy.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# one-generation rotation cap per spool file: a week-long deployment must
+# not grow an unbounded span log next to its pidfile
+SPOOL_MAX_BYTES = 8 * 1024 * 1024
+
+
+def spool_path(pidfile: str) -> str:
+    """The span spool owned by the process whose pidfile this is (replica
+    pidfiles are ``<base>.rN``, so the per-replica spool lands at
+    ``<base>.rN.spans.jsonl`` — globbable from the base)."""
+    return pidfile + ".spans.jsonl"
+
+
+def find_spools(pidfile: str) -> List[str]:
+    """Every span spool of a deployment: the daemon's own, each
+    replica's, and the LB's — anything matching ``<pidfile>*`` with the
+    spool suffix (rotated ``.1`` generations included)."""
+    out = sorted(set(glob.glob(pidfile + "*.spans.jsonl")
+                     + glob.glob(pidfile + "*.spans.jsonl.1")))
+    return out
+
+
+def append_spans(path: str, spans: Iterable[Dict],
+                 source: Optional[str] = None,
+                 max_bytes: int = SPOOL_MAX_BYTES) -> int:
+    """Append one drain batch: a clock record (wall/monotonic pair
+    captured NOW, i.e. at the drain — the offset the merge uses for every
+    span in the batch) followed by the spans.  The file rotates once to
+    ``.1`` past ``max_bytes`` so a long-lived replica cannot fill the
+    disk.  Returns the number of spans written."""
+    spans = list(spans)
+    if not spans:
+        return 0
+    try:
+        if max_bytes and os.path.exists(path) \
+                and os.path.getsize(path) > max_bytes:
+            os.replace(path, path + ".1")
+    except OSError:
+        pass
+    clock = {"kind": "clock", "wall": time.time(),
+             "mono": time.monotonic()}
+    if source is not None:
+        clock["source"] = source
+    lines = [json.dumps(clock)]
+    for s in spans:
+        rec = {"kind": "span"}
+        rec.update(s)
+        if source is not None:
+            rec.setdefault("replica_id", source)
+        try:
+            lines.append(json.dumps(rec))
+        except (TypeError, ValueError):
+            # a span smuggling a non-JSON attr must not kill the batch
+            lines.append(json.dumps(
+                {k: v for k, v in rec.items()
+                 if isinstance(v, (str, int, float, bool, type(None)))}))
+    with open(path, "a") as f:
+        f.write("\n".join(lines) + "\n")
+    return len(spans)
+
+
+def load_spool(path: str) -> List[Dict]:
+    """Every record (clock + span) of one spool, malformed lines
+    skipped."""
+    out: List[Dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _doc_clock(doc: Optional[Dict]) -> Optional[Tuple[float, float]]:
+    """(wall, mono) out of a health document's ``clock`` block."""
+    if not isinstance(doc, dict):
+        return None
+    c = doc.get("clock")
+    try:
+        return float(c["wall"]), float(c["monotonic"])
+    except (TypeError, KeyError, ValueError):
+        return None
+
+
+def merge_spools(paths: Iterable[str],
+                 health_docs: Optional[Dict[str, Dict]] = None
+                 ) -> List[Dict]:
+    """One flat fleet span list, every span stamped with ``ts_wall``
+    (epoch seconds) via the nearest PRECEDING clock record of its spool —
+    the drain writes the pair at the same instant as the batch, so the
+    offset is exact for that batch even across replica restarts (each
+    boot's monotonic epoch differs, which is exactly why a single static
+    offset would be wrong).
+
+    ``health_docs`` maps replica_id -> health document; a legacy spool
+    with no clock records falls back to its replica's health-doc
+    wall/monotonic pair, and a span with no clock at all keeps its raw
+    ``ts`` with ``clock_skewed: true`` so downstream consumers can warn
+    instead of silently mis-ordering it."""
+    by_replica_clock: Dict[str, Tuple[float, float]] = {}
+    for rid, doc in (health_docs or {}).items():
+        pair = _doc_clock(doc)
+        if pair is not None:
+            by_replica_clock[str(rid)] = pair
+    merged: List[Dict] = []
+    for path in paths:
+        offset: Optional[float] = None
+        for rec in load_spool(path):
+            if rec.get("kind") == "clock":
+                try:
+                    offset = float(rec["wall"]) - float(rec["mono"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+                continue
+            if rec.get("kind") not in (None, "span"):
+                continue
+            span = {k: v for k, v in rec.items() if k != "kind"}
+            off = offset
+            if off is None:
+                pair = by_replica_clock.get(
+                    str(span.get("replica_id") or ""))
+                if pair is not None:
+                    off = pair[0] - pair[1]
+            try:
+                ts = float(span.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if off is not None:
+                span["ts_wall"] = ts + off
+            else:
+                span["ts_wall"] = ts
+                span["clock_skewed"] = True
+            merged.append(span)
+    merged.sort(key=lambda s: s.get("ts_wall", 0.0))
+    return merged
+
+
+# -- reconstruction -------------------------------------------------------------
+
+def _span_source(span: Dict) -> str:
+    return str(span.get("replica_id") or "unknown")
+
+
+def traces_in(spans: Iterable[Dict]) -> Dict[str, List[Dict]]:
+    out: Dict[str, List[Dict]] = {}
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid:
+            out.setdefault(str(tid), []).append(s)
+    return out
+
+
+def reconstruct(spans: Iterable[Dict], trace_id: str) -> Dict:
+    """The ``manager trace <id>`` document: one request's timeline across
+    every process.  Spans are offset from the trace start (``t_ms``),
+    ordered, parent-linked, and the gaps between consecutive spans are
+    listed with a ``cross_process`` flag — the handoff costs (queue
+    residency, LB->gateway hop) that no single process's ring can see."""
+    mine = [s for s in spans if str(s.get("trace_id")) == str(trace_id)]
+    if not mine:
+        return {"trace_id": trace_id, "spans": 0, "found": False}
+    mine.sort(key=lambda s: s.get("ts_wall", 0.0))
+    t0 = min(s["ts_wall"] for s in mine)
+    t1 = max(s["ts_wall"] + float(s.get("dur_s", 0.0)) for s in mine)
+    timeline = []
+    for s in mine:
+        entry = {"t_ms": round((s["ts_wall"] - t0) * 1e3, 3),
+                 "dur_ms": round(float(s.get("dur_s", 0.0)) * 1e3, 3),
+                 "stage": s.get("stage"),
+                 "process": _span_source(s),
+                 "uri": s.get("uri")}
+        for key in ("span_id", "parent_id", "error", "tokens",
+                    "attempts", "rerouted", "code", "clock_skewed"):
+            if s.get(key) is not None:
+                entry[key] = s[key]
+        timeline.append(entry)
+    gaps = []
+    for prev, nxt in zip(mine, mine[1:]):
+        gap = nxt["ts_wall"] - (prev["ts_wall"]
+                                + float(prev.get("dur_s", 0.0)))
+        if gap > 0:
+            gaps.append({
+                "after": prev.get("stage"),
+                "before": nxt.get("stage"),
+                "gap_ms": round(gap * 1e3, 3),
+                "cross_process":
+                    _span_source(prev) != _span_source(nxt)})
+    stages: Dict[str, float] = {}
+    for s in mine:
+        st = str(s.get("stage"))
+        stages[st] = stages.get(st, 0.0) + float(s.get("dur_s", 0.0)) * 1e3
+    return {"trace_id": trace_id,
+            "found": True,
+            "spans": len(mine),
+            "processes": sorted({_span_source(s) for s in mine}),
+            "e2e_ms": round((t1 - t0) * 1e3, 3),
+            "stages_ms": {k: round(v, 3) for k, v in stages.items()},
+            "untracked_ms": round(sum(g["gap_ms"] for g in gaps), 3),
+            "errors": [s["error"] for s in mine if s.get("error")],
+            "timeline": timeline,
+            "gaps": gaps}
+
+
+def slowest(spans: Iterable[Dict], n: int = 5) -> List[Dict]:
+    """Top-N traces by fleet-wide e2e (first span start to last span
+    end) — each entry a summary; feed the trace_id back to
+    ``reconstruct`` for the full timeline."""
+    out = []
+    for tid, mine in traces_in(spans).items():
+        t0 = min(s.get("ts_wall", 0.0) for s in mine)
+        t1 = max(s.get("ts_wall", 0.0) + float(s.get("dur_s", 0.0))
+                 for s in mine)
+        out.append({
+            "trace_id": tid,
+            "e2e_ms": round((t1 - t0) * 1e3, 3),
+            "spans": len(mine),
+            "processes": sorted({_span_source(s) for s in mine}),
+            "uri": next((s.get("uri") for s in mine
+                         if s.get("uri") is not None), None),
+            "error": next((s.get("error") for s in mine
+                           if s.get("error")), None)})
+    out.sort(key=lambda t: -t["e2e_ms"])
+    return out[: max(0, int(n))]
+
+
+def chrome_trace(spans: Iterable[Dict]) -> Dict:
+    """Fleet Chrome trace-event JSON: one pid (track group) per PROCESS —
+    lb / replica-0 / replica-1 ... — one tid per stage inside it, so
+    Perfetto lays the request out as the cross-process waterfall it is."""
+    pids: Dict[str, int] = {}
+    tids: Dict[Tuple[str, str], int] = {}
+    events: List[Dict] = []
+    meta: List[Dict] = []
+    for s in spans:
+        src = _span_source(s)
+        pid = pids.get(src)
+        if pid is None:
+            pid = pids[src] = len(pids) + 1
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0, "args": {"name": src}})
+        key = (src, str(s.get("stage")))
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = sum(1 for k in tids if k[0] == src) + 1
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": key[1]}})
+        ev = {"name": str(s.get("stage")), "cat": "serving", "ph": "X",
+              "ts": round(float(s.get("ts_wall", s.get("ts", 0.0)))
+                          * 1e6, 3),
+              "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
+              "pid": pid, "tid": tid,
+              "args": {k: v for k, v in s.items()
+                       if k not in ("stage", "ts", "ts_wall", "dur_s")}}
+        events.append(ev)
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(spans: Iterable[Dict], path: str) -> str:
+    doc = chrome_trace(spans)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def collect(pidfile: str,
+            health_docs: Optional[Dict[str, Dict]] = None) -> List[Dict]:
+    """The one-call fleet merge the CLI uses: find every spool of the
+    deployment, merge, normalize."""
+    return merge_spools(find_spools(pidfile), health_docs=health_docs)

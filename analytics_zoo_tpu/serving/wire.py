@@ -69,9 +69,15 @@ _PREFIX = struct.Struct("<2sBBII")         # magic, version, flags, hlen,
 PREFIX_LEN = _PREFIX.size                  # plen — 12 bytes
 
 # header keys are SHORT on the wire, expanded at decode: every byte of
-# per-record overhead eats into the 33% base64 inflation this wire removes
+# per-record overhead eats into the 33% base64 inflation this wire removes.
+# "tc" (trace_ctx, PR 13) carries the propagated span context — the
+# gateway's traceparent + ingest timestamp ({"tp": str, "ts": ns}) — and is
+# VERSION-COMPATIBLE both ways: old frames simply lack the key, and an old
+# decoder passes the unexpanded "tc" through untouched (the engine only
+# acts on "trace_ctx")
 _SHORT = {"uri": "u", "trace_id": "t", "deadline_ns": "d", "dtype": "dt",
-          "shape": "s", "scale": "sc", "shm": "sm", "meta": "m"}
+          "shape": "s", "scale": "sc", "shm": "sm", "meta": "m",
+          "trace_ctx": "tc"}
 _LONG = {v: k for k, v in _SHORT.items()}
 
 # wire-format tags used for metrics labels and bench A/Bs
@@ -162,7 +168,8 @@ def encode_tensor_frame(uri: str, arr: np.ndarray,
                         deadline_ns: Optional[int] = None,
                         trace_id: Optional[str] = None,
                         shm_ref: Optional[Dict] = None,
-                        meta: Optional[Dict] = None) -> bytes:
+                        meta: Optional[Dict] = None,
+                        trace_ctx: Optional[Dict] = None) -> bytes:
     """One tensor record as a binary frame.  ``arr`` must already be
     contiguous little-endian (the client normalizes before calling); with
     ``shm_ref`` the payload stays in its shm slot and the frame carries only
@@ -183,6 +190,8 @@ def encode_tensor_frame(uri: str, arr: np.ndarray,
         header["deadline_ns"] = int(deadline_ns)
     if trace_id is not None:
         header["trace_id"] = str(trace_id)
+    if trace_ctx:
+        header["trace_ctx"] = dict(trace_ctx)
     if meta:
         header["meta"] = meta
     if shm_ref is not None:
@@ -291,10 +300,22 @@ def restamp_frame(buf, trace_id: Optional[str] = None,
 
 def restamp_frame_with_header(
         buf, trace_id: Optional[str] = None,
-        deadline_ns: Optional[int] = None) -> Tuple[bytes, Dict]:
+        deadline_ns: Optional[int] = None,
+        trace_ctx_fn=None,
+        overwrite_trace_ctx: bool = False) -> Tuple[bytes, Dict]:
     """``restamp_frame`` plus the (post-stamp) decoded header, so a caller
     that needs both — the gateway reads back uri/trace_id/deadline for its
-    reply — pays ONE header parse instead of re-decoding the result."""
+    reply — pays ONE header parse instead of re-decoding the result.
+
+    ``trace_ctx_fn`` (PR 13): called with the post-stamp header to produce
+    the propagated span context to stamp.  A callable (not a value)
+    because the context must name the frame's FINAL trace_id — which may
+    be the client's own, only known after the stamp.  By default a
+    context already present is kept (native producers re-framing);
+    ``overwrite_trace_ctx=True`` REPLACES it — the gateway is the trust
+    edge for remote frames, where a client-supplied context would forge
+    the queue-wait ingest timestamp (and through it the SLO attribution)
+    and mis-parent every engine span."""
     flags, header, payload = decode_frame(buf)
     changed = False
     if trace_id is not None and "trace_id" not in header:
@@ -303,6 +324,13 @@ def restamp_frame_with_header(
     if deadline_ns is not None and "deadline_ns" not in header:
         header["deadline_ns"] = int(deadline_ns)
         changed = True
+    if trace_ctx_fn is not None and (overwrite_trace_ctx
+                                     or "trace_ctx" not in header):
+        ctx = trace_ctx_fn(header)
+        if isinstance(ctx, dict) and ctx \
+                and ctx != header.get("trace_ctx"):
+            header["trace_ctx"] = ctx
+            changed = True
     if not changed:
         return (bytes(buf) if not isinstance(buf, bytes) else buf), header
     return encode_frame(header, payload=payload, flags=flags), header

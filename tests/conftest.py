@@ -70,6 +70,14 @@ def pytest_configure(config):
         "scheduler, step-wise decode, streaming partials); they compile "
         "per-bucket decode programs and drive live engines, so they "
         "carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "tracing: fleet-wide distributed-tracing tests (span propagation "
+        "across LB/gateway/engine, spool merge, SLO attribution); the "
+        "cross-process ones spawn replica subprocesses and long-poll "
+        "through the front door, so they carry a default 120 s SIGALRM "
+        "budget (subprocess-heavy ones raise it with an explicit "
+        "timeout mark)")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -83,6 +91,7 @@ WIRE_DEFAULT_TIMEOUT_S = 120.0
 AUTOSCALE_DEFAULT_TIMEOUT_S = 300.0
 COLDSTART_DEFAULT_TIMEOUT_S = 300.0
 GENERATION_DEFAULT_TIMEOUT_S = 300.0
+TRACING_DEFAULT_TIMEOUT_S = 120.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -110,6 +119,8 @@ def pytest_runtest_call(item):
             seconds = COLDSTART_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("generation") is not None:
             seconds = GENERATION_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("tracing") is not None:
+            seconds = TRACING_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

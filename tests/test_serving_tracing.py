@@ -1,0 +1,718 @@
+"""Fleet-wide distributed tracing (PR 13) — span propagation across
+process boundaries, trace collection + reconstruction, SLO attribution.
+
+Layers under test:
+
+- ``common/observability.py``: SpanContext/traceparent, fleet-consistent
+  head sampling, span ids/parents, the error-span survival buffer,
+  ``drain_spans``, ``SloTracker``.
+- Propagation: the gateway continues a ``traceparent`` header, stamps
+  the context into records/frames (``trace_ctx`` / wire short key
+  ``tc``), the engine parents every stage span under it and records the
+  QUEUE-WAIT span from the stamped ingest time; the LB opens root spans
+  and forwards the header.
+- Collection: ``serving/tracecollect.py`` spool append/merge with
+  per-process clock normalization, ``reconstruct``/``slowest``, the
+  ``manager trace`` CLI, ``tools/trace_view.py`` fleet mode + legacy
+  tolerance.
+- The cross-process acceptance scenario: two REAL replica processes
+  behind the LB front door, one traced request reconstructed across all
+  processes, queue-wait + stage decomposition summing (within
+  tolerance) to the client-observed e2e — and the SIGKILL failover
+  variant where both replicas land under one trace with the retry
+  visible.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.observability import (MetricsRegistry,
+                                                    SloTracker, SpanContext,
+                                                    Tracer, new_trace_id,
+                                                    trace_sampled)
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.serving import tracecollect
+from analytics_zoo_tpu.serving import wire as _wire
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.lb import LoadBalancer, static_members
+from analytics_zoo_tpu.serving.queues import InProcQueue
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "tracing_worker.py")
+DIM = 3
+
+
+def _mk_serving(queue=None, **params):
+    model = Sequential()
+    model.add(Dense(4, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=2, poll_timeout_s=0.02, max_wait_ms=2.0,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue or InProcQueue(),
+                          params=ServingParams(**defaults))
+
+
+def _http_json(url, data=None, headers=None, timeout=15.0):
+    req = urllib.request.Request(url, data=data,
+                                 headers=dict(headers or {}))
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# -- span context / sampling ---------------------------------------------------
+
+def test_span_context_traceparent_roundtrip():
+    ctx = SpanContext("ab12cd34ef567890")
+    tp = ctx.to_traceparent()
+    assert tp.startswith("00-") and len(tp.split("-")) == 4
+    back = SpanContext.from_traceparent(tp)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # unsampled flag survives
+    off = SpanContext("ab12cd34ef567890", sampled=False)
+    assert SpanContext.from_traceparent(off.to_traceparent()).sampled \
+        is False
+    # a child keeps the trace + verdict, mints a fresh span id
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+    # foreign full-width W3C ids survive verbatim
+    f = SpanContext.from_traceparent(
+        "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01")
+    assert f.trace_id == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert f.span_id == "00f067aa0ba902b7"
+
+
+def test_span_context_malformed_inputs():
+    for bad in (None, 17, "", "junk", "00-zz-yy-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero span
+                "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # bad version
+                "00-" + "1" * 31 + "-" + "2" * 16 + "-01"):  # short trace
+        assert SpanContext.from_traceparent(bad) is None, bad
+
+
+def test_head_sampling_deterministic_and_bounded():
+    tid = new_trace_id()
+    assert trace_sampled(tid, 1.0) is True
+    assert trace_sampled(tid, 0.0) is False
+    # the fleet-consistency property: every process computes the same
+    # verdict from the id alone
+    assert trace_sampled(tid, 0.37) == trace_sampled(tid, 0.37)
+    # rate partitions a population roughly proportionally
+    ids = [new_trace_id() for _ in range(2000)]
+    kept = sum(1 for t in ids if trace_sampled(t, 0.25))
+    assert 300 < kept < 700, kept
+    # non-hex ids degrade to a hash, not an exception
+    assert trace_sampled("not-hex-id!", 0.5) in (True, False)
+
+
+# -- error-span survival buffer (satellite) ------------------------------------
+
+def test_error_spans_survive_ring_churn():
+    """Generation load emits per-boundary decode spans at token rate; the
+    one quarantine span being diagnosed must NOT be evicted by that churn
+    (it was, before the separate bounded error buffer)."""
+    tr = Tracer(maxlen=16, error_maxlen=8)
+    tr.span("generate", 0.0, 0.0, trace_id="poisoned", uri="bad",
+            error="generate: RuntimeError: boom")
+    for i in range(500):                   # >> ring capacity
+        tr.span("decode", float(i), float(i) + 0.001, trace_id="busy")
+    errs = [s for s in tr.spans() if s.get("error")]
+    assert len(errs) == 1 and errs[0]["trace_id"] == "poisoned"
+    # the error span is reported once even while still in the ring
+    tr2 = Tracer(maxlen=64, error_maxlen=8)
+    tr2.span("predict", 0.0, 0.0, trace_id="t", error="x")
+    assert len([s for s in tr2.spans() if s.get("error")]) == 1
+
+
+def test_drain_spans_clears_both_buffers():
+    tr = Tracer(maxlen=8, error_maxlen=4)
+    tr.span("read", 0.0, 0.001, trace_id="a")
+    tr.span("predict", 0.0, 0.0, trace_id="b", error="boom")
+    for i in range(20):
+        tr.span("decode", float(i), float(i), trace_id="c")
+    drained = tr.drain_spans()
+    assert any(s.get("error") for s in drained)
+    assert tr.spans() == []
+    assert tr.drain_spans() == []
+
+
+# -- wire version compatibility ------------------------------------------------
+
+def test_wire_trace_ctx_version_compat():
+    arr = np.arange(4, dtype="<f4")
+    # new frame: context rides the tc short key and expands at decode
+    ctx = {"tp": SpanContext("ab" * 8).to_traceparent(), "ts": 123456789}
+    frame = _wire.encode_tensor_frame("u1", arr, trace_id="ab" * 8,
+                                      trace_ctx=ctx)
+    rec = _wire.frame_to_record(frame)
+    assert rec["trace_ctx"] == ctx
+    # OLD frame (no trace_ctx) still decodes — and restamp adds the
+    # context only when absent
+    old = _wire.encode_tensor_frame("u2", arr)
+    rec_old = _wire.frame_to_record(old)
+    assert "trace_ctx" not in rec_old
+    stamped, header = _wire.restamp_frame_with_header(
+        old, trace_id="t" * 16,
+        trace_ctx_fn=lambda h: {"tp": "00-" + "0" * 16 + h["trace_id"]
+                                + "-" + "1" * 16 + "-01", "ts": 7})
+    assert header["trace_ctx"]["ts"] == 7
+    assert _wire.frame_to_record(stamped)["trace_ctx"]["ts"] == 7
+    # a frame already carrying a context is NOT re-stamped
+    again, header2 = _wire.restamp_frame_with_header(
+        frame, trace_ctx_fn=lambda h: {"ts": 999})
+    assert header2["trace_ctx"] == ctx
+
+
+# -- engine propagation --------------------------------------------------------
+
+@pytest.mark.timeout(120)
+def test_gateway_continues_traceparent_and_engine_parents():
+    """The full in-process chain: an LB-style traceparent header in ->
+    the gateway continues the trace, stamps the context, records its own
+    span under the inbound parent; every engine stage span parents under
+    the GATEWAY span; queue-wait is recorded from the stamped ingest
+    time; the success result carries the trace_id and the terminal fetch
+    records a result_poll span."""
+    serving = _mk_serving(http_port=0).start()
+    try:
+        url = serving._http.url
+        root = SpanContext("fe" * 8)
+        body = json.dumps({"uri": "rec-1",
+                           "data": [0.1] * DIM}).encode()
+        status, ack = _http_json(
+            url + "/v1/enqueue", data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": root.to_traceparent()})
+        assert status == 200
+        assert ack["trace_id"] == root.trace_id     # trace CONTINUED
+        status, res = _http_json(
+            url + f"/v1/result/rec-1?timeout_s=15")
+        assert status == 200 and "value" in res
+        assert res.get("trace_id") == root.trace_id
+        time.sleep(0.2)
+        spans = serving.tracer.spans(root.trace_id)
+        by_stage = {}
+        for s in spans:
+            by_stage.setdefault(s["stage"], []).append(s)
+        for stage in ("gateway", "queue_wait", "read", "preprocess",
+                      "predict", "write", "result_poll"):
+            assert stage in by_stage, (stage, sorted(by_stage))
+        gw = by_stage["gateway"][0]
+        assert gw["parent_id"] == root.span_id
+        assert gw["span_id"]
+        for stage in ("queue_wait", "read", "preprocess", "predict",
+                      "write"):
+            assert by_stage[stage][0].get("parent_id") == gw["span_id"], \
+                stage
+        # every span names this replica (fleet merge attribution)
+        assert all(s.get("replica_id") == serving.replica_id
+                   for s in spans)
+        qw = by_stage["queue_wait"][0]
+        assert 0 <= qw["dur_s"] < 30.0
+    finally:
+        serving.shutdown()
+
+
+@pytest.mark.timeout(120)
+def test_trace_sample_zero_spans_dark_errors_kept():
+    """sampling=0: a healthy record emits NO spans (the volume knob), but
+    a quarantined record's error span still records — and survives in the
+    error buffer."""
+    q = InProcQueue()
+    serving = _mk_serving(q, trace_sample=0.0)
+    cin = InputQueue(q)
+    cin.enqueue_tensor("ok", np.ones(DIM, np.float32))
+    serving.serve_once()
+    assert serving.tracer.spans() == []
+    q.xadd({"uri": "bad", "data": "not-a-tensor"})
+    serving.serve_once()
+    errs = [s for s in serving.tracer.spans() if s.get("error")]
+    assert errs and errs[0]["uri"] == "bad"
+    res = q.get_result("bad")
+    assert OutputQueue.is_error(res)
+    serving.shutdown()
+
+
+@pytest.mark.timeout(120)
+def test_native_client_queue_wait_span():
+    """Native (non-HTTP) producers stamp the ingest timestamp too, so
+    queue-wait is attributable without the gateway in the path."""
+    q = InProcQueue()
+    serving = _mk_serving(q)
+    cin = InputQueue(q)
+    cin.enqueue_tensor("n1", np.ones(DIM, np.float32), wire="bin")
+    tid = cin.last_trace_id
+    time.sleep(0.05)                       # real queue residency
+    serving.serve_once()
+    spans = serving.tracer.spans(tid)
+    qw = [s for s in spans if s["stage"] == "queue_wait"]
+    assert qw and qw[0]["dur_s"] >= 0.04, qw
+    serving.shutdown()
+
+
+@pytest.mark.timeout(120)
+def test_remote_trust_edge_and_unsampled_propagation():
+    """Review regressions: (1) a remote frame's forged trace_ctx is
+    OVERWRITTEN at the gateway — a 1 ns ingest stamp must not fabricate
+    an hour-long queue-wait span / SLO violation, nor a forged parent
+    mis-thread the timeline; (2) an explicitly-unsampled inbound
+    traceparent (flags 00) stays dark across LB, gateway and engine when
+    the client continues the context on its poll; (3) a 200 result with
+    no trace_id (the partial-at-deadline shape) mints NO orphan LB
+    span."""
+    serving = _mk_serving(http_port=0,
+                          serving_slo={"latency_ms": 60000,
+                                       "window_s": 30,
+                                       "target": 0.9}).start()
+    lb = LoadBalancer(static_members([serving._http.url])).start()
+    try:
+        # (1) forged context in a remote binary frame
+        arr = np.ones(DIM, "<f4")
+        forged = _wire.encode_tensor_frame(
+            "forge-1", arr, trace_id="ab" * 8,
+            trace_ctx={"tp": "00-" + "9" * 32 + "-" + "8" * 16 + "-01",
+                       "ts": 1})
+        status, _ = _http_json(
+            serving._http.url + "/v1/enqueue", data=forged,
+            headers={"Content-Type": "application/octet-stream"})
+        assert status == 200
+        status, _ = _http_json(
+            serving._http.url + "/v1/result/forge-1?timeout_s=15")
+        assert status == 200
+        time.sleep(0.2)
+        spans = serving.tracer.spans("ab" * 8)
+        qw = [s for s in spans if s["stage"] == "queue_wait"]
+        assert qw and qw[0]["dur_s"] < 5.0, qw
+        assert all(s.get("parent_id") != "8" * 16 for s in spans)
+        assert serving._slo.snapshot()["window_violations"] == 0
+
+        # (2) explicitly-unsampled trace stays dark fleet-wide
+        off = SpanContext("cd" * 8, sampled=False)
+        tp = {"traceparent": off.to_traceparent()}
+        body = json.dumps({"uri": "dark-1",
+                           "data": [0.1] * DIM}).encode()
+        status, ack = _http_json(
+            lb.url + "/v1/enqueue", data=body,
+            headers={"Content-Type": "application/json", **tp})
+        assert status == 200 and ack["trace_id"] == "cd" * 8
+        status, _ = _http_json(lb.url + "/v1/result/dark-1?timeout_s=15",
+                               headers=tp)
+        assert status == 200
+        time.sleep(0.2)
+        assert serving.tracer.spans("cd" * 8) == []
+        assert lb.tracer.spans("cd" * 8) == []
+
+        # (3) trace-id-less 200 (partial shape) -> no orphan LB span
+        serving.queue.put_result("orphan-1",
+                                 {"partial": True, "tokens": [1, 2]})
+        status, res = _http_json(
+            lb.url + "/v1/result/orphan-1?timeout_s=0")
+        assert status == 200 and res.get("partial")
+        time.sleep(0.1)
+        assert not [s for s in lb.tracer.spans()
+                    if s.get("uri") == "orphan-1"]
+    finally:
+        lb.stop()
+        serving.shutdown()
+
+
+# -- SLO attribution -----------------------------------------------------------
+
+def test_slo_tracker_burn_and_attribution():
+    reg = MetricsRegistry()
+    slo = SloTracker.from_config(
+        reg, {"latency_ms": 10, "window_s": 60, "target": 0.9})
+    assert slo.observe(0.005, {"predict": 0.004}) is None
+    assert slo.observe(0.5, {"queue_wait": 0.4, "predict": 0.05}) \
+        == "queue_wait"
+    assert slo.observe(0.5, {}) == "unattributed"
+    snap = slo.snapshot()
+    assert snap["window_violations"] == 2
+    # 2/3 violating over a 10% budget -> burn 6.67 (snapshot rounds)
+    assert abs(snap["burn_rate"] - (2 / 3) / 0.1) < 1e-3
+    counter = reg.get("serving_slo_violations_total")
+    assert counter.labels(stage="queue_wait").value == 1
+    # config edge cases
+    assert SloTracker.from_config(reg, None) is None
+    assert SloTracker.from_config(reg, {"latency_ms": "junk"}) is None
+    assert SloTracker.from_config(reg, {}) is None
+
+
+@pytest.mark.timeout(120)
+def test_engine_slo_violation_attribution_and_fleet_merge():
+    """A 1µs objective makes every record violate: the counter charges a
+    stage, the burn gauge saturates, the health doc carries the slo
+    block, and the fleet layers (aggregate_health + prometheus merge)
+    surface it with the MAX rule."""
+    from analytics_zoo_tpu.serving import fleet as _fleet
+    q = InProcQueue()
+    serving = _mk_serving(q, serving_slo={"latency_ms": 0.001,
+                                          "window_s": 30, "target": 0.99})
+    cin = InputQueue(q)
+    for i in range(4):
+        cin.enqueue_tensor(f"s{i}", np.ones(DIM, np.float32))
+    while serving.serve_once():
+        pass
+    h = serving.health()
+    assert h["slo"]["window_violations"] >= 4
+    assert h["slo"]["burn_rate"] > 1.0
+    assert "clock" in h and h["clock"]["wall"] > 0
+    prom = serving.prom_metrics()
+    assert "serving_slo_violations_total" in prom
+    assert "serving_slo_burn_rate" in prom
+    agg = _fleet.aggregate_health({0: h, 1: dict(h)})
+    assert agg["slo_burn_rate"] == h["slo"]["burn_rate"]
+    assert agg["slo_window_violations"] >= 8
+    doc = _fleet.fleet_metrics({0: h})
+    assert doc["slo"]["burn_rate"] == h["slo"]["burn_rate"]
+    # prometheus merge: burn rate takes the max, never the sum
+    merged = _fleet.merge_prometheus([
+        "# TYPE serving_slo_burn_rate gauge\nserving_slo_burn_rate 2.0\n",
+        "# TYPE serving_slo_burn_rate gauge\nserving_slo_burn_rate 5.0\n"])
+    assert "serving_slo_burn_rate 5" in merged
+    serving.shutdown()
+
+
+# -- LB metrics in the fleet doc (satellite) -----------------------------------
+
+@pytest.mark.timeout(120)
+def test_lb_metrics_join_fleet_doc():
+    from analytics_zoo_tpu.serving import fleet as _fleet
+    serving = _mk_serving(http_port=0).start()
+    lb = LoadBalancer(static_members([serving._http.url])).start()
+    try:
+        body = json.dumps({"uri": "m1", "data": [0.1] * DIM}).encode()
+        status, _ = _http_json(lb.url + "/v1/enqueue", data=body,
+                               headers={"Content-Type":
+                                        "application/json"})
+        assert status == 200
+        status, _ = _http_json(lb.url + "/v1/result/m1?timeout_s=10")
+        assert status == 200
+        snap = {"url": lb.url, "ts": time.time(),
+                "snapshot": lb.registry.snapshot(),
+                "prom": lb.registry.to_prometheus()}
+        summary = _fleet.lb_summary(snap)
+        assert summary["requests_total"] >= 2
+        assert summary["requests"].get("enqueue:200") == 1
+        assert summary["members_total"] == 1
+        doc = _fleet.fleet_metrics({0: serving.health()}, lb=snap)
+        assert doc["lb"]["requests_total"] >= 2
+        # absent snapshot -> no lb block, not a crash
+        assert "lb" not in _fleet.fleet_metrics({0: serving.health()})
+        assert _fleet.lb_summary(None) is None
+    finally:
+        lb.stop()
+        serving.shutdown()
+
+
+# -- collection / reconstruction ----------------------------------------------
+
+def test_tracecollect_clock_normalization(tmp_path):
+    """Two processes with wildly different monotonic epochs merge onto
+    one wall timeline through their drain-time clock records; a legacy
+    spool with no clock records falls back to the health-doc pair; with
+    neither, spans keep raw ts flagged clock_skewed."""
+    tid = "ab" * 8
+    wall = 1_000_000.0
+    # process A: monotonic epoch ~100, its span at wall+1.0
+    a = {"trace_id": tid, "uri": "u", "stage": "read", "ts": 101.0,
+         "dur_s": 0.01, "replica_id": "ra"}
+    with open(tmp_path / "a.spans.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "clock", "wall": wall,
+                            "mono": 100.0}) + "\n")
+        f.write(json.dumps(dict(a, kind="span")) + "\n")
+    # process B: monotonic epoch ~90000, its span at wall+2.0
+    b = {"trace_id": tid, "uri": "u", "stage": "predict", "ts": 90002.0,
+         "dur_s": 0.02, "replica_id": "rb"}
+    with open(tmp_path / "b.spans.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "clock", "wall": wall,
+                            "mono": 90000.0}) + "\n")
+        f.write(json.dumps(dict(b, kind="span")) + "\n")
+    # legacy process C: NO clock records — health-doc pair instead
+    c = {"trace_id": tid, "uri": "u", "stage": "write", "ts": 503.0,
+         "dur_s": 0.001, "replica_id": "rc"}
+    with open(tmp_path / "c.spans.jsonl", "w") as f:
+        f.write(json.dumps(dict(c, kind="span")) + "\n")
+    health = {"rc": {"clock": {"wall": wall, "monotonic": 500.0}}}
+    spans = tracecollect.merge_spools(
+        [str(tmp_path / n) for n in ("a.spans.jsonl", "b.spans.jsonl",
+                                     "c.spans.jsonl")],
+        health_docs=health)
+    by_stage = {s["stage"]: s for s in spans}
+    assert abs(by_stage["read"]["ts_wall"] - (wall + 1.0)) < 1e-6
+    assert abs(by_stage["predict"]["ts_wall"] - (wall + 2.0)) < 1e-6
+    assert abs(by_stage["write"]["ts_wall"] - (wall + 3.0)) < 1e-6
+    assert [s["stage"] for s in spans] == ["read", "predict", "write"]
+    doc = tracecollect.reconstruct(spans, tid)
+    assert doc["found"] and doc["processes"] == ["ra", "rb", "rc"]
+    assert abs(doc["e2e_ms"] - 2001.0) < 1.0
+    # no clock anywhere: flagged, not dropped
+    spans2 = tracecollect.merge_spools([str(tmp_path / "c.spans.jsonl")])
+    assert spans2[0].get("clock_skewed") is True
+    # unknown trace
+    assert tracecollect.reconstruct(spans, "nope")["found"] is False
+
+
+def test_trace_view_tolerates_missing_replica_id(tmp_path):
+    """Satellite regression: the viewer's percentile helper and summary
+    must accept spans with NO replica_id (legacy spools) — and empty
+    stage distributions — without raising."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_view
+    assert trace_view._dist([]) == {"count": 0, "mean_ms": None,
+                                    "p50_ms": None, "p99_ms": None}
+    tr = Tracer()                          # no replica identity at all
+    tid = new_trace_id()
+    tr.span("read", 0.0, 0.01, trace_id=tid, uri="u")
+    tr.span("predict", 0.02, 0.05, trace_id=tid, uri="u")
+    spans = tr.drain_spans()
+    for s in spans:
+        s.pop("replica_id", None)
+    with open(tmp_path / "legacy.spans.jsonl", "w") as f:
+        for s in spans:
+            f.write(json.dumps(dict(s, kind="span")) + "\n")
+    events = trace_view.load_fleet_events(
+        [str(tmp_path / "legacy.spans.jsonl")])
+    doc = trace_view.summarize(events)
+    assert doc["traces"] == 1 and doc["processes"] == 1
+    # legacy traces don't grow bogus per-process fields
+    assert "processes" not in doc["slowest"][0]
+    assert doc["critical_path"]["segments"]
+    # mixed legacy + identified spans coexist
+    tr2 = Tracer(replica_id="r9")
+    tr2.span("write", 0.06, 0.07, trace_id=tid, uri="u")
+    events += trace_view.spans_to_events(
+        [dict(s, ts_wall=s["ts"]) for s in tr2.drain_spans()])
+    doc2 = trace_view.summarize(events)
+    assert doc2["processes"] == 2
+    assert doc2["slowest"][0]["processes"] == ["r9", "unknown"]
+
+
+# -- cross-process acceptance ---------------------------------------------------
+
+def _spawn_worker(qdir, rid, spool, tmp_path, extra=()):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, qdir, rid, "--spool", spool,
+         *extra],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    info = json.loads(line)
+    assert info["replica"] == rid
+    return proc, info["port"]
+
+
+@pytest.mark.timeout(300)
+def test_fleet_e2e_acceptance(tmp_path):
+    """ISSUE 13 acceptance: 2 real replica processes (engine + gateway)
+    behind the LB front door.  One traced request's `manager trace <id>`
+    output reconstructs lb -> gateway -> queue-wait -> preprocess ->
+    predict -> write -> result-poll as parented spans across the
+    processes, with the decomposition summing (within tolerance) to the
+    client-observed e2e latency."""
+    qdir = str(tmp_path / "q")
+    base = str(tmp_path / "cluster-serving.pid")
+    procs = []
+    lb = None
+    try:
+        for i in range(2):
+            procs.append(_spawn_worker(
+                qdir, f"replica-{i}", f"{base}.r{i}.spans.jsonl",
+                tmp_path))
+        urls = [f"http://127.0.0.1:{port}" for _, port in procs]
+        lb = LoadBalancer(static_members(urls),
+                          span_spool=f"{base}.lb.spans.jsonl").start()
+        t0 = time.monotonic()
+        body = json.dumps({"uri": "acc-1", "data": [0.1] * DIM}).encode()
+        status, ack = _http_json(lb.url + "/v1/enqueue", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+        assert status == 200
+        tid = ack["trace_id"]
+        status, res = _http_json(lb.url + "/v1/result/acc-1?timeout_s=20",
+                                 timeout=30)
+        client_e2e_ms = (time.monotonic() - t0) * 1e3
+        assert status == 200 and "value" in res
+        assert res.get("trace_id") == tid
+        time.sleep(0.5)                    # final spool drains
+        lb.drain_spans_to_spool()
+
+        spans = tracecollect.collect(base)
+        doc = tracecollect.reconstruct(spans, tid)
+        assert doc["found"], doc
+        stages = set(doc["stages_ms"])
+        for stage in ("lb_enqueue", "gateway", "queue_wait", "preprocess",
+                      "predict", "write", "result_poll", "lb_result"):
+            assert stage in stages, (stage, sorted(stages))
+        # across processes: the LB plus at least one replica, every span
+        # attributed
+        assert "lb" in doc["processes"]
+        assert any(p.startswith("replica-") for p in doc["processes"])
+        assert len(doc["processes"]) >= 2
+        # parented: every engine stage span hangs off the gateway span
+        gw = [e for e in doc["timeline"] if e["stage"] == "gateway"][0]
+        eng = [e for e in doc["timeline"]
+               if e["stage"] in ("queue_wait", "read", "preprocess",
+                                 "stage_wait", "predict", "write")]
+        assert eng and all(e.get("parent_id") == gw["span_id"]
+                           for e in eng)
+        # decomposition sums to the client-observed e2e within tolerance:
+        # the trace covers POST-start (lb_enqueue) through result receipt
+        # (lb_result end) — same-host wall clocks, so the window should
+        # track the client's own measurement closely
+        assert abs(doc["e2e_ms"] - client_e2e_ms) < \
+            max(0.5 * client_e2e_ms, 150.0), (doc["e2e_ms"], client_e2e_ms)
+        # and the non-overlapping serving-path pieces fit inside it
+        inner = sum(doc["stages_ms"].get(k, 0.0)
+                    for k in ("queue_wait", "read", "preprocess",
+                              "stage_wait", "predict", "write"))
+        assert inner <= doc["e2e_ms"] * 1.25, (inner, doc["e2e_ms"])
+
+        # the CLI path: manager trace <id> / --slowest over the spools
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+             "trace", tid, "--pidfile", base],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=60)
+        assert out.returncode == 0, out.stderr
+        cli_doc = json.loads(out.stdout)
+        assert cli_doc["trace_id"] == tid and cli_doc["found"]
+        assert set(cli_doc["stages_ms"]) == stages
+        out = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+             "trace", "--slowest", "3", "--pidfile", base],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=60)
+        assert out.returncode == 0, out.stderr
+        top = json.loads(out.stdout)["slowest"]
+        assert any(t["trace_id"] == tid for t in top)
+    finally:
+        if lb is not None:
+            lb.stop()
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc, _ in procs:
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+@pytest.mark.timeout(300)
+def test_lb_reroute_sigkill_one_trace(tmp_path):
+    """Satellite: SIGKILL the replica that CLAIMED the record while the
+    client long-polls through the LB.  The survivor reclaims and serves;
+    the reconstructed timeline shows BOTH replicas under one trace_id
+    with the retry visible (the reclaim span + a redelivered result)."""
+    qdir = str(tmp_path / "q")
+    base = str(tmp_path / "cluster-serving.pid")
+    slow = ("--slow", "3.0", "--lease", "1.0",
+            "--reclaim-interval", "0.2")
+    procs = []
+    lb = None
+    try:
+        for i in range(2):
+            procs.append(_spawn_worker(
+                qdir, f"replica-{i}", f"{base}.r{i}.spans.jsonl",
+                tmp_path, extra=slow))
+        urls = [f"http://127.0.0.1:{port}" for _, port in procs]
+        lb = LoadBalancer(static_members(urls),
+                          span_spool=f"{base}.lb.spans.jsonl").start()
+        body = json.dumps({"uri": "kill-1", "data": [0.1] * DIM}).encode()
+        status, ack = _http_json(lb.url + "/v1/enqueue", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+        assert status == 200
+        tid = ack["trace_id"]
+
+        # long-poll through the LB on a background thread (parked on one
+        # of the gateways while the claimer sleeps in its slow predict)
+        result = {}
+
+        def poll():
+            try:
+                result["res"] = _http_json(
+                    lb.url + "/v1/result/kill-1?timeout_s=25",
+                    timeout=35)
+            except Exception as e:  # noqa: BLE001
+                result["err"] = e
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+
+        # identify the CLAIMER: its spool shows the read span for our uri
+        def claimer():
+            for i in range(2):
+                for rec in tracecollect.load_spool(
+                        f"{base}.r{i}.spans.jsonl"):
+                    if rec.get("stage") == "read" \
+                            and rec.get("uri") == "kill-1":
+                        return i
+            return None
+
+        deadline = time.monotonic() + 30
+        victim = None
+        while victim is None and time.monotonic() < deadline:
+            victim = claimer()
+            time.sleep(0.1)
+        assert victim is not None, "no replica claimed the record"
+        victim_proc, _ = procs[victim]
+        time.sleep(0.5)                    # mid-predict (3s sleep)
+        os.kill(victim_proc.pid, signal.SIGKILL)
+
+        t.join(timeout=40)
+        assert "res" in result, result.get("err")
+        status, res = result["res"]
+        assert status == 200 and "value" in res, res
+        # redelivery made visible: the survivor reclaimed + re-served
+        assert OutputQueue.deliveries(res) >= 2, res
+        time.sleep(0.5)
+        lb.drain_spans_to_spool()
+
+        spans = tracecollect.collect(base)
+        doc = tracecollect.reconstruct(spans, tid)
+        assert doc["found"], doc
+        replicas = {p for p in doc["processes"]
+                    if p.startswith("replica-")}
+        assert replicas == {"replica-0", "replica-1"}, doc["processes"]
+        # the retry is visible: the survivor's reclaim span rides the
+        # same trace, and the terminal write happened on the survivor
+        stages = [e["stage"] for e in doc["timeline"]]
+        assert "reclaim" in stages, stages
+        survivor = f"replica-{1 - victim}"
+        writes = [e for e in doc["timeline"] if e["stage"] == "write"]
+        assert writes and writes[-1]["process"] == survivor
+        reads = [e for e in doc["timeline"] if e["stage"] == "read"]
+        assert {e["process"] for e in reads} == replicas
+    finally:
+        if lb is not None:
+            lb.stop()
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc, _ in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass

@@ -1,0 +1,104 @@
+"""Serving replica subprocess for the fleet-tracing tests
+(test_serving_tracing.py): one ClusterServing engine + HTTP gateway over a
+shared FileQueue spool, draining its tracer ring to a span spool exactly
+like the manager's foreground loop does.
+
+Prints one JSON line to stdout once serving is up::
+
+    {"replica": "<id>", "port": <gateway port>, "pid": <pid>}
+
+so the parent learns the ephemeral gateway port without a pre-pick race.
+Runs until SIGTERM (graceful drain + final span flush) — or SIGKILL,
+which is the point of the failover test.
+
+Usage:
+    python tracing_worker.py QUEUE_DIR REPLICA_ID --spool PATH
+        [--health PATH] [--slow S] [--lease S] [--reclaim-interval S]
+        [--sample R] [--slo-ms MS]
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("queue_dir")
+    ap.add_argument("replica_id")
+    ap.add_argument("--spool", required=True,
+                    help="span spool path (jsonl) this replica drains to")
+    ap.add_argument("--health", default=None,
+                    help="health snapshot path (default: "
+                         "<queue_dir>/<replica_id>.health.json)")
+    ap.add_argument("--slow", type=float, default=0.0,
+                    help="per-batch predict sleep: keeps claims in flight "
+                         "long enough for the parent to SIGKILL mid-stream")
+    ap.add_argument("--lease", type=float, default=1.0)
+    ap.add_argument("--reclaim-interval", type=float, default=0.2)
+    ap.add_argument("--sample", type=float, default=1.0)
+    ap.add_argument("--slo-ms", type=float, default=None)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+    from analytics_zoo_tpu.serving import tracecollect
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    queue = FileQueue(args.queue_dir)
+    model = Sequential()
+    model.add(Dense(4, input_shape=(3,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    slo = {"latency_ms": args.slo_ms} if args.slo_ms else None
+    serving = ClusterServing(im, queue, params=ServingParams(
+        batch_size=4, poll_timeout_s=0.02, max_wait_ms=2.0,
+        worker_backoff_s=0.01, replica_id=args.replica_id,
+        lease_s=args.lease, reclaim_interval_s=args.reclaim_interval,
+        http_port=0, trace_sample=args.sample, serving_slo=slo))
+    if args.slow > 0:
+        orig_predict = serving.model.do_predict
+
+        def slow_predict(*a, **kw):
+            time.sleep(args.slow)
+            return orig_predict(*a, **kw)
+
+        serving.model.do_predict = slow_predict
+
+    health_path = args.health or os.path.join(
+        args.queue_dir, f"{args.replica_id}.health.json")
+
+    def _drain():
+        spans = serving.tracer.drain_spans()
+        if spans:
+            tracecollect.append_spans(args.spool, spans,
+                                      source=args.replica_id)
+
+    def _terminate(signum, frame):
+        serving.shutdown(drain_s=5.0)
+        _drain()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    serving.start()
+    print(json.dumps({"replica": args.replica_id,
+                      "port": serving._http.port,
+                      "pid": os.getpid()}), flush=True)
+    while True:
+        tmp = health_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(serving.health(), ts=time.time()), f)
+        os.replace(tmp, health_path)
+        _drain()
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

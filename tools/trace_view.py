@@ -1,4 +1,5 @@
-"""Summarize a serving Chrome trace-event dump (PR 4 observability).
+"""Summarize serving traces — single-process Chrome dumps (PR 4) or the
+fleet-wide span spools (PR 13).
 
 `ClusterServing.export_trace(path)` (or `Tracer.export_chrome_trace`) writes
 the per-record pipeline spans — read / preprocess / stage_wait / predict /
@@ -17,7 +18,26 @@ offline, from the same file:
 - **errors** — every span carrying an error (quarantined / shed records),
   grouped by stage.
 
+Fleet mode (PR 13): point it at the span SPOOLS a deployment writes next to
+its health snapshots (``<pidfile>*.spans.jsonl`` — per-replica + the LB's)
+and it merges them through ``serving/tracecollect.py`` (monotonic clocks
+normalized per process) before summarizing.  Spans then carry a process
+identity, so the analysis adds what no single ring can see:
+
+- **cross-process gaps** — untracked time where the previous span ran in
+  one process and the next in another (LB->gateway handoff, queue
+  residency between the gateway's stamp and a replica's claim);
+- **critical path** — for the slowest trace, the ordered walk of spans
+  covering its wall time, each segment attributed to its process, with the
+  gaps in between flagged ``cross_process`` where the handoff crossed one.
+
+Spans missing ``replica_id`` (legacy spools, pre-PR-13 dumps) are tolerated
+everywhere: they fold into one ``unknown`` process and the single-process
+analysis is unchanged.
+
 Run: python tools/trace_view.py trace.json [--top 5] [--json]
+     python tools/trace_view.py cluster-serving.pid --fleet   # merge spools
+     python tools/trace_view.py a.spans.jsonl b.spans.jsonl   # explicit
      python tools/trace_view.py --smoke          # self-test (tier-1)
 """
 
@@ -37,6 +57,8 @@ from analytics_zoo_tpu.common.observability import _percentile  # noqa: E402
 
 def _dist(vals_ms):
     vals = sorted(vals_ms)
+    if not vals:
+        return {"count": 0, "mean_ms": None, "p50_ms": None, "p99_ms": None}
     return {"count": len(vals),
             "mean_ms": round(sum(vals) / len(vals), 3),
             "p50_ms": round(_percentile(vals, 50), 3),
@@ -50,6 +72,13 @@ def _stage_sums(spans):
     return {name: round(d / 1e3, 3) for name, d in agg.items()}
 
 
+def _proc(e) -> str:
+    """Process identity of one event — tolerant of spans that never
+    carried a ``replica_id`` (legacy spools): they fold into one
+    ``unknown`` track rather than raising or fragmenting per-event."""
+    return str((e.get("args") or {}).get("replica_id") or "unknown")
+
+
 def load_events(path: str):
     """Complete ('X') events from a Chrome trace file ({"traceEvents": []}
     document or a bare event list)."""
@@ -59,41 +88,110 @@ def load_events(path: str):
     return [e for e in events if e.get("ph") == "X"]
 
 
+def spans_to_events(spans):
+    """Normalized tracecollect spans -> the event shape summarize() speaks
+    (µs timestamps, args carrying trace/uri/error/replica)."""
+    events = []
+    for s in spans:
+        args = {"trace_id": s.get("trace_id"), "uri": s.get("uri")}
+        for key in ("error", "replica_id", "span_id", "parent_id",
+                    "tokens", "attempts", "rerouted"):
+            if s.get(key) is not None:
+                args[key] = s[key]
+        events.append({
+            "name": str(s.get("stage")), "ph": "X",
+            "ts": float(s.get("ts_wall", s.get("ts", 0.0))) * 1e6,
+            "dur": float(s.get("dur_s", 0.0)) * 1e6,
+            "args": args})
+    return events
+
+
+def load_fleet_events(paths):
+    """Fleet merge path (PR 13): ``paths`` is any mix of span spools
+    (``*.spans.jsonl``) and pidfile prefixes whose spools we glob; the
+    merged, clock-normalized spans come back as summarize()-ready
+    events."""
+    from analytics_zoo_tpu.serving import tracecollect
+    spools = []
+    for p in paths:
+        if p.endswith(".jsonl") or p.endswith(".jsonl.1"):
+            spools.append(p)
+        else:
+            spools.extend(tracecollect.find_spools(p))
+    return spans_to_events(tracecollect.merge_spools(sorted(set(spools))))
+
+
+def _ordered_gaps(trace_events):
+    """(time-sorted spans, positive inter-span gaps) for one trace — the
+    ONE ordered-walk/gap derivation ``summarize`` and ``critical_path``
+    both consume, so gap semantics cannot silently diverge between the
+    per-trace stats and the critical-path listing.  Each gap carries the
+    ``cross_process`` flag (the handoff crossed a process boundary — the
+    queue-residency / LB-hop costs no single ring can see)."""
+    spans = sorted(trace_events, key=lambda e: float(e["ts"]))
+    gaps = []
+    for prev, nxt in zip(spans, spans[1:]):
+        gap = float(nxt["ts"]) - (float(prev["ts"])
+                                  + float(prev.get("dur", 0.0)))
+        if gap > 0:
+            gaps.append({"after": prev["name"], "before": nxt["name"],
+                         "gap_ms": round(gap / 1e3, 3),
+                         "cross_process": _proc(prev) != _proc(nxt)})
+    return spans, gaps
+
+
+def critical_path(trace_events):
+    """The ordered walk of one trace's spans across the fleet: each
+    segment names its stage + process, gaps flagged per
+    ``_ordered_gaps``."""
+    spans, gaps = _ordered_gaps(trace_events)
+    t0 = float(spans[0]["ts"]) if spans else 0.0
+    segments = [{"stage": e["name"],
+                 "process": _proc(e),
+                 "t_ms": round((float(e["ts"]) - t0) / 1e3, 3),
+                 "dur_ms": round(float(e.get("dur", 0.0)) / 1e3, 3)}
+                for e in spans]
+    return {"segments": segments, "gaps": gaps,
+            "cross_process_gap_ms": round(sum(
+                g["gap_ms"] for g in gaps if g["cross_process"]), 3)}
+
+
 def summarize(events, top: int = 5):
     """The analysis document: per-stage distributions, slowest traces,
-    gap analysis, and error spans."""
+    gap analysis (cross-process gaps split out), and error spans."""
     if not events:
-        return {"spans": 0, "traces": 0, "stages": {}, "slowest": [],
-                "gaps": None, "errors": []}
+        return {"spans": 0, "traces": 0, "processes": 0, "stages": {},
+                "slowest": [], "gaps": None, "errors": [],
+                "critical_path": None}
     stages = {}
     traces = {}
     errors = []
+    processes = set()
     for e in events:
         args = e.get("args") or {}
         tid = args.get("trace_id") or f"untraced-{id(e)}"
         dur_ms = float(e.get("dur", 0.0)) / 1e3
         stages.setdefault(e["name"], []).append(dur_ms)
         traces.setdefault(tid, []).append(e)
+        processes.add(_proc(e))
         if args.get("error"):
             errors.append({"trace_id": args.get("trace_id"),
                            "uri": args.get("uri"),
                            "stage": e["name"],
+                           "process": _proc(e),
                            "error": args["error"]})
     per_trace = []
     gap_stats = []
+    cross_gap_stats = []
     for tid, spans in traces.items():
-        spans = sorted(spans, key=lambda e: float(e["ts"]))
+        spans, gaps = _ordered_gaps(spans)
         t0 = float(spans[0]["ts"])
         t1 = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in spans)
-        gaps = []
-        for prev, nxt in zip(spans, spans[1:]):
-            gap = float(nxt["ts"]) - (float(prev["ts"])
-                                      + float(prev.get("dur", 0.0)))
-            if gap > 0:
-                gaps.append(gap / 1e3)
-        gap_ms = sum(gaps)
+        gap_ms = sum(g["gap_ms"] for g in gaps)
+        cross_ms = sum(g["gap_ms"] for g in gaps if g["cross_process"])
         gap_stats.append(gap_ms)
-        per_trace.append({
+        cross_gap_stats.append(cross_ms)
+        entry = {
             "trace_id": tid,
             "uri": (spans[0].get("args") or {}).get("uri"),
             "e2e_ms": round((t1 - t0) / 1e3, 3),
@@ -104,24 +202,38 @@ def summarize(events, top: int = 5):
             # being diagnosed
             "stages": _stage_sums(spans),
             "error": next((e["args"].get("error") for e in spans
-                           if (e.get("args") or {}).get("error")), None)})
+                           if (e.get("args") or {}).get("error")), None)}
+        procs = {_proc(e) for e in spans}
+        if procs != {"unknown"}:
+            entry["processes"] = sorted(procs)
+            entry["cross_process_gap_ms"] = round(cross_ms, 3)
+        per_trace.append(entry)
     per_trace.sort(key=lambda t: -t["e2e_ms"])
     by_gap = sorted(per_trace, key=lambda t: -t["untracked_gap_ms"])
-    return {
+    doc = {
         "spans": len(events),
         "traces": len(traces),
+        "processes": len(processes),
         "stages": {name: _dist(vals) for name, vals in sorted(stages.items())},
         "slowest": per_trace[:top],
         "gaps": {**_dist(gap_stats),
+                 "cross_process_ms": round(sum(cross_gap_stats), 3),
                  "top": [{"trace_id": t["trace_id"], "uri": t["uri"],
                           "untracked_gap_ms": t["untracked_gap_ms"]}
                          for t in by_gap[:top]]},
         "errors": errors,
+        "critical_path": None,
     }
+    if per_trace:
+        slowest_tid = per_trace[0]["trace_id"]
+        doc["critical_path"] = dict(
+            critical_path(traces[slowest_tid]), trace_id=slowest_tid)
+    return doc
 
 
 def _print_human(doc):
-    print(f"{doc['spans']} spans over {doc['traces']} traces")
+    print(f"{doc['spans']} spans over {doc['traces']} traces "
+          f"({doc.get('processes', 1)} process(es))")
     print("\nper-stage breakdown:")
     for name, d in doc["stages"].items():
         print(f"  {name:<12} n={d['count']:<6} mean={d['mean_ms']:>9.3f}ms "
@@ -130,12 +242,26 @@ def _print_human(doc):
     for t in doc["slowest"]:
         stages = " ".join(f"{k}={v:.2f}" for k, v in t["stages"].items())
         err = f"  ERROR: {t['error']}" if t["error"] else ""
+        procs = f" procs={','.join(t['processes'])}" \
+            if t.get("processes") else ""
         print(f"  {t['e2e_ms']:>9.3f}ms  uri={t['uri']} "
-              f"trace={t['trace_id']}  [{stages}]{err}")
+              f"trace={t['trace_id']}{procs}  [{stages}]{err}")
     if doc["gaps"]:
         g = doc["gaps"]
         print(f"\nuntracked gaps (queue residency between stages): "
-              f"mean={g['mean_ms']:.3f}ms p99={g['p99_ms']:.3f}ms")
+              f"mean={g['mean_ms']:.3f}ms p99={g['p99_ms']:.3f}ms "
+              f"cross-process total={g.get('cross_process_ms', 0.0):.3f}ms")
+    cp = doc.get("critical_path")
+    if cp and cp.get("segments"):
+        print(f"\ncritical path (slowest trace {cp.get('trace_id')}, "
+              f"cross-process gap {cp['cross_process_gap_ms']:.3f}ms):")
+        for seg in cp["segments"]:
+            print(f"  +{seg['t_ms']:>9.3f}ms {seg['dur_ms']:>9.3f}ms "
+                  f"{seg['stage']:<12} @ {seg['process']}")
+        for gap in cp["gaps"]:
+            mark = " <-- cross-process" if gap["cross_process"] else ""
+            print(f"    gap {gap['gap_ms']:.3f}ms between "
+                  f"{gap['after']} and {gap['before']}{mark}")
     if doc["errors"]:
         print(f"\n{len(doc['errors'])} error span(s):")
         for e in doc["errors"]:
@@ -144,23 +270,31 @@ def _print_human(doc):
 
 
 def _smoke() -> int:
-    """Self-test: synthesize a trace through the real Tracer, export it,
-    summarize the export, and assert the document's shape — the tier-1
-    guard that the exporter and this viewer stay in sync."""
+    """Self-test: synthesize traces through the real Tracer — one batch
+    WITH replica identities spooled + fleet-merged (the PR 13 path), one
+    legacy batch WITHOUT replica_id (pre-PR-13 spools) — summarize both,
+    and assert the document's shape.  The tier-1 guard that the exporter,
+    the spool merge, and this viewer stay in sync, including tolerance of
+    spans missing ``replica_id``."""
     from analytics_zoo_tpu.common.observability import Tracer
-    tracer = Tracer()
+    from analytics_zoo_tpu.serving import tracecollect
     stages = ("read", "preprocess", "stage_wait", "predict", "write")
-    t = 0.0
-    for i in range(4):
-        tid = Tracer.new_trace_id()
-        t0 = t
-        for j, stage in enumerate(stages):
-            tracer.span(stage, t0 + j * 0.002, t0 + j * 0.002 + 0.001,
-                        trace_id=tid, uri=f"img-{i}")
-        t += 0.010
-    bad = Tracer.new_trace_id()
-    tracer.span("preprocess", t, t, trace_id=bad, uri="img-bad",
-                error="preprocess: ValueError: bad pixel")
+
+    def fill(tracer, t=0.0):
+        for i in range(4):
+            tid = Tracer.new_trace_id()
+            t0 = t
+            for j, stage in enumerate(stages):
+                tracer.span(stage, t0 + j * 0.002, t0 + j * 0.002 + 0.001,
+                            trace_id=tid, uri=f"img-{i}")
+            t += 0.010
+        bad = Tracer.new_trace_id()
+        tracer.span("preprocess", t, t, trace_id=bad, uri="img-bad",
+                    error="preprocess: ValueError: bad pixel")
+
+    # single-process chrome-dump path (PR 4 behaviour, unchanged)
+    tracer = Tracer()
+    fill(tracer)
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "trace.json")
         tracer.export_chrome_trace(path)
@@ -172,18 +306,54 @@ def _smoke() -> int:
     assert len(doc["errors"]) == 1 and doc["errors"][0]["uri"] == "img-bad"
     assert doc["slowest"] and doc["slowest"][0]["e2e_ms"] > 0
     assert doc["gaps"]["mean_ms"] >= 0
+    assert doc["critical_path"] and doc["critical_path"]["segments"]
+
+    # fleet path: two replicas' spools + one LEGACY spool whose spans
+    # never carried replica_id — both must merge and summarize
+    with tempfile.TemporaryDirectory() as td:
+        for rid in ("replica-0", "replica-1"):
+            tr = Tracer(replica_id=rid)
+            fill(tr)
+            tracecollect.append_spans(
+                os.path.join(td, f"{rid}.spans.jsonl"),
+                tr.drain_spans(), source=rid)
+        legacy = Tracer()           # no replica identity (pre-PR-13)
+        fill(legacy)
+        spans = legacy.drain_spans()
+        for s in spans:
+            s.pop("replica_id", None)
+        with open(os.path.join(td, "legacy.spans.jsonl"), "w") as f:
+            for s in spans:         # no clock record either — worst case
+                f.write(json.dumps(dict(s, kind="span")) + "\n")
+        events = load_fleet_events(
+            [os.path.join(td, n) for n in sorted(os.listdir(td))])
+        fdoc = summarize(events, top=3)
+    assert fdoc["traces"] == 15, fdoc["traces"]
+    assert fdoc["processes"] == 3, fdoc["processes"]   # r0, r1, unknown
+    assert len(fdoc["errors"]) == 3
+    assert any(e.get("process") == "unknown" for e in fdoc["errors"])
+    assert fdoc["critical_path"] is not None
     print(json.dumps({"smoke": "ok", "spans": doc["spans"],
-                      "traces": doc["traces"]}))
+                      "traces": doc["traces"],
+                      "fleet_traces": fdoc["traces"],
+                      "fleet_processes": fdoc["processes"]}))
     return 0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="summarize a serving Chrome trace-event dump")
-    ap.add_argument("trace", nargs="?", help="trace.json path "
-                    "(ClusterServing.export_trace output)")
+        description="summarize a serving Chrome trace-event dump or a "
+                    "fleet of span spools")
+    ap.add_argument("trace", nargs="*",
+                    help="trace.json (ClusterServing.export_trace output), "
+                         "one or more *.spans.jsonl spools, or a pidfile "
+                         "prefix with --fleet")
     ap.add_argument("--top", type=int, default=5,
                     help="how many slowest records / largest gaps to list")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat the argument(s) as span spools / a pidfile "
+                         "prefix and merge them fleet-wide "
+                         "(clock-normalized per process)")
     ap.add_argument("--json", action="store_true",
                     help="print the full analysis as JSON")
     ap.add_argument("--smoke", action="store_true",
@@ -192,8 +362,16 @@ def main(argv=None):
     if args.smoke:
         return _smoke()
     if not args.trace:
-        ap.error("pass a trace.json (or --smoke)")
-    doc = summarize(load_events(args.trace), top=args.top)
+        ap.error("pass a trace.json / spool paths (or --smoke)")
+    fleet = args.fleet or all(
+        p.endswith(".jsonl") or p.endswith(".jsonl.1") for p in args.trace)
+    if fleet:
+        events = load_fleet_events(args.trace)
+    else:
+        events = []
+        for p in args.trace:
+            events.extend(load_events(p))
+    doc = summarize(events, top=args.top)
     if args.json:
         print(json.dumps(doc, indent=1))
     else:
