@@ -60,6 +60,12 @@ class WarmupEntry(NamedTuple):
     scales: bool
     mesh: Optional[Tuple[int, int]]  # (data, model) axes, None = single-chip
     sharding: str                    # off | batch | tensor | hybrid
+    # quantized-weight program variant (PR 14): "float" | "w8" | "w4" —
+    # informational like mesh/sharding (the model's live params decide what
+    # the compile lowers against), but it makes the quantized program set
+    # explicit in `manager warmup` output and pins the manifest derivation
+    # to the graph actually deployed
+    variant: str = "float"
 
 
 class CompileStats:
@@ -230,17 +236,28 @@ def warmup_manifest(model, input_shape=None, dtype: str = "<f4",
         want_scales = True
     else:
         want_scales = False
+    # quantized-weight deployments (PR 14): the manifest enumerates the
+    # SAME (bucket, dtype, scales) surface, but every program lowers
+    # against the quantized graph — stamp the variant so the warm set is
+    # explicit about which program family it compiled (do_quantize bumps
+    # the AOT epoch, so float and quantized executables can never mix)
+    try:
+        from analytics_zoo_tpu.inference.quantize import quantized_bits
+        variant = {8: "w8", 4: "w4"}.get(
+            quantized_bits(getattr(model, "_params", None) or {}), "float")
+    except Exception:  # noqa: BLE001 — exotic bridge params
+        variant = "float"
     entries: List[WarmupEntry] = []
     for bucket in bucket_ladder(mb, multiple, model_cap=cap):
         entries.append(WarmupEntry(bucket, tail, np.dtype(dtype).str,
-                                   False, mesh, mode))
+                                   False, mesh, mode, variant))
         if want_scales:
             # compact-wire variants: the batch arrives in its wire dtype
             # with per-row dequant scales (engine QuantizedTensor path)
             for sdt in scale_dtypes:
                 entries.append(WarmupEntry(bucket, tail,
                                            np.dtype(sdt).str, True,
-                                           mesh, mode))
+                                           mesh, mode, variant))
     return entries
 
 

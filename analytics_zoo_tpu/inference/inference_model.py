@@ -518,7 +518,36 @@ class InferenceModel:
         except Exception:  # noqa: BLE001 — data-dependent init
             model.init_weights()
             like = {"params": model._params, "state": model._state}
-        tree = weightstore.load_store(store_dir, like=like)
+        try:
+            tree = weightstore.load_store(store_dir, like=like)
+        except KeyError:
+            # a QUANTIZED store (int8/int4 leaves + scales, PR 14) does not
+            # match the float init skeleton — restore by the stored paths
+            # (container names remapped onto the fresh model's auto-names,
+            # shared leaves verified); layer lookup is key-based, so the
+            # nested dicts slot straight in and predict serves quantized
+            # from the mmap'd leaves.  The fallback is gated on the store
+            # actually holding quantized leaves: a FLOAT store that failed
+            # the keyed+positional match is corrupt or belongs to another
+            # topology, and must keep failing loudly here, not at first
+            # predict
+            from analytics_zoo_tpu.inference.quantize import QUANT_LEAVES
+            manifest = weightstore.read_manifest(store_dir) or {}
+            names = {k.rsplit("/", 1)[-1]
+                     for k in (manifest.get("leaves") or {})}
+            if not names & set(QUANT_LEAVES):
+                raise
+            tree = weightstore.load_store_nested(store_dir, like=like)
+            # paramless/stateless layers' empty {} slots produce no store
+            # leaves; the executor still looks each one up — graft the
+            # container skeleton from the template around the restored
+            # leaves (params leaves may legitimately differ: {W_q4, s_g}
+            # replace the skeleton's {W})
+            tree["params"] = weightstore.graft_containers(
+                like.get("params", {}), tree.get("params", {}),
+                require_leaves=False)
+            tree["state"] = weightstore.graft_containers(
+                like.get("state", {}), tree.get("state", {}))
         params, state = tree["params"], tree["state"]
         # one transfer at load (vs one per predict for host-resident
         # params): DMA reads the mapped pages directly
@@ -564,26 +593,34 @@ class InferenceModel:
         return self.do_load_model(net, params, {})
 
     # -- quantization ----------------------------------------------------------
-    def do_quantize(self, calib_inputs, force: bool = False):
-        """Post-training int8 quantization of the loaded model (the
+    def do_quantize(self, calib_inputs, force: bool = False, bits: int = 8,
+                    group_size: int = 64,
+                    percentile: Optional[float] = None):
+        """Post-training weight quantization of the loaded model (the
         OpenVINO-int8 capability, pipeline/inference/OpenVinoInferenceSupportive
-        .scala analog — here targeting the MXU s8xs8->s32 path).
+        .scala analog — served through the fused-dequant kernels in
+        ops/quant_matmul.py).
 
-        `calib_inputs`: one batch (or list of batches) shaped like predict
-        inputs; used to calibrate per-layer activation scales.  Dense/conv
-        weights become int8 with per-output-channel scales; predict() then
-        runs the quantized graph.
+        ``bits=8`` (W8A8): `calib_inputs` — one batch, a list of batches,
+        or a `FeatureSet` (sampled via quantize.calibrate_featureset) —
+        calibrates per-layer activation scales (`percentile` clips the
+        range at that percentile of |x| instead of absmax); dense/conv
+        weights become int8 with per-output-channel scales, ~4x less
+        weight HBM per predict.  ``bits=4`` (W4A16): weight-only int4 with
+        group-wise scales (`group_size` contraction rows per scale, two
+        weights per byte, ~8x less weight HBM) — no calibration needed,
+        `calib_inputs` may be None.
 
         OPT-IN on TPU v5e (re-measured 2026-07-30 round 5 with the
         LICM-proof timing loop, bench.py bench_resnet50_int8): raw
         s8xs8->s32 kernels reach only ~1.0-1.2x the bf16 rate through this
         XLA stack (tools/int8_matrix.py; bf16 already runs near the
-        197 TF/s nameplate — int8 does NOT unlock a doubled MXU rate), and
-        the per-layer quantize/clip/dequant elementwise passes push the
-        END-TO-END quantized ResNet-50 to 0.82x bf16.  Unlike the reference's
-        AVX512-VNNI target, int8 here costs speed; accuracy parity holds
-        (top-1 agreement 1.0).  Pass force=True to quantize anyway (memory
-        footprint, numerics experiments)."""
+        197 TF/s nameplate — int8 does NOT unlock a doubled MXU rate) — a
+        COMPUTE-bound model quantizes for footprint, not speed; the win
+        this path exists for is the MEMORY-bound serving regime (wide
+        heads, decode steps), where weight bytes are the wall.  Accuracy
+        parity holds (top-1 agreement 1.0 int8).  Pass force=True to
+        quantize."""
         import warnings
 
         from analytics_zoo_tpu.inference.quantize import (
@@ -592,17 +629,19 @@ class InferenceModel:
             raise RuntimeError("load a model first")
         if not force:
             warnings.warn(
-                "int8 PTQ is measurably SLOWER than bf16 on this TPU stack "
-                "(~0.84x end-to-end ResNet-50; raw-kernel matrix in "
-                "tools/int8_matrix.py) — skipping quantization. Pass "
-                "force=True to quantize anyway.", stacklevel=2)
+                "weight PTQ trades speed for HBM footprint on compute-bound "
+                "models through this XLA stack (~0.84x end-to-end ResNet-50; "
+                "raw-kernel matrix in tools/int8_matrix.py) — skipping "
+                "quantization. Pass force=True to quantize anyway.",
+                stacklevel=2)
             return self
         if not _target_layers(self._model, self._params or {}):
             # nothing quantizable (e.g. a TFNet-backed model whose predict
             # lambda must stay un-jitted) — leave the loaded path untouched
             return self
         self._params = quantize(self._model, self._params, self._state or {},
-                                calib_inputs)
+                                calib_inputs, bits=bits,
+                                group_size=group_size, percentile=percentile)
         model = self._model
         self._jitted = jax.jit(
             lambda p, s, x: model.apply(p, s, x, training=False)[0])

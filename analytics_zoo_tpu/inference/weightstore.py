@@ -59,11 +59,14 @@ def _fingerprint(flat: Dict[str, np.ndarray]) -> str:
     import zlib
     h = hashlib.sha256()
     for key in sorted(flat):
-        a = np.ascontiguousarray(flat[key])
+        # order="C" (not ascontiguousarray, which silently promotes 0-d
+        # scalars to (1,)): quantized trees carry 0-d scale leaves whose
+        # shape must round-trip exactly (PR 14)
+        a = np.asarray(flat[key], order="C")
         h.update(key.encode())
         h.update(str(a.shape).encode())
         h.update(np.dtype(a.dtype).str.encode())
-        crc = zlib.crc32(memoryview(a.view(np.uint8).reshape(-1)))
+        crc = zlib.crc32(memoryview(a.reshape(-1).view(np.uint8)))
         h.update(crc.to_bytes(4, "little"))
     return h.hexdigest()
 
@@ -100,7 +103,7 @@ def save_store(store_dir: str, tree) -> Dict:
     total = 0
     try:
         for i, key in enumerate(sorted(flat)):
-            a = np.ascontiguousarray(flat[key])
+            a = np.asarray(flat[key], order="C")   # preserves 0-d shapes
             np.save(os.path.join(tmp, _leaf_file(i)), a,
                     allow_pickle=False)
             leaves[key] = {"file": _leaf_file(i),
@@ -144,8 +147,126 @@ def load_flat(store_dir: str, mmap: bool = True) -> Dict[str, np.ndarray]:
     mode = "r" if mmap else None
     out = {}
     for key, meta in manifest["leaves"].items():
-        out[key] = np.load(os.path.join(store_dir, meta["file"]),
-                           mmap_mode=mode, allow_pickle=False)
+        a = np.load(os.path.join(store_dir, meta["file"]),
+                    mmap_mode=mode, allow_pickle=False)
+        # manifest dtype/shape check (PR 14): quantized stores carry
+        # int8/uint8-packed and f32-scale leaves whose bit patterns must
+        # survive VERBATIM — a leaf file that drifted from its manifest
+        # entry (partial rewrite, wrong-store mixup) must fail loudly,
+        # never dequantize garbage
+        if np.dtype(a.dtype).str != meta["dtype"] \
+                or list(a.shape) != list(meta["shape"]):
+            raise ValueError(
+                f"weight store {store_dir}: leaf {key!r} is "
+                f"{a.shape}/{np.dtype(a.dtype).str} on disk but the "
+                f"manifest records {meta['shape']}/{meta['dtype']}")
+        out[key] = a
+    return out
+
+
+def _natural(path: str):
+    """Sort key splitting digit runs out of each path segment, so
+    auto-name suffixes order numerically (dense_9 < dense_10) — plain
+    lexicographic order diverges from creation order at every power-of-10
+    suffix boundary and would cross-wire a positional container remap."""
+    import re
+    return tuple(tuple(int(p) if p.isdigit() else p
+                       for p in re.split(r"(\d+)", seg))
+                 for seg in path.split("/"))
+
+
+def _nest(flat: Dict[str, np.ndarray]) -> dict:
+    """{path: leaf} -> nested dicts keyed by path segments (the ONE
+    flat-to-nested rebuild shared by load_store and load_store_nested)."""
+    nested: dict = {}
+    for key, val in flat.items():
+        cur = nested
+        parts = key.split("/")
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = val
+    return nested
+
+
+def load_store_nested(store_dir: str, like=None, mmap: bool = True):
+    """Nested path-keyed restore for trees whose LEAF structure differs
+    from any available template — the quantized-store path (PR 14): a
+    store exported after ``do_quantize`` holds {W_q/W_q4, s_w/s_g, s_x}
+    leaves no float init skeleton matches, so the structure must come from
+    the store itself.
+
+    With ``like``, container DIRECTORIES are remapped positionally onto
+    the template's (layer auto-naming is process-global, so a template
+    built after other models carries shifted name suffixes — the same
+    rationale as :func:`load_store`'s positional fallback), and every leaf
+    name present in BOTH a mapped container and its template counterpart
+    (biases, any unquantized weight) is shape/dtype-verified; a mismatch
+    raises ``KeyError`` rather than serving someone else's weights."""
+    from analytics_zoo_tpu.utils.serialization import _path_str
+    flat = load_flat(store_dir, mmap=mmap)
+    mapping = {}
+    if like is not None:
+        import jax
+        paths, _ = jax.tree_util.tree_flatten_with_path(like)
+        tflat = {"/".join(_path_str(p) for p in path_elems): leaf
+                 for path_elems, leaf in paths}
+        sdirs = sorted({k.rsplit("/", 1)[0] for k in flat if "/" in k},
+                       key=_natural)
+        tdirs = sorted({k.rsplit("/", 1)[0] for k in tflat if "/" in k},
+                       key=_natural)
+        if sdirs != tdirs:
+            if len(sdirs) != len(tdirs):
+                raise KeyError(
+                    f"store {store_dir}: {len(sdirs)} containers cannot "
+                    f"map onto the template's {len(tdirs)}")
+            mapping = dict(zip(sdirs, tdirs))
+        # verify every leaf name present in BOTH a (possibly remapped)
+        # container and its template counterpart — identity mappings
+        # included, so a same-named store from a different topology still
+        # fails loudly here instead of at first predict
+        for skey, leaf in flat.items():
+            if "/" not in skey:
+                continue
+            sdir, name = skey.rsplit("/", 1)
+            tdir = mapping.get(sdir, sdir)
+            want = tflat.get(f"{tdir}/{name}")
+            if want is not None and (
+                    tuple(np.shape(want)) != tuple(leaf.shape)
+                    or np.dtype(getattr(want, "dtype", np.float32))
+                    != leaf.dtype):
+                raise KeyError(
+                    f"store {store_dir}: container {sdir!r} -> {tdir!r} — "
+                    f"shared leaf {name!r} is {leaf.shape}/{leaf.dtype}, "
+                    f"template expects {np.shape(want)}")
+        if mapping:
+            logger.warning(
+                "weightstore: %s restored with remapped container names "
+                "(auto-named layers built in a different order?); shared "
+                "leaves verified shape/dtype", store_dir)
+    if mapping:
+        flat = {(f"{mapping[k.rsplit('/', 1)[0]]}/{k.rsplit('/', 1)[1]}"
+                 if "/" in k else k): v for k, v in flat.items()}
+    return _nest(flat)
+
+
+def graft_containers(skeleton, got, require_leaves: bool = True):
+    """Rebuild ``skeleton``'s dict structure around the real leaves in
+    ``got``: container dicts (including EMPTY ones — paramless/stateless
+    layers' slots, which a flattened store cannot represent) come from the
+    skeleton; skeleton leaves may be abstract ``eval_shape`` values and
+    are never returned.  With ``require_leaves`` every skeleton leaf
+    position must exist in ``got``; without it, missing skeleton leaves
+    are allowed — the quantized-params case, where {W_q4, s_g} replace the
+    skeleton's {W}."""
+    if not isinstance(skeleton, dict):
+        return got
+    out = dict(got) if isinstance(got, dict) else {}
+    for key, val in skeleton.items():
+        if isinstance(val, dict):
+            out[key] = graft_containers(val, out.get(key, {}),
+                                        require_leaves=require_leaves)
+        elif key not in out and require_leaves:
+            raise KeyError(f"leaf {key!r} missing from the restored tree")
     return out
 
 
@@ -158,14 +279,7 @@ def load_store(store_dir: str, like=None, mmap: bool = True):
     from analytics_zoo_tpu.utils.serialization import _path_str
     flat = load_flat(store_dir, mmap=mmap)
     if like is None:
-        nested: dict = {}
-        for key, val in flat.items():
-            cur = nested
-            parts = key.split("/")
-            for part in parts[:-1]:
-                cur = cur.setdefault(part, {})
-            cur[parts[-1]] = val
-        return nested
+        return _nest(flat)
     paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     like_keys = ["/".join(_path_str(p) for p in path_elems)
                  for path_elems, _ in paths]
@@ -175,14 +289,17 @@ def load_store(store_dir: str, like=None, mmap: bool = True):
     # positional fallback: layer auto-naming is process-global, so a
     # template built AFTER other models in the same process carries
     # shifted name suffixes (dense_3/W for the store's dense_1/W).  The
-    # sorted leaf order is name-stable; accept it only when every leaf's
-    # shape+dtype matches exactly, else fail loudly.
-    store_keys = sorted(flat)
+    # NATURALLY-sorted leaf order is name-stable (numeric suffixes order
+    # as numbers, so a _9/_10 boundary cannot cross-wire the zip); accept
+    # it only when every leaf's shape+dtype matches exactly, else fail
+    # loudly.
+    store_keys = sorted(flat, key=_natural)
     if len(store_keys) != len(like_keys):
         raise KeyError(
             f"store {store_dir} has {len(store_keys)} leaves, template "
             f"expects {len(like_keys)}")
-    order = sorted(range(len(like_keys)), key=lambda i: like_keys[i])
+    order = sorted(range(len(like_keys)),
+                   key=lambda i: _natural(like_keys[i]))
     leaves: list = [None] * len(like_keys)
     template_leaves = [leaf for _, leaf in paths]
     for skey, i in zip(store_keys, order):

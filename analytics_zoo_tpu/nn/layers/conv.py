@@ -106,18 +106,28 @@ class _ConvND(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = self._to_tf(x)
-        if "W_q" in params:
-            # int8 PTQ path (inference/quantize.py): s8 x s8 -> s32 conv on
-            # the MXU, dequantized by per-output-channel scale.
-            s_x = params["s_x"]
-            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
-                          -127, 127).astype(jnp.int8)
-            acc = jax.lax.conv_general_dilated(
-                xq, params["W_q"], window_strides=self.subsample,
-                padding=_pad_str(self.border_mode, self.ndim), rhs_dilation=self.dilation,
-                dimension_numbers=self._dn(), feature_group_count=self.groups,
-                preferred_element_type=jnp.int32)
-            y = acc.astype(jnp.float32) * (s_x * params["s_w"])
+        if "W_q" in params or "W_q4" in params:
+            # PTQ paths (inference/quantize.py) via the fused-dequant
+            # kernels (ops/quant_matmul.py): pointwise convs route through
+            # the blockwise matmul kernel, spatial convs keep the weights
+            # compact and dequantize at the conv's weight read.
+            from analytics_zoo_tpu.ops import quant_matmul as qm
+            conv_kw = dict(window_strides=self.subsample,
+                           padding=_pad_str(self.border_mode, self.ndim),
+                           rhs_dilation=self.dilation,
+                           dimension_numbers=self._dn(),
+                           feature_group_count=self.groups)
+            if "W_q4" in params:
+                kshape = self.kernel_size + (
+                    int(x.shape[-1]) // self.groups, self.nb_filter)
+                y = qm.w4a16_conv(x, params["W_q4"], params["s_g"], kshape,
+                                  **conv_kw)
+            else:
+                s_x = params["s_x"]
+                xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
+                              -127, 127).astype(jnp.int8)
+                y = qm.w8a8_conv(xq, params["W_q"],
+                                 s_x * params["s_w"], **conv_kw)
             if self.bias:
                 y = y + params["b"]
             return self._from_tf(self.activation(y.astype(dtypes.param_dtype())))

@@ -41,17 +41,24 @@ class Dense(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
-        if "W_q" in params:
-            # Post-training-quantized path (inference/quantize.py): symmetric
-            # int8 activations (per-tensor scale from calibration) x int8
-            # weights (per-output-channel scale), int32 MXU accumulation.
-            s_x = params["s_x"]
-            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
-                          -127, 127).astype(jnp.int8)
-            acc = jax.lax.dot_general(
-                xq, params["W_q"], (((x.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            y = acc.astype(jnp.float32) * (s_x * params["s_w"])
+        if "W_q" in params or "W_q4" in params:
+            # Post-training-quantized paths (inference/quantize.py), served
+            # through the fused-dequant kernels (ops/quant_matmul.py): the
+            # weights stay compact in HBM and dequantize per-tile in VMEM.
+            from analytics_zoo_tpu.ops import quant_matmul as qm
+            if "W_q4" in params:
+                # W4A16: weight-only int4 with group-wise scales — the
+                # activations stay full precision
+                y = qm.w4a16_dense(x.astype(jnp.float32), params["W_q4"],
+                                   params["s_g"])
+            else:
+                # W8A8: symmetric int8 activations (per-tensor scale from
+                # calibration) x int8 weights (per-output-channel scale),
+                # int32 MXU accumulation, dequant on the output tile
+                s_x = params["s_x"]
+                xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
+                              -127, 127).astype(jnp.int8)
+                y = qm.w8a8_dense(xq, params["W_q"], s_x * params["s_w"])
             if "b" in params:
                 y = y + params["b"]
             return self.activation(y.astype(dtypes.param_dtype()))

@@ -120,20 +120,50 @@ def replicated_plan() -> ShardingPlan:
     return ShardingPlan([], default=P())
 
 
+def _quantized_companions(pat: str, column: bool) -> List[Tuple[str, P]]:
+    """Sharding rules for a ``.../W$`` rule's quantized siblings (PR 14):
+    the int8/int4 weight leaves split exactly like the float weight they
+    replace, and each scale leaf rides the axis its values are indexed by
+    — per-out-channel ``s_w`` (N,) splits with a column-parallel out dim
+    and replicates for row-parallel; group-wise ``s_g`` (groups, N) keeps
+    its group axis with the contraction dim.  (The int8-wire per-row
+    activation scales already shard alongside the batch — PR 6; this is
+    the same principle applied to the weight-side scales.)"""
+    if not pat.endswith("/W$"):
+        return []
+    wq = pat.replace("/W$", "/W_q$")
+    wq4 = pat.replace("/W$", "/W_q4$")
+    sw = pat.replace("/W$", "/s_w$")
+    sg = pat.replace("/W$", "/s_g$")
+    if column:       # (K, N) split on N
+        return [(wq, P(None, "model")), (wq4, P(None, "model")),
+                (sw, P("model",)), (sg, P(None, "model"))]
+    # row-parallel: (K, N) split on K — packed nibbles and groups split
+    # along the same contraction axis (ShardingPlan._fit replicates any
+    # leaf whose rows don't divide, with its one-time warning)
+    return [(wq, P("model", None)), (wq4, P("model", None)),
+            (sw, P()), (sg, P("model", None))]
+
+
 def megatron_plan(column_patterns: Optional[Sequence[str]] = None,
                   row_patterns: Optional[Sequence[str]] = None,
                   embed_patterns: Optional[Sequence[str]] = None
                   ) -> ShardingPlan:
     """Default tensor-parallel plan for transformer-ish stacks: qkv/ffn-in are
-    column-parallel, attention-out/ffn-proj are row-parallel, embeddings vocab-sharded."""
+    column-parallel, attention-out/ffn-proj are row-parallel, embeddings
+    vocab-sharded.  Every weight rule carries its quantized-sibling rules
+    (W_q/W_q4 + scales) so a quantized model re-shards consistently under
+    the same plan."""
     rules: List[Tuple[str, P]] = []
     for pat in (column_patterns or [r".*qkv/W$", r".*_ffn/fc/W$",
                                     r".*fc\d*/W$"]):
         rules.append((pat, P(None, "model")))
+        rules.extend(_quantized_companions(pat, column=True))
     for pat in (column_patterns or [r".*qkv/b$", r".*_ffn/fc/b$"]):
         rules.append((pat.replace("/W$", "/b$"), P("model",)))
     for pat in (row_patterns or [r".*attn/out/W$", r".*_ffn/proj/W$"]):
         rules.append((pat, P("model", None)))
+        rules.extend(_quantized_companions(pat, column=False))
     for pat in (embed_patterns or [r".*(wte|word|embed.*)/(E)$", r".*wte$",
                                    r".*word$"]):
         rules.append((pat, P("model", None)))

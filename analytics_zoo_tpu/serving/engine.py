@@ -304,6 +304,72 @@ class _FailedDispatch(_ResultHandle):
         raise self._exc
 
 
+def resolve_quantize_spec(q) -> Optional[Dict]:
+    """Normalize the `ServingParams.quantize` surface to a spec dict
+    {"bits", "group_size", "percentile", "calib"} (or None = off).
+    Accepts None/False, "int8"/"int4", 8/4, or a dict with those keys."""
+    if not q:
+        return None
+    if isinstance(q, dict):
+        spec = dict(q)
+    elif q in ("int8", "int4", 8, 4, "8", "4", True):
+        spec = {"bits": 8 if q in ("int8", 8, "8", True) else 4}
+    else:
+        raise ValueError(
+            f"quantize={q!r}: expected int8|int4|8|4 or a spec dict")
+    bits = int(spec.get("bits", 8))
+    if bits not in (8, 4):
+        raise ValueError(f"quantize.bits={bits!r}: expected 8 or 4")
+    return {"bits": bits,
+            "group_size": int(spec.get("group_size", 64)),
+            "percentile": (None if spec.get("percentile") is None
+                           else float(spec["percentile"])),
+            "calib": spec.get("calib")}
+
+
+def apply_quantize(model, spec) -> bool:
+    """Quantize an InferenceModel per a (resolved) `quantize` spec — the
+    ONE application path shared by ClusterServing construction and
+    `manager warmup`, so the store the manager exports and the graph a
+    replica serves are the same program family.  Returns True when the
+    model was quantized here, False when it already was (a quantized
+    mmap store restored at load — re-quantizing int8 leaves would stack
+    errors).  An int8 spec on an unquantized model REQUIRES calibration
+    data (`calib`: .npy one batch / .npz batch-per-entry): activation
+    scales cannot be conjured, so this fails construction loudly."""
+    from analytics_zoo_tpu.inference.quantize import quantized_bits
+    spec = resolve_quantize_spec(spec)
+    if spec is None:
+        return False
+    have = quantized_bits(getattr(model, "_params", None) or {})
+    if have:
+        if have != spec["bits"]:
+            logger.warning(
+                "serving: model already quantized at %d bits; ignoring "
+                "the quantize=%d config (re-load float weights to "
+                "re-quantize)", have, spec["bits"])
+        return False
+    calib = None
+    if spec["calib"]:
+        import numpy as _np
+        loaded = _np.load(spec["calib"], allow_pickle=False)
+        calib = [loaded[k] for k in loaded.files] \
+            if hasattr(loaded, "files") else loaded
+    if spec["bits"] == 8 and calib is None:
+        raise ValueError(
+            "quantize: int8 needs activation calibration — provide "
+            "quantize.calib (.npy/.npz batch file), quantize offline via "
+            "do_quantize(FeatureSet, bits=8), or serve a quantized "
+            "weight store")
+    model.do_quantize(calib, force=True, bits=spec["bits"],
+                      group_size=spec["group_size"],
+                      percentile=spec["percentile"])
+    logger.info("serving: model quantized to int%d at construction "
+                "(group_size=%d, percentile=%s, calib=%s)", spec["bits"],
+                spec["group_size"], spec["percentile"], spec["calib"])
+    return True
+
+
 class ServingParams:
     """config.yaml surface (scripts/cluster-serving/config.yaml parity)."""
 
@@ -337,7 +403,8 @@ class ServingParams:
                  compile_cache_dir: Optional[str] = None,
                  generation=None,
                  trace_sample: float = 1.0,
-                 serving_slo=None):
+                 serving_slo=None,
+                 quantize=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -443,6 +510,18 @@ class ServingParams:
         # the serving_slo_burn_rate gauge.  None = off.
         self.serving_slo = serving_slo if isinstance(serving_slo, dict) \
             else None
+        # fused-dequant quantized predict (PR 14).  `quantize`: None/off
+        # (float serve, the default) | "int8"/8 | "int4"/4 | a config dict
+        # {"bits": 8|4, "group_size": 64, "percentile": 99.9,
+        #  "calib": "/path/to/batch.npy|.npz"} — applied at ClusterServing
+        # construction (before sharding) when the model is not already
+        # quantized.  int4 is weight-only (no calibration needed); int8
+        # needs activation scales, so an unquantized model REQUIRES the
+        # `calib` file (fail-fast at construction, like a bad mesh) —
+        # calibrate offline with do_quantize(FeatureSet) for real data, or
+        # let `manager warmup` quantize + export the mmap store so replica
+        # forks serve quantized without re-quantizing.
+        self.quantize = resolve_quantize_spec(quantize)
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -492,7 +571,8 @@ class ServingParams:
             compile_cache_dir=p.get("compile_cache_dir"),
             generation=p.get("generation"),
             trace_sample=p.get("trace_sample", 1.0),
-            serving_slo=p.get("serving_slo"))
+            serving_slo=p.get("serving_slo"),
+            quantize=p.get("quantize"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -513,6 +593,14 @@ class ClusterServing:
         self.model = model
         self.queue = queue
         self.params = params or ServingParams()
+        # fused-dequant quantized predict (PR 14): quantize BEFORE the
+        # mesh placement so the quantized leaves are what the plan shards
+        # — a bad spec (int8 with no calibration) fails construction, not
+        # a mid-stream request.  A model restored from a quantized weight
+        # store skips this (already quantized).
+        if self.params.quantize and isinstance(model, InferenceModel):
+            apply_quantize(model, self.params.quantize)
+        self._qbits: Optional[int] = None    # lazily cached health() value
         # sharded multi-chip serving (PR 6): place the model over the mesh
         # BEFORE any worker can dispatch — a bad mesh config fails
         # construction, not a mid-stream request.  Idempotent for a model
@@ -2053,6 +2141,21 @@ class ClusterServing:
         doc["e2e"] = self._e2e.snapshot()
         return doc
 
+    def _quantized_bits(self) -> int:
+        """0 float, 8 W8A8, 4 W4A16 — what the loaded model serves with.
+        Fixed after construction, so computed once and cached: health()
+        backs the /healthz poll loops and must not re-flatten a large
+        params tree per scrape."""
+        if self._qbits is None:
+            try:
+                from analytics_zoo_tpu.inference.quantize import (
+                    quantized_bits)
+                self._qbits = quantized_bits(
+                    getattr(self.model, "_params", None) or {})
+            except Exception:  # noqa: BLE001 — bridge models, exotic params
+                self._qbits = 0
+        return self._qbits
+
     def health(self) -> Dict:
         """Serving health surface (manager `status` / ops, `/healthz`):
         worker states, restart counts, breaker state, record/dead-letter/
@@ -2100,6 +2203,9 @@ class ClusterServing:
              "warmup": self.warmup_state(),
              "cold_start_s": (None if self._cold_start_s is None
                               else round(self._cold_start_s, 3)),
+             # fused-dequant quantized predict (PR 14): what the model
+             # serves with — 0 float, 8 int8 (W8A8), 4 int4 (W4A16)
+             "quantized_bits": self._quantized_bits(),
              "breaker": self._breaker.health(),
              "dead_letter_breaker": self._dead_breaker.health(),
              # live data-plane knob targets (PR 10): the autoscaler's

@@ -86,6 +86,19 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # spans always record.  0
                                         # disables span volume entirely
                                         # (metrics stay on).
+      quantize: null                    # fused-dequant quantized predict
+                                        # (PR 14): null/off = float serve,
+                                        # int8 | int4, or a dict
+                                        # {bits: 8|4, group_size: 64,
+                                        # percentile: 99.9, calib:
+                                        # /path/batch.npy}.  `manager
+                                        # warmup` quantizes BEFORE
+                                        # exporting the weight store, so
+                                        # replica forks serve quantized
+                                        # from the mmap'd store with zero
+                                        # steady-state compiles.  int8
+                                        # needs `calib` (activation
+                                        # scales); int4 is weight-only
       serving_slo: null                 # SLO attribution (PR 13):
                                         # {latency_ms: 500, window_s: 60,
                                         # target: 0.99} judges every
@@ -820,6 +833,15 @@ def main(argv=None):
             aot.enable_persistent_cache(cache_dir)
         store = _weights_dir(args.pidfile)
         im = load_model(cfg, weight_store=store)
+        if params.quantize:
+            # quantize BEFORE the export + warm-up (PR 14): the store this
+            # pass persists holds the packed int4 / int8 + scale leaves,
+            # and the programs it compiles are the quantized graph — a
+            # replica fork then mmaps quantized weights and hits the warm
+            # cache, compiling nothing.  A store already quantized (a
+            # prior warmup pass) restores as-is and is skipped here.
+            from analytics_zoo_tpu.serving.engine import apply_quantize
+            apply_quantize(im, params.quantize)
         exported = False
         if getattr(im, "_params", None):
             try:
@@ -842,10 +864,14 @@ def main(argv=None):
             im.shard(mesh=params.mesh_shape, sharding=params.sharding)
         stats = aot.warm_up(im, aot.resolve_manifest(
             im, params.warmup if params.warmup else True))
+        from analytics_zoo_tpu.inference.quantize import quantized_bits
         print(json.dumps({"cache_dir": cache_dir, "weight_store": store,
                           "store_exported": exported,
                           "load_seconds": im.load_seconds,
-                          "load_mmap": im.load_mmap, **stats}))
+                          "load_mmap": im.load_mmap,
+                          "quantized_bits": quantized_bits(
+                              getattr(im, "_params", None) or {}),
+                          **stats}))
         return 0 if stats["failed"] == 0 else 1
     if args.action == "trace":
         # fleet-wide trace reconstruction (PR 13): merge every span spool

@@ -72,6 +72,13 @@ def pytest_configure(config):
         "carry a default 300 s SIGALRM budget")
     config.addinivalue_line(
         "markers",
+        "quant: fused-dequant quantized-predict tests (Pallas kernel "
+        "parity vs the XLA oracle, int4/int8 calibration + packing, "
+        "quantized weight-store round-trips, warm quantized serving); "
+        "they run the kernels in interpret mode on CPU and compile "
+        "small programs, so they carry a default 120 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
         "tracing: fleet-wide distributed-tracing tests (span propagation "
         "across LB/gateway/engine, spool merge, SLO attribution); the "
         "cross-process ones spawn replica subprocesses and long-poll "
@@ -92,6 +99,7 @@ AUTOSCALE_DEFAULT_TIMEOUT_S = 300.0
 COLDSTART_DEFAULT_TIMEOUT_S = 300.0
 GENERATION_DEFAULT_TIMEOUT_S = 300.0
 TRACING_DEFAULT_TIMEOUT_S = 120.0
+QUANT_DEFAULT_TIMEOUT_S = 120.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -121,6 +129,8 @@ def pytest_runtest_call(item):
             seconds = GENERATION_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("tracing") is not None:
             seconds = TRACING_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("quant") is not None:
+            seconds = QUANT_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

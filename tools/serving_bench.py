@@ -321,6 +321,108 @@ def _run_trace_overhead(im, args):
     }
 
 
+# -- fused-dequant quantized predict A/B (PR 14) -------------------------------
+
+def _quantize_eval_batch(args, n=256):
+    """Eval/calibration sample drawn from the SAME distribution _enqueue
+    ships, so the bench's accuracy delta measures the serving workload,
+    not a synthetic one."""
+    g = np.random.default_rng(1)
+    if args.smoke:
+        return g.random((n, 16)).astype(np.float32)
+    if args.model == "mlp":
+        return g.random((n, args.image * args.image * 3)).astype(np.float32)
+    return g.random((n, args.image, args.image, 3)).astype(np.float32)
+
+
+def _run_quantize_ab(args):
+    """Interleaved float-vs-quantized A/B of the steady predict workload:
+    throughput AND accuracy delta side by side (the RUNLOG contract — a
+    quantized speedup that silently costs top-1 is not a win).  Both
+    sides share one Layer; each side is its own InferenceModel, warmed
+    over the engine's bucket ladder before any measured lap so steady
+    laps compile NOTHING (asserted).  int8 calibrates on a FeatureSet
+    sample of the workload distribution — the full calibration workflow,
+    not hand-built arrays.  The structural half of the claim
+    (weight-bytes ratio) is wall-clock-independent; on CPU containers the
+    kernels serve through the XLA reference, so wall-clock deltas only
+    mean something on real TPUs (README caveat)."""
+    from analytics_zoo_tpu.feature.dataset import FeatureSet
+    from analytics_zoo_tpu.inference import aot
+    from analytics_zoo_tpu.inference.quantize import (
+        quantized_bits, weight_bytes)
+
+    bits = {"int8": 8, "int4": 4}[args.quantize]
+    laps = max(1, int(args.quantize_laps))
+    im_fp = _build_model(args)
+    model = im_fp._model
+    im_q = type(im_fp)(supported_concurrent_num=max(2, args.inflight)) \
+        .do_load_model(model, im_fp._params, im_fp._state)
+
+    x_eval = _quantize_eval_batch(args, n=(96 if args.smoke else 256))
+    y_fp = im_fp.do_predict(x_eval)
+    if bits == 8:
+        calib = FeatureSet.from_arrays(x_eval[:64])
+        im_q.do_quantize(calib, force=True, bits=8,
+                         percentile=args.quantize_percentile)
+    else:
+        im_q.do_quantize(None, force=True, bits=4,
+                         group_size=args.quantize_group)
+    assert quantized_bits(im_q._params) == bits
+    y_q = im_q.do_predict(x_eval)
+    agreement = float((y_q.argmax(-1) == y_fp.argmax(-1)).mean())
+    max_delta = float(np.abs(y_q - y_fp).max())
+    wb_fp = weight_bytes(im_fp._params)
+    wb_q = weight_bytes(im_q._params)
+
+    # warm BOTH sides over the engine's bucket ladder so the measured
+    # laps serve from the AOT cache (PR 11 contract: zero steady-state
+    # compiles, asserted below via the executable-cache counter)
+    mb = args.max_batch or args.batch
+    for im in (im_fp, im_q):
+        stats = aot.warm_up(im, aot.warmup_manifest(im, max_batch=mb))
+        assert stats["failed"] == 0, stats
+    # one discarded lap per side absorbs incidental first-use jits
+    # (postprocess top-N etc.) that are not bucket programs
+    _run_once(im_fp, args, args.batch)
+    _run_once(im_q, args, args.batch)
+    compiles0 = im_q.aot_stats()["compiles"]
+    fp_rates, q_rates = [], []
+    for _ in range(laps):
+        for im, rates in ((im_fp, fp_rates), (im_q, q_rates)):
+            out = _run_once(im, args, args.batch)
+            assert out["records"] == args.n, \
+                f"lost records: {out['records']}/{args.n}"
+            rates.append(out["wall_records_per_sec"])
+    steady_compiles = im_q.aot_stats()["compiles"] - compiles0
+    assert steady_compiles == 0, \
+        f"quantized steady laps compiled {steady_compiles} program(s)"
+    fp_med = float(np.median(fp_rates))
+    q_med = float(np.median(q_rates))
+    return {
+        "mode": "quantize-ab",
+        "quantize": args.quantize,
+        "bits": bits,
+        "group_size": (args.quantize_group if bits == 4 else None),
+        "percentile": (args.quantize_percentile if bits == 8 else None),
+        "records_per_lap": args.n,
+        "laps_per_side": laps,
+        "float_records_per_sec": round(fp_med, 1),
+        "quantized_records_per_sec": round(q_med, 1),
+        "float_laps": fp_rates,
+        "quantized_laps": q_rates,
+        "quantized_speedup": round(q_med / fp_med, 3) if fp_med else None,
+        # accuracy delta, side by side with throughput (the contract)
+        "top1_agreement": round(agreement, 4),
+        "max_abs_delta": round(max_delta, 5),
+        # the structural HBM claim: bytes of weights read per predict
+        "weight_bytes_float": wb_fp,
+        "weight_bytes_quantized": wb_q,
+        "weight_bytes_ratio": round(wb_fp / wb_q, 2) if wb_q else None,
+        "steady_compiles_quantized": steady_compiles,
+    }
+
+
 # -- zero-cold-start A/B (PR 11) ----------------------------------------------
 
 def _cold_start_child(args):
@@ -1183,6 +1285,25 @@ def main(argv=None):
                     help="laps per side for --trace-overhead (7 default: "
                          "at 3 the lap noise on small containers is the "
                          "same order as the effect being measured)")
+    ap.add_argument("--quantize", choices=("off", "int8", "int4"),
+                    default="off",
+                    help="PR 14 fused-dequant quantized-predict A/B: "
+                         "interleaved float-vs-quantized laps reporting "
+                         "throughput AND accuracy delta (top-1 agreement, "
+                         "max prob delta) side by side in --json, plus the "
+                         "structural weight-bytes ratio (~4x int8, ~8x "
+                         "int4).  int8 calibrates on a FeatureSet sample "
+                         "of the workload; int4 is weight-only")
+    ap.add_argument("--quantize-laps", type=int, default=3,
+                    help="quantize A/B: interleaved lap pairs per side "
+                         "(medians reported; one discarded warm-up lap "
+                         "per side absorbs incidental jits)")
+    ap.add_argument("--quantize-group", type=int, default=64,
+                    help="quantize A/B: int4 group size (contraction rows "
+                         "per scale)")
+    ap.add_argument("--quantize-percentile", type=float, default=None,
+                    help="quantize A/B: int8 calibration percentile clip "
+                         "(default absmax)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 smoke: tiny MLP workload, asserts the "
                          "pipeline completes with stage metrics populated")
@@ -1302,7 +1423,6 @@ def main(argv=None):
     if args.smoke:
         args.n = min(args.n, 96)
         args.batch = min(args.batch, 8)
-    im = _build_model(args)
 
     def _write_json(results):
         """The trackable results document: one file per bench invocation,
@@ -1319,6 +1439,25 @@ def main(argv=None):
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)
         os.replace(tmp, args.json_path)
+
+    if args.quantize != "off":
+        if args.model not in ("mlp", "resnet") and not args.smoke:
+            ap.error("--quantize A/B needs a dense/conv predict model: "
+                     "--model mlp|resnet (or --smoke)")
+        if args.smoke:
+            args.quantize_laps = 1
+        out = _run_quantize_ab(args)
+        print(json.dumps(out))
+        _write_json([out])
+        if args.smoke:
+            # the smoke contract: accuracy measured, structural HBM win
+            # real, zero steady-state compiles on the quantized side
+            assert out["top1_agreement"] >= 0.9
+            assert out["weight_bytes_quantized"] < out["weight_bytes_float"]
+            assert out["steady_compiles_quantized"] == 0
+        return out
+
+    im = _build_model(args)
 
     if args.trace_overhead:
         out = _run_trace_overhead(im, args)
