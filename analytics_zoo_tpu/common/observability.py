@@ -41,6 +41,18 @@ spool writers use (``serving/tracecollect.py`` merges spools fleet-wide).
 pipeline stage (``serving_slo_violations_total{stage=}``) and maintains a
 windowed burn-rate gauge, feeding the fleet metrics merge.
 
+Incident forensics (PR 15): ``FlightRecorder`` is the black-box half the
+trace spans never carried — a bounded, lock-cheap ring of typed EVENTS
+(state transitions, retunes, reclaims, quarantines, warm-up phases,
+compile requests, scheduler boundaries, autoscaler decisions) that every
+subsystem already emitting a log line also records.  Events live on the
+monotonic clock like spans and drain through the same spool contract
+(``serving/tracecollect.append_events`` / ``merge_spools``), so `manager
+incident` snapshots one merged cross-process timeline of what every
+process was DOING around a crash or SLO burn, not just where time went.
+``process_stats()`` is the per-process resource read (RSS, CPU seconds,
+open FDs, thread count) the health doc and prom exposition carry.
+
 Pure stdlib + numpy-free: safe to import from the client, the queues, and
 the trainer without dragging in jax.
 """
@@ -528,6 +540,7 @@ class MetricsRegistry:
 
 _global_registry: Optional[MetricsRegistry] = None
 _global_tracer: Optional["Tracer"] = None
+_global_recorder: Optional["FlightRecorder"] = None
 _global_lock = threading.Lock()
 
 
@@ -549,6 +562,137 @@ def get_tracer() -> "Tracer":
         if _global_tracer is None:
             _global_tracer = Tracer()
         return _global_tracer
+
+
+def get_recorder() -> "FlightRecorder":
+    """The process-wide flight recorder (PR 15).  ONE ring per process by
+    design: a replica process has one engine, and cross-layer emitters
+    (AOT compile listeners, the LB, the supervisor) must land in the same
+    ring the manager loop drains — events carry a ``replica`` attr when
+    several engines share a test process."""
+    global _global_recorder
+    with _global_lock:
+        if _global_recorder is None:
+            _global_recorder = FlightRecorder()
+        return _global_recorder
+
+
+# -- incident flight recorder (PR 15) ------------------------------------------
+
+class FlightRecorder:
+    """Bounded in-process ring of typed events — the serving black box.
+
+    An event is a plain dict ``{"event": kind, "ts": monotonic seconds,
+    ...attrs}``; ``record()`` is the hot-path call, so it does the minimum
+    under its lock (one deque append — the deque's maxlen evicts the
+    oldest entry for free).  ``drain_events()`` is the atomic take+clear
+    export hop the manager's spool loop calls, mirroring
+    ``Tracer.drain_spans()`` so event spools ride the exact same
+    rotation/clock-normalization contract as trace spools
+    (``serving/tracecollect``).  ``recorded``/``dropped`` make ring
+    pressure itself observable: a ring too small for the drain period
+    shows up as a dropped count, not silent amnesia."""
+
+    DEFAULT_MAXLEN = 4096
+
+    def __init__(self, maxlen: int = DEFAULT_MAXLEN,
+                 replica_id: Optional[str] = None):
+        self._events: deque = deque(maxlen=max(16, int(maxlen)))
+        self._lock = threading.Lock()
+        self.replica_id = replica_id
+        self.recorded = 0        # lifetime events seen
+        self.dropped = 0         # evicted before a drain saw them
+
+    @property
+    def maxlen(self) -> int:
+        return self._events.maxlen or 0
+
+    def resize(self, maxlen: int) -> None:
+        """Re-bound the ring (config ``recorder_ring``), keeping the most
+        recent events."""
+        maxlen = max(16, int(maxlen))
+        with self._lock:
+            if maxlen == self._events.maxlen:
+                return
+            self._events = deque(self._events, maxlen=maxlen)
+
+    def record(self, kind: str, **attrs) -> Dict:
+        """Append one event.  Attrs must be JSON-safe scalars/short
+        strings — the spool writer downgrades anything else.  Never
+        raises: the recorder is diagnostic, not load-bearing."""
+        ev = {"event": str(kind), "ts": time.monotonic()}
+        if self.replica_id is not None:
+            ev["replica_id"] = self.replica_id
+        for k, v in attrs.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+            self.recorded += 1
+        return ev
+
+    def events(self, kind: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e.get("event") == kind]
+        return out
+
+    def drain_events(self) -> List[Dict]:
+        """Atomically take every buffered event and clear the ring — the
+        export hop the manager spool loop calls
+        (``tracecollect.append_events``)."""
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"buffered": len(self._events),
+                    "maxlen": self._events.maxlen,
+                    "recorded": self.recorded,
+                    "dropped": self.dropped}
+
+
+# -- per-process resource accounting (PR 15 satellite) --------------------------
+
+def process_stats() -> Dict:
+    """RSS bytes, cumulative CPU seconds, open FDs and thread count for
+    THIS process — the per-process half of the resource ledger, read from
+    /proc on Linux with ``resource``-module fallbacks elsewhere.  Any
+    field that cannot be read reports None instead of raising: this runs
+    on every /healthz scrape."""
+    rss = cpu = fds = threads = None
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource as _res
+        ru = _res.getrusage(_res.RUSAGE_SELF)
+        cpu = float(ru.ru_utime + ru.ru_stime)
+        if rss is None and ru.ru_maxrss:
+            rss = int(ru.ru_maxrss) * 1024    # peak, the portable fallback
+    except Exception:  # noqa: BLE001 — non-POSIX
+        pass
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    try:
+        threads = threading.active_count()
+    except Exception:  # noqa: BLE001
+        pass
+    return {"rss_bytes": rss, "cpu_seconds": cpu,
+            "open_fds": fds, "threads": threads}
 
 
 # -- tracing -------------------------------------------------------------------

@@ -107,6 +107,16 @@ class CompileStats:
             with self._lock:
                 self.compile_requests += 1
                 self.compile_seconds += float(dur)
+            # incident flight recorder (PR 15): compile requests are
+            # first-class forensic events — "the replica was compiling"
+            # explains a stall better than any latency histogram
+            try:
+                from analytics_zoo_tpu.common.observability import (
+                    get_recorder)
+                get_recorder().record("compile",
+                                      seconds=round(float(dur), 4))
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
 
 
 COMPILE_STATS = CompileStats()

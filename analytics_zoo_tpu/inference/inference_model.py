@@ -136,6 +136,11 @@ class InferenceModel:
         self._aot_epoch = 0
         self.aot_hits = 0               # padded calls served by the cache
         self.aot_compiles = 0           # lower().compile() calls we made
+        # per-program execution counters (PR 15 resource accounting):
+        # label -> executions, keyed the way the warm-up manifest names
+        # programs (bucket x tail-shape / dtype [+scales]) so "which
+        # program is actually hot" reads straight off the health doc
+        self._aot_execs: Dict[str, int] = {}
         self.load_seconds: Optional[float] = None   # last do_load* wall
         self.load_mmap = False          # last load used the mmap store
         # scaled-program wrappers per base program (bounded): a base that
@@ -373,6 +378,7 @@ class InferenceModel:
             self._aot_epoch += 1
             self._aot.clear()
             self._scaled_wrappers.clear()
+            self._aot_execs.clear()    # counts name the OLD epoch's programs
 
     def _aot_key(self, fn, xs: List, sc, multi: bool):
         # `fn` (the jitted base or its per-base scaled wrapper) is part of
@@ -425,7 +431,40 @@ class InferenceModel:
                     exe = self._aot.setdefault(key, exe)
         else:
             self.aot_hits += 1
-        return exe(*args) if execute else None
+        if execute:
+            label = self.program_label(xs, scales=sc)
+            with self._aot_lock:
+                self._aot_execs[label] = self._aot_execs.get(label, 0) + 1
+            return exe(*args)
+        return None
+
+    @staticmethod
+    def program_label(xs: List, scales=None) -> str:
+        """Human-stable program name matching the warm-up manifest entry
+        naming: ``b<bucket>x<tail shape>/<dtype>[+scales]``."""
+        a = xs[0]
+        tail = "x".join(str(int(s)) for s in a.shape[1:]) or "scalar"
+        label = (f"b{int(a.shape[0])}x{tail}/"
+                 f"{np.dtype(a.dtype).str}")
+        return label + "+scales" if scales is not None else label
+
+    def aot_memory_bytes(self) -> Optional[int]:
+        """Best-effort total generated-code size of the cached AOT
+        executables (the ``executables`` HBM component of the resource
+        ledger).  None when this jax/backend exposes no memory analysis —
+        the count is still exact either way."""
+        total, seen = 0, 0
+        with self._aot_lock:
+            exes = list(self._aot.values())
+        for exe in exes:
+            try:
+                ma = exe.memory_analysis()
+                total += int(getattr(ma, "generated_code_size_in_bytes",
+                                     0) or 0)
+                seen += 1
+            except Exception:  # noqa: BLE001 — backend without analysis
+                continue
+        return total if seen else None
 
     def warm(self, bucket: int, shape, dtype: str = "<f4",
              scales: bool = False) -> bool:
@@ -451,12 +490,15 @@ class InferenceModel:
         return fresh
 
     def aot_stats(self) -> Dict:
-        """AOT-cache evidence counters (bench/test surface)."""
+        """AOT-cache evidence counters (bench/test surface) + the
+        per-program execution counts (PR 15): which compiled program is
+        actually serving traffic, keyed by its manifest-style label."""
         with self._aot_lock:
             return {"epoch": self._aot_epoch,
                     "cached_programs": len(self._aot),
                     "hits": self.aot_hits,
-                    "compiles": self.aot_compiles}
+                    "compiles": self.aot_compiles,
+                    "programs": dict(self._aot_execs)}
 
     # -- loaders --------------------------------------------------------------
     def do_load_model(self, model: Layer, params=None, state=None):

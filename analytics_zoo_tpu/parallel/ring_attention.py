@@ -23,12 +23,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from analytics_zoo_tpu.common.context import SEQ_AXIS
+from analytics_zoo_tpu.utils import jaxcompat
 
 
 def _ring_local(q, k, v, *, axis_name: str, causal: bool,
                 scale: Optional[float]):
     """Per-shard body.  q/k/v: (B, H, T_local, D)."""
-    n = jax.lax.axis_size(axis_name)
+    n = jaxcompat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -78,7 +79,7 @@ def _ring_local_flash(q, k, v, *, axis_name: str, causal: bool,
     the flash backward as a delta shift)."""
     from analytics_zoo_tpu.ops.flash_attention import flash_attention_with_lse
 
-    n = jax.lax.axis_size(axis_name)
+    n = jaxcompat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     NEG = jnp.float32(-1e30)
@@ -151,7 +152,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
                          "(expected 'auto', 'flash', or 'xla')")
     body = (_ring_local_flash if impl == "flash" else _ring_local)
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = jaxcompat.shard_map(
         functools.partial(body, axis_name=axis_name, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

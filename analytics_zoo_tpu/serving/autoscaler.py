@@ -460,6 +460,16 @@ class Autoscaler:
                  "target": act.target, "knobs": act.knobs,
                  "reason": act.reason}
         self._decisions.append(entry)
+        # incident flight recorder (PR 15): every actuated decision lands
+        # on the process timeline next to LB/lifecycle events, so an
+        # incident bundle shows WHAT the controller did around the burn
+        try:
+            from analytics_zoo_tpu.common.observability import get_recorder
+            get_recorder().record(
+                "autoscale", action=act.kind,
+                target=act.target, reason=str(act.reason)[:200])
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
         logger.info(
             "autoscaler: %s target=%s knobs=%s (%s) [depth=%d pending=%d "
             "replicas=%d/%d]", act.kind, act.target, act.knobs, act.reason,

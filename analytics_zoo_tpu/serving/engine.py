@@ -404,7 +404,10 @@ class ServingParams:
                  generation=None,
                  trace_sample: float = 1.0,
                  serving_slo=None,
-                 quantize=None):
+                 quantize=None,
+                 flight_recorder: bool = True,
+                 recorder_ring: Optional[int] = None,
+                 profiling: bool = True):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -522,6 +525,22 @@ class ServingParams:
         # let `manager warmup` quantize + export the mmap store so replica
         # forks serve quantized without re-quantizing.
         self.quantize = resolve_quantize_spec(quantize)
+        # incident flight recorder (PR 15).  `flight_recorder`: record
+        # typed events (state transitions, retunes, reclaims, quarantines,
+        # sheds, warm-up phases, scheduler boundaries) into the bounded
+        # process ring that `manager incident` bundles — per-EVENT cost is
+        # one dict + deque append, so it stays on by default; off compiles
+        # the hop down to a no-op like tracing=False.  `recorder_ring`
+        # re-bounds the ring (default 4096 events); size it to cover the
+        # diagnosis window between manager drains (1 s) at your event
+        # rate.  `profiling`: serve POST /debug/profile?seconds=N on the
+        # probe port (jax.profiler trace into the deployment dir) — probe
+        # surface only, the LB never proxies /debug; false removes the
+        # route entirely.
+        self.flight_recorder = bool(flight_recorder)
+        self.recorder_ring = (None if recorder_ring is None
+                              else max(16, int(recorder_ring)))
+        self.profiling = bool(profiling)
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -572,7 +591,11 @@ class ServingParams:
             generation=p.get("generation"),
             trace_sample=p.get("trace_sample", 1.0),
             serving_slo=p.get("serving_slo"),
-            quantize=p.get("quantize"))
+            quantize=p.get("quantize"),
+            flight_recorder=bool(p.get("flight_recorder", True)),
+            recorder_ring=(None if p.get("recorder_ring") is None
+                           else int(p["recorder_ring"])),
+            profiling=bool(p.get("profiling", True)))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -680,6 +703,25 @@ class ClusterServing:
         # configured latency objective, charging the dominant stage
         self._slo = SloTracker.from_config(self.registry,
                                            self.params.serving_slo)
+        # incident flight recorder (PR 15): the PROCESS ring — one per
+        # process by design, so the AOT compile listeners, the gateway
+        # and the engine all land on the one timeline the manager drains
+        # to <pidfile>.events.jsonl.  Events carry this replica's id so
+        # several engines sharing a test process stay attributable.
+        # flight_recorder=False compiles the hop to a no-op (the ring
+        # itself stays — other subsystems may still record).
+        from analytics_zoo_tpu.common.observability import get_recorder
+        self.recorder = get_recorder()
+        if self.params.recorder_ring:
+            self.recorder.resize(self.params.recorder_ring)
+        self._event = (self._record_event if self.params.flight_recorder
+                       else (lambda *a, **kw: None))
+        # on-demand device profiling (PR 15): one jax.profiler trace at a
+        # time, written under profile_dir (the manager points it at
+        # <pidfile>.profiles)
+        self.profile_dir: Optional[str] = None
+        self._profile_lock = threading.Lock()
+        self._profile_active = False
         self._t_start = time.monotonic()     # re-stamped by start()
         self._snapshot_seq = itertools.count(1)
         p = self.params
@@ -831,6 +873,41 @@ class ClusterServing:
                            fn=slots_fn), slots_fn))
             self._last_steps = 0
             self._tps_window = (time.monotonic(), 0)   # (t0, tokens0)
+        # resource accounting (PR 15): decompose device memory into
+        # weights (PR 14 stored-dtype bytes) / kv_state (PR 12 lane
+        # buffers) / executables (PR 11 AOT cache) — live gauges + the
+        # health doc `resources` block the fleet aggregation sums
+        from analytics_zoo_tpu.inference.resources import ResourceLedger
+        from analytics_zoo_tpu.common.observability import process_stats
+        self._ledger = ResourceLedger(model, batcher=self._batcher)
+        hbm = reg.gauge("serving_hbm_bytes",
+                        "Device memory by component: weights (stored "
+                        "dtype), kv_state (generation lane buffers), "
+                        "executables (AOT generated code)",
+                        labels=("component",))
+        for comp in ResourceLedger.COMPONENTS:
+            fn = (lambda c=comp: self._ledger.hbm_bytes(c))
+            child = hbm.labels(component=comp)
+            child.add_function(fn)
+            self._gauge_fns.append((child, fn))
+        # per-process resource gauges (PR 15 satellite): RSS / CPU / FDs /
+        # threads — per PROCESS, so engines pooling one registry in a
+        # test process sum to the same process figure N times; real
+        # deployments run one engine per process and the fleet merge sums
+        # across processes
+        for name, help_, key in (
+                ("process_resident_memory_bytes",
+                 "Resident set size of this serving process", "rss_bytes"),
+                ("process_cpu_seconds_total",
+                 "User+system CPU seconds consumed by this process",
+                 "cpu_seconds"),
+                ("process_open_fds",
+                 "Open file descriptors in this process", "open_fds"),
+                ("process_threads_total",
+                 "Live threads in this process", "threads")):
+            fn = (lambda k=key: float(process_stats().get(k) or 0))
+            g = reg.gauge(name, help_, fn=fn)
+            self._gauge_fns.append((g, fn))
         self._tb = None
         if tensorboard_dir:
             from analytics_zoo_tpu.utils.tbwriter import FileWriter
@@ -857,6 +934,74 @@ class ClusterServing:
 
     def _heartbeat_age(self) -> float:
         return time.monotonic() - self._hb_ts
+
+    # -- incident flight recorder (PR 15) ------------------------------------
+    def _record_event(self, kind: str, **attrs) -> None:
+        """The engine's event hop: stamp replica identity, never raise —
+        forensics must not be able to take serving down."""
+        try:
+            self.recorder.record(kind, replica=self.replica_id, **attrs)
+        except Exception:  # noqa: BLE001 — diagnostic, not load-bearing
+            pass
+
+    # -- on-demand device profiling (PR 15) ----------------------------------
+    PROFILE_MIN_S, PROFILE_MAX_S = 0.05, 300.0
+
+    def start_profile(self, seconds: float,
+                      out_dir: Optional[str] = None) -> Dict:
+        """Arm one ``jax.profiler`` trace for ``seconds`` into the
+        deployment's profile dir (the manager points ``profile_dir`` at
+        ``<pidfile>.profiles``).  ONE trace at a time — a second request
+        while one is armed raises ``RuntimeError`` (the gateway maps it
+        to 409).  The start/sleep/stop cycle runs entirely on a daemon
+        thread: ``jax.profiler.start_trace`` can take SECONDS to bring
+        the profiler server up (measured ~15 s in sandboxed containers),
+        and a probe-port handler must never block that long — the 202
+        reply means "armed", the trace lands in ``path`` when done (the
+        ``profile_done`` flight-recorder event marks completion)."""
+        import tempfile
+        seconds = min(max(float(seconds), self.PROFILE_MIN_S),
+                      self.PROFILE_MAX_S)
+        base = out_dir or self.profile_dir or os.path.join(
+            tempfile.gettempdir(), f"serving-profile-{self.replica_id}")
+        path = os.path.join(
+            base, time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}")
+        with self._profile_lock:
+            if self._profile_active:
+                raise RuntimeError(
+                    "a profiling trace is already armed/running — one "
+                    "at a time per process")
+            os.makedirs(path, exist_ok=True)
+            self._profile_active = True
+
+        def _run():
+            try:
+                import jax
+                jax.profiler.start_trace(path)
+                time.sleep(seconds)
+                jax.profiler.stop_trace()
+                self._event("profile_done", path=path, seconds=seconds)
+            except Exception as e:  # noqa: BLE001 — the trace failing
+                # must not leave the engine permanently "busy"
+                logger.exception("serving: profiling trace failed")
+                self._event("profile_error",
+                            error=f"{type(e).__name__}: {e}"[:200])
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — was never started
+                    pass
+            finally:
+                with self._profile_lock:
+                    self._profile_active = False
+
+        threading.Thread(target=_run, name="serving-profile",
+                         daemon=True).start()
+        self._event("profile_start", path=path, seconds=seconds)
+        logger.info("serving: profiling armed for %.2fs into %s",
+                    seconds, path)
+        return {"profiling": True, "path": path,
+                "seconds": seconds, "replica_id": self.replica_id}
 
     # -- distributed tracing (PR 13) -----------------------------------------
     _TRACE_META_CAP = 8192
@@ -1058,6 +1203,8 @@ class ClusterServing:
                 "(lease %.3gs, %d suppressed as duplicates)",
                 self.replica_id, len(out), p.lease_s,
                 len(entries) - len(out))
+            self._event("reclaim", count=len(out),
+                        suppressed=len(entries) - len(out))
         return out
 
     # -- result write with backpressure (ClusterServing.scala:276-307) -------
@@ -1126,6 +1273,8 @@ class ClusterServing:
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
                          error=msg)
         logger.warning("serving: quarantining record %r (%s)", rid, msg)
+        self._event("quarantine", rid=str(rid), stage=stage,
+                    error=msg[:200], trace_id=trace_id)
         handled = False
         try:
             self._dead_breaker.call(self.queue.put_error, rid, msg,
@@ -1199,6 +1348,7 @@ class ClusterServing:
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
                          error=error)
         logger.info("serving: shedding expired record %r", rid)
+        self._event("shed", rid=str(rid), stage=stage, trace_id=trace_id)
         result = {"error": error}
         if extra:
             result.update(extra)
@@ -1361,6 +1511,7 @@ class ClusterServing:
                     q.not_full.notify_all()
         logger.info("serving: replica %s retuned %s", self.replica_id,
                     staged)
+        self._event("retune", **{k: float(v) for k, v in staged.items()})
 
     def _read_and_preprocess(self):
         """Read one micro-batch and preprocess it record-by-record, returning
@@ -1779,6 +1930,10 @@ class ClusterServing:
         self._predict_sup.start()
         if self._write_sup is not None:
             self._write_sup.start()
+        self._event("start", mode=("generation" if self._batcher is not None
+                                   else "predict"),
+                    max_batch=p.max_batch or p.batch_size,
+                    quantized_bits=self._quantized_bits() or None)
         # compat aliases: the raw threads, for callers that poked at them
         self._pre_thread = self._pre_sup._thread
         self._thread = self._predict_sup._thread
@@ -1812,6 +1967,7 @@ class ClusterServing:
         # start() and the first compile must already say warming
         self._warm_state.update(state="pending", total=len(manifest),
                                 compiled=0, failed=0, seconds=None)
+        self._event("warmup", state="pending", total=len(manifest))
         self._warm_thread = threading.Thread(
             target=self._warmup_loop, args=(manifest,),
             name="serving-warmup", daemon=True)
@@ -1820,6 +1976,8 @@ class ClusterServing:
     def _warmup_loop(self, manifest) -> None:
         from analytics_zoo_tpu.inference import aot
         self._warm_state["state"] = "warming"
+        self._event("warmup", state="warming",
+                    total=self._warm_state.get("total"))
 
         def progress(done, total, entry):
             self._warm_state["compiled"] = done
@@ -1835,6 +1993,7 @@ class ClusterServing:
             # block readiness forever; the lazy path still serves
             logger.exception("serving: warm-up pass failed")
             self._warm_state.update(state="failed", error=str(e))
+            self._event("warmup", state="failed", error=str(e)[:200])
             return
         if stats.get("stopped"):
             self._warm_state.update(state="cancelled")
@@ -1843,6 +2002,10 @@ class ClusterServing:
             state="ready" if not stats["failed"] else "degraded",
             failed=stats["failed"], seconds=stats["seconds"],
             compile_stats=stats["compile_stats"])
+        self._event("warmup",
+                    state="ready" if not stats["failed"] else "degraded",
+                    programs=stats["programs"], failed=stats["failed"],
+                    seconds=stats["seconds"])
         self._g_warm.labels(phase="compile").set(float(stats["seconds"]))
         if self._cold_start_s is None:
             # serving-capable without having seen traffic yet: the replica
@@ -1995,6 +2158,18 @@ class ClusterServing:
             self._m_decode_steps.inc(steps - self._last_steps)
             self._last_steps = steps
         self._update_tps(now)
+        kinds = [ev.kind for ev in events]
+        if any(k in ("finish", "shed", "quarantine") for k in kinds) or \
+                b.last_admitted:
+            # scheduler-boundary event (PR 15): recorded only when the
+            # slot population changed — per-quantum decode churn would
+            # otherwise dominate the ring without adding forensic signal
+            self._event("gen_boundary", active=b.active,
+                        waiting=b.waiting,
+                        admitted=b.last_admitted,
+                        finished=kinds.count("finish"),
+                        shed=kinds.count("shed"),
+                        quarantined=kinds.count("quarantine"))
         self._handle_gen_events(events)
 
     def _update_tps(self, now: float) -> None:
@@ -2156,6 +2331,22 @@ class ClusterServing:
                 self._qbits = 0
         return self._qbits
 
+    def _resources_doc(self) -> Dict:
+        """The health-doc ``resources`` block (never raises — a probe
+        must answer even when a component read fails mid-reload)."""
+        try:
+            return self._ledger.doc()
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    def _process_doc() -> Dict:
+        from analytics_zoo_tpu.common.observability import process_stats
+        try:
+            return process_stats()
+        except Exception:  # noqa: BLE001
+            return {}
+
     def health(self) -> Dict:
         """Serving health surface (manager `status` / ops, `/healthz`):
         worker states, restart counts, breaker state, record/dead-letter/
@@ -2206,6 +2397,16 @@ class ClusterServing:
              # fused-dequant quantized predict (PR 14): what the model
              # serves with — 0 float, 8 int8 (W8A8), 4 int4 (W4A16)
              "quantized_bits": self._quantized_bits(),
+             # resource accounting (PR 15): HBM decomposition (weights /
+             # kv_state / executables + per-program exec counts) and the
+             # per-process resource read — fleet-aggregated by
+             # serving/fleet.py, scrapeable as serving_hbm_bytes /
+             # process_* gauges
+             "resources": self._resources_doc(),
+             "process": self._process_doc(),
+             # flight-recorder ring pressure (PR 15): a dropped count
+             # means the ring is too small for the drain period
+             "recorder": self.recorder.stats(),
              "breaker": self._breaker.health(),
              "dead_letter_breaker": self._dead_breaker.health(),
              # live data-plane knob targets (PR 10): the autoscaler's
@@ -2321,6 +2522,8 @@ class ClusterServing:
         and ``manager scale N`` retire replicas this way)."""
         if drain_s is None:
             drain_s = 0.0
+        self._event("shutdown", drain_s=drain_s,
+                    retire=not close_admission)
         sups = (self._pre_sup, self._predict_sup, self._write_sup)
         started = any(s is not None for s in sups)
         if drain_s > 0 and started:

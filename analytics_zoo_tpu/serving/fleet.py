@@ -133,6 +133,15 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     cold_start: Optional[float] = None   # slowest measured cold start
     slo_burn: Optional[float] = None     # worst replica burn rate (PR 13)
     slo_violations = 0
+    # resource accounting (PR 15): HBM components SUM across replicas
+    # (each replica pins its own copy), per-process stats sum with a max
+    # alongside RSS so one bloated replica stands out
+    res = {"weights_bytes": 0, "kv_state_bytes": 0, "executables": 0,
+           "executable_code_bytes": 0, "total_bytes": 0}
+    res_seen = False
+    proc = {"rss_bytes": 0, "rss_max_bytes": 0, "cpu_seconds": 0.0,
+            "open_fds": 0, "threads": 0}
+    proc_seen = False
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -167,6 +176,25 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
         wv = slo.get("window_violations")
         if isinstance(wv, int):
             slo_violations += wv
+        r = doc.get("resources") or {}
+        if isinstance(r.get("weights_bytes"), (int, float)):
+            res_seen = True
+            res["weights_bytes"] += int(r.get("weights_bytes") or 0)
+            res["kv_state_bytes"] += int(r.get("kv_state_bytes") or 0)
+            res["total_bytes"] += int(r.get("total_bytes") or 0)
+            exes = r.get("executables") or {}
+            res["executables"] += int(exes.get("count") or 0)
+            res["executable_code_bytes"] += int(exes.get("code_bytes")
+                                                or 0)
+        pr = doc.get("process") or {}
+        if isinstance(pr.get("rss_bytes"), (int, float)):
+            proc_seen = True
+            proc["rss_bytes"] += int(pr.get("rss_bytes") or 0)
+            proc["rss_max_bytes"] = max(proc["rss_max_bytes"],
+                                        int(pr.get("rss_bytes") or 0))
+            proc["cpu_seconds"] += float(pr.get("cpu_seconds") or 0.0)
+            proc["open_fds"] += int(pr.get("open_fds") or 0)
+            proc["threads"] += int(pr.get("threads") or 0)
     return {"replicas_total": len(docs),
             "replicas_alive": alive,
             "replicas_warming": warming,
@@ -188,6 +216,13 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             # (ROADMAP item 1) will judge overload on
             "slo_burn_rate": slo_burn,
             "slo_window_violations": slo_violations,
+            # resource accounting (PR 15): fleet HBM decomposition +
+            # summed per-process resources (None when no replica reports
+            # them yet — old snapshots mid-rolling-upgrade)
+            "resources": res if res_seen else None,
+            "process": dict(proc, cpu_seconds=round(proc["cpu_seconds"],
+                                                    3))
+            if proc_seen else None,
             "knobs": knobs}
 
 
@@ -220,6 +255,12 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
                                 ("state", "compiled", "total", "seconds")}
         if doc.get("cold_start_s") is not None:
             member["cold_start_s"] = doc["cold_start_s"]
+        pr = doc.get("process") or {}
+        if isinstance(pr.get("rss_bytes"), (int, float)):
+            member["rss_bytes"] = int(pr["rss_bytes"])
+        r = doc.get("resources") or {}
+        if isinstance(r.get("total_bytes"), (int, float)):
+            member["hbm_bytes"] = int(r["total_bytes"])
         per_replica[doc.get("replica_id") or f"replica-{i}"] = member
     out = {"replicas": {"total": agg["replicas_total"],
                         "alive": agg["replicas_alive"],
@@ -242,6 +283,12 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
     if agg.get("slo_burn_rate") is not None:
         out["slo"] = {"burn_rate": agg["slo_burn_rate"],
                       "window_violations": agg["slo_window_violations"]}
+    # resource accounting (PR 15): the fleet HBM decomposition + summed
+    # per-process stats ride the metrics doc next to the SLO block
+    if agg.get("resources") is not None:
+        out["resources"] = agg["resources"]
+    if agg.get("process") is not None:
+        out["process"] = agg["process"]
     summary = lb_summary(lb)
     if summary is not None:
         out["lb"] = summary

@@ -270,9 +270,13 @@ class ContinuousBatcher:
         # slot that ran a decode step — the engine turns these into the
         # per-boundary decode spans TTFT decomposition needs
         self.last_boundary: List[Tuple] = []
+        self.last_admitted = 0       # admissions at the last boundary
         # compiled programs: ("prefill", pb, lane_bucket) |
         # ("decode_step", lane_bucket) | ("insert", lane_bucket)
         self._programs: Dict[tuple, object] = {}
+        # per-program execution counts (PR 15 resource accounting):
+        # scheduler-thread-only, keyed by the manifest-style program name
+        self._exec_counts: Dict[str, int] = {}
         self.compiles = 0
         self.decode_steps = 0
         self.generated_tokens = 0
@@ -352,6 +356,23 @@ class ContinuousBatcher:
             self._programs[key] = exe
             self.compiles += 1
         return exe
+
+    @staticmethod
+    def _program_name(key: tuple) -> str:
+        """Manifest-style label for one compiled scheduler program
+        (PR 15 per-program exec accounting)."""
+        if key[0] == "prefill":
+            return f"prefill:b{key[1]}xp{key[2]}@{key[3]}"
+        if key[0] == "insert":
+            return f"insert:b{key[1]}@{key[2]}"
+        if key[0] == "decode_step":
+            return f"decode_step@{key[1]}"
+        return ":".join(str(k) for k in key)
+
+    def _count_exec(self, key: tuple) -> None:
+        # scheduler-thread-only (step/admit run on one thread)
+        label = self._program_name(key)
+        self._exec_counts[label] = self._exec_counts.get(label, 0) + 1
 
     def _commit_state(self, state):
         """Commit a lane state buffer over the serving mesh (PR 6): slot
@@ -509,6 +530,7 @@ class ContinuousBatcher:
             exe = self._compiled(("prefill", bb, pb, lane.bucket), prefill,
                                  self._params(), padded, lengths)
             res = exe(self._params(), padded, lengths)
+            self._count_exec(("prefill", bb, pb, lane.bucket))
             if self._is_pair(res):
                 sub, logits0 = res
                 toks0 = np.asarray(jax.numpy.argmax(logits0, axis=-1))
@@ -534,6 +556,7 @@ class ContinuousBatcher:
             try:
                 lane.state = ins(lane.state, sub, np.int32(j),
                                  np.int32(slot))
+                self._count_exec(("insert", bb, lane.bucket))
             except Exception as e:  # noqa: BLE001 — per-row insert failure
                 self.quarantined += 1
                 events.append(GenEvent(
@@ -691,7 +714,7 @@ class ContinuousBatcher:
         events: List[GenEvent] = []
         self.last_boundary = []
         self._shed_active(events)
-        self._admit(events)
+        self.last_admitted = self._admit(events)
         for lane in self._lanes:
             if lane.active == 0:
                 continue
@@ -700,6 +723,7 @@ class ContinuousBatcher:
             exe = self._compiled(("decode_step", lane.bucket), step,
                                  self._params(), lane.state, tokens)
             block, lane.state = exe(self._params(), lane.state, tokens)
+            self._count_exec(("decode_step", lane.bucket))
             block = np.asarray(block)          # (decode_quantum, A)
             self.decode_steps += int(block.shape[0])   # token-level steps
             now = time.monotonic()
@@ -822,6 +846,36 @@ class ContinuousBatcher:
         raise ValueError(f"unknown warm-up entry kind {entry.kind!r}")
 
     # -- observability --------------------------------------------------------
+    def state_bytes(self) -> int:
+        """Bytes pinned by the committed lane state buffers — the
+        ``kv_state`` component of the resource ledger (PR 15).  Derived
+        from the leaf shapes/dtypes of each lane's fixed
+        ``(max_active, bucket)`` pytree, so the number is exact for the
+        bucket geometry in force regardless of where jax placed it."""
+        import jax
+        total = 0
+        for lane in self._lanes:
+            if lane.state is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(lane.state):
+                try:
+                    total += int(np.prod(leaf.shape)) \
+                        * int(np.dtype(leaf.dtype).itemsize)
+                except (TypeError, ValueError):
+                    continue
+            total += int(lane.tokens.nbytes)
+        return total
+
+    def program_stats(self) -> Dict:
+        """Compiled scheduler programs + per-program execution counts
+        (PR 15): the generation half of the per-program exec accounting,
+        keyed like the ``aot.generation_manifest`` entries
+        (``prefill:b<batch>xp<bucket>@<lane>`` etc.)."""
+        progs = {k: v for k, v in self._programs.items()
+                 if k and k[0] != "fns"}
+        return {"count": len(progs),
+                "programs": dict(self._exec_counts)}
+
     def stats(self) -> Dict:
         return {"slots_total": self.slots_total,
                 "active_slots": self.active,

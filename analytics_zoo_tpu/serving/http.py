@@ -383,6 +383,13 @@ class HealthServer:
             def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 from urllib.parse import urlsplit
                 parts = urlsplit(self.path)
+                if parts.path == "/debug/profile":
+                    # on-demand device profiling (PR 15): PROBE surface
+                    # only — the LB proxies /v1/* and nothing else, so
+                    # /debug never faces remote gateway traffic; the
+                    # params.profiling gate removes the route entirely
+                    self._profile(parts)
+                    return
                 if not (gateway_on and parts.path == "/v1/enqueue"):
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
@@ -390,6 +397,39 @@ class HealthServer:
                     self._enqueue(parts)
                 except Exception as e:  # noqa: BLE001 — gateway must answer
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _profile(self, parts) -> None:
+                """POST /debug/profile?seconds=N — start one
+                ``jax.profiler`` trace into the deployment's profile dir
+                (202 + the path), 409 while one is already running, 404
+                when ``params.profiling`` is off."""
+                if not bool(getattr(getattr(serving, "params", None),
+                                    "profiling", False)):
+                    self._reply(404, {"error": "profiling disabled "
+                                               "(params.profiling)"})
+                    return
+                start = getattr(serving, "start_profile", None)
+                if not callable(start):
+                    self._reply(404, {"error": "engine exposes no "
+                                               "profiler"})
+                    return
+                seconds = self._query_float(parts.query, "seconds")
+                if seconds is None:
+                    seconds = 5.0
+                if seconds <= 0:
+                    self._reply(400, {"error": "seconds must be > 0"})
+                    return
+                try:
+                    doc = start(seconds)
+                except RuntimeError as e:
+                    self._reply(409, {"error": str(e)},
+                                extra_headers=(("Retry-After", "5"),))
+                    return
+                except Exception as e:  # noqa: BLE001 — profiler missing
+                    self._reply(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply(202, doc)
 
             def _enqueue(self, parts) -> None:
                 """POST /v1/enqueue[?timeout_s=S] — binary frame or JSON

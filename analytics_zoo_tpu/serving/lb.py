@@ -83,8 +83,21 @@ class _Member:
 
     def mark(self, healthy: bool) -> None:
         with self.lock:
+            flipped = healthy != self.healthy
             self.healthy = healthy
             self.fails = 0 if healthy else self.fails + 1
+        if flipped:
+            # incident flight recorder (PR 15): member rotation flips are
+            # exactly the "what was the front door seeing" evidence an
+            # incident bundle needs (the supervisor drains this ring)
+            try:
+                from analytics_zoo_tpu.common.observability import (
+                    get_recorder)
+                get_recorder().record(
+                    "lb_member_up" if healthy else "lb_member_down",
+                    url=self.url)
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
 
 
 def static_members(urls: List[str]) -> Callable[[], List[str]]:

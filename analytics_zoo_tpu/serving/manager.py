@@ -99,6 +99,20 @@ config.yaml surface (scripts/cluster-serving/config.yaml template):
                                         # steady-state compiles.  int8
                                         # needs `calib` (activation
                                         # scales); int4 is weight-only
+      flight_recorder: true             # incident flight recorder
+                                        # (PR 15): typed events (state
+                                        # transitions, retunes, reclaims,
+                                        # quarantines, warm-up phases,
+                                        # scheduler boundaries) into a
+                                        # bounded ring, drained to
+                                        # <pidfile>.events.jsonl; false =
+                                        # no-op hop
+      recorder_ring: 4096               # ring size (events kept between
+                                        # the manager's 1 s drains)
+      profiling: true                   # POST /debug/profile?seconds=N
+                                        # on the replica PROBE port (the
+                                        # LB never proxies /debug); false
+                                        # removes the route
       serving_slo: null                 # SLO attribution (PR 13):
                                         # {latency_ms: 500, window_s: 60,
                                         # target: 0.99} judges every
@@ -176,6 +190,23 @@ CLI (used by scripts/cluster-serving/*.sh):
         # appends the controller's own exposition when the autoscaler is
         # running, plus (PR 13) the LB front door's own series from
         # <pidfile>.lb.json.
+    python -m analytics_zoo_tpu.serving.manager incident
+        [--list | --show [bundle] [--last N]]
+        # PR 15 incident forensics.  Bare `incident` snapshots a
+        # self-contained bundle NOW (works live or post-mortem) into
+        # <pidfile>.incidents/<ts>/: every process's flight-recorder
+        # event spool + trace spools + health snapshots + autoscaler
+        # decision log + LB telemetry + knobs/scale files.  The
+        # supervisor auto-captures on replica crash and on SLO-burn
+        # threshold (config `incident:` section).  --list enumerates
+        # bundles; --show renders one merged cross-process timeline
+        # (recorder events + trace spans, clock-normalized) —
+        # tools/incident_view.py renders the same document as text.
+    python -m analytics_zoo_tpu.serving.manager profile [replica]
+        [--seconds S]
+        # PR 15 on-demand device profiling: POST /debug/profile on the
+        # replica's probe port; a jax.profiler trace lands under
+        # <pidfile>.profiles/<ts>/ (open with TensorBoard/Perfetto).
     python -m analytics_zoo_tpu.serving.manager trace <trace_id>
     python -m analytics_zoo_tpu.serving.manager trace --slowest N
     python -m analytics_zoo_tpu.serving.manager trace --chrome fleet.json
@@ -374,6 +405,12 @@ def _cache_dir(pidfile: str) -> str:
     return pidfile + ".xla_cache"
 
 
+def _profiles_dir(pidfile: str) -> str:
+    """On-demand jax.profiler traces (PR 15): `manager profile <replica>`
+    lands one timestamped trace dir per run in here."""
+    return pidfile + ".profiles"
+
+
 def _weights_dir(pidfile: str) -> str:
     """Per-deployment mmap'd weight store (PR 11): `manager warmup`
     persists the params once, every replica boot maps the same pages."""
@@ -424,6 +461,23 @@ def _drain_spans(serving, pidfile: str) -> None:
         pass
 
 
+def _drain_events(pidfile: str, source=None) -> None:
+    """Flight-recorder spool hop (PR 15): drain this PROCESS's event ring
+    into ``<pidfile>.events.jsonl`` — same rotation/clock contract as the
+    span spools, so `manager incident`/`trace` merge both onto one
+    timeline.  Runs in replicas (engine/gateway/compile events) AND the
+    supervisor (autoscaler/LB/lifecycle events)."""
+    try:
+        from analytics_zoo_tpu.common.observability import get_recorder
+        from analytics_zoo_tpu.serving import tracecollect
+        events = get_recorder().drain_events()
+        if events:
+            tracecollect.append_events(tracecollect.events_path(pidfile),
+                                       events, source=source)
+    except Exception:  # noqa: BLE001 — forensics is never load-bearing
+        pass
+
+
 def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
                     http_port_offset: int = 0,
@@ -445,6 +499,9 @@ def _run_foreground(config_path: str, pidfile: str,
                                 http_port_offset=http_port_offset,
                                 cache_dir=cache_dir,
                                 weight_store=_weights_dir(base))
+    # on-demand profiling (PR 15): traces land next to the deployment's
+    # other artifacts, shared across the replicas of one base pidfile
+    serving.profile_dir = _profiles_dir(base)
     health_path = _health_path(pidfile)
     if knobs_path is None:
         knobs_path = _knobs_path(pidfile)
@@ -458,6 +515,7 @@ def _run_foreground(config_path: str, pidfile: str,
         # spool survives the process for post-mortem `manager trace`.
         serving.shutdown(drain_s=serving.params.drain_s)
         _drain_spans(serving, pidfile)
+        _drain_events(pidfile, source=serving.replica_id)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -475,6 +533,7 @@ def _run_foreground(config_path: str, pidfile: str,
         serving.shutdown(drain_s=serving.params.drain_s,
                          close_admission=False)
         _drain_spans(serving, pidfile)
+        _drain_events(pidfile, source=serving.replica_id)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -493,6 +552,8 @@ def _run_foreground(config_path: str, pidfile: str,
         # land in <pidfile>.spans.jsonl, merged fleet-wide by
         # `manager trace` / tools/trace_view.py
         _drain_spans(serving, pidfile)
+        # flight recorder (PR 15): same hop for the event ring
+        _drain_events(pidfile, source=serving.replica_id)
         # live knob nudges (PR 10 autoscaler fast tier): the supervisor's
         # autoscaler writes <base pidfile>.knobs.json; every replica polls
         # it once a second and applies via retune() — validated, and taken
@@ -584,6 +645,42 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
 
     cfg = load_config(config_path)
     params = serving_params(cfg)
+    # incident auto-capture (PR 15): config `incident:` section —
+    # `burn_threshold` snapshots a bundle when any replica's SLO burn
+    # rate crosses it, `on_crash` (default on) when a replica dies and
+    # is respawned, `cooldown_s` bounds capture frequency, `max_bundles`
+    # bounds disk.  Capture is supervisor-side file copying of drained
+    # spools: the serving hot path never blocks.
+    icfg = cfg.get("incident") if isinstance(cfg.get("incident"), dict) \
+        else {}
+    inc_burn = icfg.get("burn_threshold")
+    inc_burn = None if inc_burn is None else float(inc_burn)
+    inc_on_crash = bool(icfg.get("on_crash", True))
+    inc_cooldown = float(icfg.get("cooldown_s", 60.0))
+    inc_max = int(icfg.get("max_bundles", 20))
+    inc_last = {"t": -1e9}
+    from analytics_zoo_tpu.common.observability import get_recorder
+    recorder = get_recorder()
+
+    def _capture_incident(reason: str, meta=None):
+        from analytics_zoo_tpu.serving import incident as _incident
+        now = time.monotonic()
+        if now - inc_last["t"] < inc_cooldown:
+            return None
+        inc_last["t"] = now
+        recorder.record("incident", reason=reason, **(meta or {}))
+        # flush the supervisor's own ring first so the bundle carries the
+        # trigger event itself (replica spools were drained by their own
+        # 1 s loops — capture reads files, never the hot path)
+        _drain_events(pidfile, source="supervisor")
+        bundle = _incident.capture(pidfile, reason, meta=meta,
+                                   max_bundles=inc_max)
+        if bundle:
+            print(json.dumps({"event": "incident captured",
+                              "reason": reason, "bundle": bundle}),
+                  file=sys.stderr, flush=True)
+        return bundle
+
     if prewarm and params.warmup and \
             _resolve_cache_dir(params, pidfile):
         # pre-populate the deployment's compile cache + weight store so
@@ -615,6 +712,7 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
 
     def _spawn(index: int):
         last_spawn[index] = time.monotonic()
+        recorder.record("replica_spawn", index=index)
         pid = os.fork()
         if pid == 0:
             # child: plain replica process with its own pidfile/health
@@ -622,6 +720,11 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
             # installs its own graceful-drain SIGTERM handler
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
             signal.signal(signal.SIGINT, signal.SIG_DFL)
+            # the fork copies the supervisor's process-wide flight-
+            # recorder ring: clear it, or the child's 1 s drain would
+            # re-spool the supervisor's undrained events (this very
+            # spawn event included) misattributed to the replica
+            get_recorder().clear()
             try:
                 _run_foreground(config_path, _replica_pidfile(pidfile, index),
                                 replica_id=f"replica-{index}",
@@ -688,11 +791,25 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                 done = pid
             if done:
                 children.pop(index)
+                was_retiring = index in stopping
                 stopping.discard(index)
                 if index < desired:
                     print(json.dumps({"replica": index, "pid": pid,
                                       "event": "exited; respawning"}),
                           file=sys.stderr, flush=True)
+                    recorder.record("replica_exit", index=index, pid=pid,
+                                    respawning=True)
+                    if inc_on_crash:
+                        # PR 15: an unexpected replica death IS the
+                        # incident — bundle every process's recent
+                        # events/spans/health before evidence rotates
+                        _capture_incident(
+                            f"replica-{index}-crash",
+                            meta={"replica": index, "pid": pid})
+                else:
+                    recorder.record("replica_exit", index=index, pid=pid,
+                                    respawning=False,
+                                    retired=was_retiring)
         # scale down: highest-numbered replicas RETIRE (SIGUSR1: drain
         # their in-flight work, shared admission stays open for the
         # survivors) and exit; signalled once — a repeat would re-enter
@@ -701,6 +818,7 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
         for index in sorted(children, reverse=True):
             if index >= desired and index not in stopping:
                 stopping.add(index)
+                recorder.record("replica_retire", index=index)
                 try:
                     os.kill(children[index], retire_sig)
                 except OSError:
@@ -712,6 +830,29 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
             if index not in children and \
                     now - last_spawn.get(index, -1e9) >= 1.0:
                 _spawn(index)
+        # SLO-burn incident trigger (PR 15): the replicas' health
+        # snapshots already land next to the pidfile every second —
+        # cheap file reads, throttled by the capture cooldown itself
+        if inc_burn is not None:
+            worst = None
+            for index in range(desired):
+                try:
+                    with open(_health_path(
+                            _replica_pidfile(pidfile, index))) as f:
+                        doc = json.load(f)
+                    br = (doc.get("slo") or {}).get("burn_rate")
+                    if isinstance(br, (int, float)):
+                        worst = br if worst is None else max(worst, br)
+                except (OSError, ValueError):
+                    continue
+            if worst is not None and worst >= inc_burn:
+                _capture_incident(
+                    f"slo-burn {worst:.2f} >= threshold {inc_burn:.2f}",
+                    meta={"burn_rate": round(float(worst), 4),
+                          "threshold": inc_burn})
+        # the supervisor's own events (spawns, retires, autoscaler
+        # decisions, LB member flips) spool next to the replicas'
+        _drain_events(pidfile, source="supervisor")
         if scaler is not None:
             # controller observability through `manager metrics`: persist
             # the decision counters / target gauges / decision log next to
@@ -752,10 +893,12 @@ def main(argv=None):
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
                              "replay", "metrics", "scale", "warmup",
-                             "trace"])
+                             "trace", "incident", "profile"])
     ap.add_argument("value", nargs="?", default=None,
                     help="scale: target replica count; trace: the "
-                         "trace_id to reconstruct")
+                         "trace_id to reconstruct; incident --show: the "
+                         "bundle name (default latest); profile: the "
+                         "replica index (default 0)")
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
@@ -795,6 +938,18 @@ def main(argv=None):
                     help="trace: export the merged fleet timeline as "
                          "Chrome trace-event JSON (one track per "
                          "process) for Perfetto")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="incident: list captured bundles")
+    ap.add_argument("--show", action="store_true",
+                    help="incident: render a bundle's merged "
+                         "cross-process timeline (recorder events + "
+                         "trace spans); pass the bundle name as the "
+                         "positional value, default latest")
+    ap.add_argument("--last", type=int, default=200, metavar="N",
+                    help="incident --show: timeline entries to render "
+                         "(default 200)")
+    ap.add_argument("--seconds", type=float, default=5.0, metavar="S",
+                    help="profile: trace duration (default 5s)")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -873,6 +1028,81 @@ def main(argv=None):
                               getattr(im, "_params", None) or {}),
                           **stats}))
         return 0 if stats["failed"] == 0 else 1
+    if args.action == "incident":
+        # incident forensics (PR 15): capture/list/show self-contained
+        # bundles under <pidfile>.incidents/ — works on a live OR dead
+        # deployment (post-mortem forensics reads files, not processes)
+        from analytics_zoo_tpu.serving import incident as _incident
+        if args.list_:
+            print(json.dumps({"incidents":
+                              _incident.list_incidents(args.pidfile)}))
+            return 0
+        if args.show:
+            bundle = _incident.resolve_bundle(args.pidfile, args.value)
+            if bundle is None:
+                print(json.dumps({"error": "no incident bundle found "
+                                           f"(looked under "
+                                           f"{args.pidfile}.incidents)"}),
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(_incident.render(bundle, last=args.last)))
+            return 0
+        # operator-triggered capture: flush this CLI process's view is
+        # moot (replicas spool their own rings every second); just bundle
+        bundle = _incident.capture(args.pidfile, "operator",
+                                   meta={"via": "manager incident"})
+        if bundle is None:
+            print(json.dumps({"error": "nothing to capture (no spools/"
+                                       "health snapshots next to "
+                                       f"{args.pidfile})"}),
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({"captured": True, "bundle": bundle}))
+        return 0
+    if args.action == "profile":
+        # on-demand device profiling (PR 15): POST /debug/profile on the
+        # target replica's PROBE port (never via the LB/gateway surface)
+        try:
+            params = serving_params(load_config(args.config))
+        except OSError:
+            params = ServingParams()
+        if not params.http_port:
+            print(json.dumps({"error": "profile needs params.http_port "
+                                       "(the replica probe port)"}),
+                  file=sys.stderr)
+            return 1
+        index = 0
+        if args.value is not None:
+            try:
+                index = int(args.value)
+            except ValueError:
+                print(json.dumps({"error": f"profile: replica index "
+                                           f"expected, got "
+                                           f"{args.value!r}"}),
+                      file=sys.stderr)
+                return 1
+        import urllib.error
+        import urllib.request
+        url = (f"http://{params.http_host}:{params.http_port + index}"
+               f"/debug/profile?seconds={max(args.seconds, 0.05):g}")
+        try:
+            req = urllib.request.Request(url, data=b"", method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=10.0) as resp:
+                print(json.dumps(json.loads(resp.read())))
+                return 0
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except (ValueError, OSError):
+                body = {"error": f"HTTP {e.code}"}
+            print(json.dumps(dict(body, code=e.code)), file=sys.stderr)
+            return 1
+        except Exception as e:  # noqa: BLE001 — replica down
+            print(json.dumps({"error": f"replica {index} probe port "
+                                       f"unreachable ({type(e).__name__}"
+                                       f": {e})"}), file=sys.stderr)
+            return 1
     if args.action == "trace":
         # fleet-wide trace reconstruction (PR 13): merge every span spool
         # of the deployment (per-replica + LB, written next to the health

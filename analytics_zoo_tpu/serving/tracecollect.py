@@ -51,6 +51,12 @@ def spool_path(pidfile: str) -> str:
     return pidfile + ".spans.jsonl"
 
 
+def events_path(pidfile: str) -> str:
+    """The flight-recorder event spool (PR 15) next to the span spool:
+    same per-process ownership, same rotation/clock contract."""
+    return pidfile + ".events.jsonl"
+
+
 def find_spools(pidfile: str) -> List[str]:
     """Every span spool of a deployment: the daemon's own, each
     replica's, and the LB's — anything matching ``<pidfile>*`` with the
@@ -60,16 +66,23 @@ def find_spools(pidfile: str) -> List[str]:
     return out
 
 
-def append_spans(path: str, spans: Iterable[Dict],
-                 source: Optional[str] = None,
-                 max_bytes: int = SPOOL_MAX_BYTES) -> int:
-    """Append one drain batch: a clock record (wall/monotonic pair
-    captured NOW, i.e. at the drain — the offset the merge uses for every
-    span in the batch) followed by the spans.  The file rotates once to
-    ``.1`` past ``max_bytes`` so a long-lived replica cannot fill the
-    disk.  Returns the number of spans written."""
-    spans = list(spans)
-    if not spans:
+def find_event_spools(pidfile: str) -> List[str]:
+    """Every flight-recorder event spool of a deployment (PR 15) —
+    replicas, the supervisor's own (autoscaler/LB/incident events), and
+    rotated generations."""
+    out = sorted(set(glob.glob(pidfile + "*.events.jsonl")
+                     + glob.glob(pidfile + "*.events.jsonl.1")))
+    return out
+
+
+def _append_records(path: str, records: List[Dict], kind: str,
+                    source: Optional[str], max_bytes: int) -> int:
+    """The one spool writer (spans AND events): a clock record
+    (wall/monotonic pair captured NOW, i.e. at the drain — the offset the
+    merge uses for every record in the batch) followed by the batch.  The
+    file rotates once to ``.1`` past ``max_bytes`` so a long-lived
+    replica cannot fill the disk."""
+    if not records:
         return 0
     try:
         if max_bytes and os.path.exists(path) \
@@ -82,21 +95,39 @@ def append_spans(path: str, spans: Iterable[Dict],
     if source is not None:
         clock["source"] = source
     lines = [json.dumps(clock)]
-    for s in spans:
-        rec = {"kind": "span"}
+    for s in records:
+        rec = {"kind": kind}
         rec.update(s)
         if source is not None:
             rec.setdefault("replica_id", source)
         try:
             lines.append(json.dumps(rec))
         except (TypeError, ValueError):
-            # a span smuggling a non-JSON attr must not kill the batch
+            # a record smuggling a non-JSON attr must not kill the batch
             lines.append(json.dumps(
                 {k: v for k, v in rec.items()
                  if isinstance(v, (str, int, float, bool, type(None)))}))
     with open(path, "a") as f:
         f.write("\n".join(lines) + "\n")
-    return len(spans)
+    return len(records)
+
+
+def append_spans(path: str, spans: Iterable[Dict],
+                 source: Optional[str] = None,
+                 max_bytes: int = SPOOL_MAX_BYTES) -> int:
+    """Append one ``Tracer.drain_spans()`` batch.  Returns the number of
+    spans written."""
+    return _append_records(path, list(spans), "span", source, max_bytes)
+
+
+def append_events(path: str, events: Iterable[Dict],
+                  source: Optional[str] = None,
+                  max_bytes: int = SPOOL_MAX_BYTES) -> int:
+    """Append one ``FlightRecorder.drain_events()`` batch (PR 15) — the
+    SAME rotation + drain-time clock contract as span spools, so
+    ``merge_spools`` normalizes both onto one wall timeline and `manager
+    trace` / `incident_view` agree about when everything happened."""
+    return _append_records(path, list(events), "event", source, max_bytes)
 
 
 def load_spool(path: str) -> List[Dict]:
@@ -145,7 +176,13 @@ def merge_spools(paths: Iterable[str],
     with no clock records falls back to its replica's health-doc
     wall/monotonic pair, and a span with no clock at all keeps its raw
     ``ts`` with ``clock_skewed: true`` so downstream consumers can warn
-    instead of silently mis-ordering it."""
+    instead of silently mis-ordering it.
+
+    Flight-recorder EVENT spools (PR 15) merge through the same path:
+    an event record keeps ``kind: "event"`` and gets its ``event`` name
+    mirrored into ``stage`` so every downstream consumer (reconstruct,
+    chrome_trace, incident_view) lays events and spans out on the one
+    timeline as zero-duration marks."""
     by_replica_clock: Dict[str, Tuple[float, float]] = {}
     for rid, doc in (health_docs or {}).items():
         pair = _doc_clock(doc)
@@ -161,9 +198,14 @@ def merge_spools(paths: Iterable[str],
                 except (KeyError, TypeError, ValueError):
                     pass
                 continue
-            if rec.get("kind") not in (None, "span"):
+            if rec.get("kind") not in (None, "span", "event"):
                 continue
-            span = {k: v for k, v in rec.items() if k != "kind"}
+            if rec.get("kind") == "event":
+                span = {k: v for k, v in rec.items()}
+                span.setdefault("stage", str(span.get("event")))
+                span.setdefault("dur_s", 0.0)
+            else:
+                span = {k: v for k, v in rec.items() if k != "kind"}
             off = offset
             if off is None:
                 pair = by_replica_clock.get(
@@ -314,7 +356,13 @@ def export_chrome_trace(spans: Iterable[Dict], path: str) -> str:
 
 
 def collect(pidfile: str,
-            health_docs: Optional[Dict[str, Dict]] = None) -> List[Dict]:
+            health_docs: Optional[Dict[str, Dict]] = None,
+            events: bool = False) -> List[Dict]:
     """The one-call fleet merge the CLI uses: find every spool of the
-    deployment, merge, normalize."""
-    return merge_spools(find_spools(pidfile), health_docs=health_docs)
+    deployment, merge, normalize.  ``events=True`` (PR 15) folds the
+    flight-recorder event spools into the same timeline — the `manager
+    incident --show` / `tools/incident_view.py` view."""
+    paths = find_spools(pidfile)
+    if events:
+        paths = sorted(set(paths) | set(find_event_spools(pidfile)))
+    return merge_spools(paths, health_docs=health_docs)

@@ -21,10 +21,15 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-except Exception:
-    pass
+# gloo CPU collectives only for REAL multi-process runs: this jaxlib's
+# make_gloo_tcp_collectives binding requires a live DistributedRuntimeClient,
+# so requesting gloo in a single-process worker (no jax.distributed
+# bootstrap -> client is None) aborts CPU backend init outright
+if len(sys.argv) > 2 and int(sys.argv[2]) > 1:
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

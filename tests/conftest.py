@@ -79,6 +79,12 @@ def pytest_configure(config):
         "small programs, so they carry a default 120 s SIGALRM budget")
     config.addinivalue_line(
         "markers",
+        "forensics: incident flight-recorder / resource-ledger / "
+        "on-demand-profiling tests (PR 15); the capture e2e forks real "
+        "manager processes, so they carry a default 300 s SIGALRM "
+        "budget")
+    config.addinivalue_line(
+        "markers",
         "tracing: fleet-wide distributed-tracing tests (span propagation "
         "across LB/gateway/engine, spool merge, SLO attribution); the "
         "cross-process ones spawn replica subprocesses and long-poll "
@@ -100,6 +106,7 @@ COLDSTART_DEFAULT_TIMEOUT_S = 300.0
 GENERATION_DEFAULT_TIMEOUT_S = 300.0
 TRACING_DEFAULT_TIMEOUT_S = 120.0
 QUANT_DEFAULT_TIMEOUT_S = 120.0
+FORENSICS_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -131,6 +138,8 @@ def pytest_runtest_call(item):
             seconds = TRACING_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("quant") is not None:
             seconds = QUANT_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("forensics") is not None:
+            seconds = FORENSICS_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Incident bundle timeline renderer (PR 15).
+
+Renders a `manager incident` bundle — or a live deployment's spools —
+as one merged cross-process timeline: flight-recorder EVENTS (state
+transitions, retunes, reclaims, quarantines, autoscaler decisions,
+replica lifecycle) interleaved with trace SPANS on the PR 13
+clock-normalized wall timeline, so "what was every process doing when
+it died" reads top to bottom.
+
+    # newest bundle of a deployment
+    python tools/incident_view.py --pidfile cluster-serving.pid
+
+    # a specific bundle dir (self-contained: copy it anywhere)
+    python tools/incident_view.py /path/to/pidfile.incidents/20260804-120000
+
+    # live spools, no bundle (pre-capture forensics)
+    python tools/incident_view.py --pidfile P --live
+
+    # machine-readable
+    python tools/incident_view.py ... --json
+
+    # self-test over synthetic spools
+    python tools/incident_view.py --smoke
+
+Pure stdlib — importable/runnable anywhere the bundle was copied to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from analytics_zoo_tpu.serving import incident, tracecollect  # noqa: E402
+
+
+def _fmt_entry(e) -> str:
+    mark = "*" if e["kind"] == "event" else "|"
+    what = str(e.get("what"))
+    extra = []
+    for key in ("state", "action", "reason", "count", "rid", "uri",
+                "index", "url"):
+        if e.get(key) not in (None, ""):
+            extra.append(f"{key}={e[key]}")
+    if e.get("dur_s"):
+        extra.append(f"{float(e['dur_s']) * 1e3:.1f}ms")
+    if e.get("error"):
+        extra.append(f"ERROR {e['error']}")
+    tail = ("  [" + " ".join(str(x) for x in extra) + "]") if extra else ""
+    return (f"{e['t_ms']:>10.1f}ms {mark} {e['process']:<14} "
+            f"{what}{tail}")
+
+
+def render_text(doc) -> str:
+    lines = [
+        f"incident: {doc.get('reason') or 'n/a'}"
+        + (f"  captured {doc['captured']}" if doc.get("captured") else ""),
+        f"processes: {', '.join(doc.get('processes') or [])}",
+        f"entries: {doc.get('entries_shown')}/{doc.get('entries_total')}"
+        f"  (events+spans, * = flight-recorder event)",
+    ]
+    if doc.get("meta"):
+        lines.append(f"meta: {json.dumps(doc['meta'])}")
+    top = list((doc.get("events_by_kind") or {}).items())[:12]
+    if top:
+        lines.append("by kind: " + ", ".join(f"{k}x{v}" for k, v in top))
+    errors = doc.get("errors") or []
+    if errors:
+        lines.append(f"errors ({len(errors)} recent):")
+        lines.extend(f"  - {e}" for e in errors[-5:])
+    lines.append("-" * 72)
+    lines.extend(_fmt_entry(e) for e in doc.get("timeline") or [])
+    return "\n".join(lines)
+
+
+def live_doc(pidfile: str, last: int) -> dict:
+    """Render straight off a deployment's live spools (no bundle)."""
+    merged = tracecollect.collect(pidfile, events=True)
+    t0 = merged[0].get("ts_wall", 0.0) if merged else 0.0
+    timeline = []
+    for s in merged[-max(1, int(last)):]:
+        entry = {"t_ms": round((s.get("ts_wall", 0.0) - t0) * 1e3, 3),
+                 "kind": "event" if s.get("kind") == "event" else "span",
+                 "what": (s.get("event") if s.get("kind") == "event"
+                          else s.get("stage")),
+                 "process": str(s.get("replica_id") or "unknown")}
+        for key in ("uri", "trace_id", "error", "state", "count",
+                    "action", "reason", "index", "url"):
+            if s.get(key) is not None:
+                entry[key] = s[key]
+        if s.get("dur_s"):
+            entry["dur_s"] = s["dur_s"]
+        timeline.append(entry)
+    counts = {}
+    for s in merged:
+        what = str(s.get("event") or s.get("stage"))
+        counts[what] = counts.get(what, 0) + 1
+    return {"reason": "live spools (no bundle)",
+            "processes": sorted({str(s.get("replica_id") or "unknown")
+                                 for s in merged}),
+            "entries_total": len(merged), "entries_shown": len(timeline),
+            "events_by_kind": dict(sorted(counts.items(),
+                                          key=lambda kv: -kv[1])),
+            "errors": [s.get("error") for s in merged
+                       if s.get("error")][-20:],
+            "timeline": timeline}
+
+
+def _smoke() -> int:
+    """Self-test: synthetic span + event spools merge into one ordered
+    timeline with both kinds present."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "p.pid")
+        tracecollect.append_spans(
+            tracecollect.spool_path(base + ".r0"),
+            [{"trace_id": "t1", "uri": "u1", "stage": "predict",
+              "ts": 1.0, "dur_s": 0.01}], source="replica-0")
+        tracecollect.append_events(
+            tracecollect.events_path(base + ".r0"),
+            [{"event": "quarantine", "ts": 1.02, "rid": "u2",
+              "error": "boom"}], source="replica-0")
+        tracecollect.append_events(
+            tracecollect.events_path(base),
+            [{"event": "replica_exit", "ts": 1.05, "index": 0}],
+            source="supervisor")
+        bundle = incident.capture(base, "smoke", meta={"n": 1})
+        assert bundle, "capture produced nothing"
+        doc = incident.render(bundle, last=50)
+        kinds = {e["kind"] for e in doc["timeline"]}
+        assert kinds == {"span", "event"}, kinds
+        whats = [e["what"] for e in doc["timeline"]]
+        assert whats == ["predict", "quarantine", "replica_exit"], whats
+        assert doc["errors"] == ["boom"]
+        assert {"replica-0", "supervisor"} <= set(doc["processes"])
+        lst = incident.list_incidents(base)
+        assert len(lst) == 1 and lst[0]["reason"] == "smoke"
+        print(render_text(doc))
+        print("incident_view --smoke: ALL OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="incident_view")
+    ap.add_argument("bundle", nargs="?", default=None,
+                    help="bundle dir (default: newest under "
+                         "<pidfile>.incidents)")
+    ap.add_argument("--pidfile", default="cluster-serving.pid")
+    ap.add_argument("--last", type=int, default=200,
+                    help="timeline entries to render (default 200)")
+    ap.add_argument("--live", action="store_true",
+                    help="render the deployment's LIVE spools instead of "
+                         "a captured bundle")
+    ap.add_argument("--json", action="store_true", dest="json_",
+                    help="machine-readable document instead of text")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test over synthetic spools")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return _smoke()
+    if args.live:
+        doc = live_doc(args.pidfile, args.last)
+    else:
+        bundle = args.bundle or incident.resolve_bundle(args.pidfile)
+        if bundle is None or not os.path.isdir(bundle):
+            print(json.dumps({"error": "no incident bundle found (pass a "
+                                       "bundle dir, or --pidfile with "
+                                       "captured incidents, or --live)"}),
+                  file=sys.stderr)
+            return 1
+        doc = incident.render(bundle, last=args.last)
+    print(json.dumps(doc) if args.json_ else render_text(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

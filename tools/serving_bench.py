@@ -166,6 +166,9 @@ def _run_once(im, args, batch_size):
             # (trace_sample=0 is the span-free parity baseline; the
             # tracing machinery stays constructed on both sides)
             trace_sample=getattr(args, "trace_sample", 1.0),
+            # PR 15: flight-recorder on/off for the recorder-overhead A/B
+            # (off compiles the event hop to a no-op, same pattern)
+            flight_recorder=getattr(args, "flight_recorder", True),
             # PR 6: sharded multi-chip predict — the engine places the
             # model over the mesh at construction (idempotent across
             # replicas/sweep runs sharing one model)
@@ -319,6 +322,50 @@ def _run_trace_overhead(im, args):
         "tracing_off_laps": off_rates,
         "trace_overhead_pct": round(overhead, 2),
     }
+
+
+# -- flight-recorder overhead A/B (PR 15) --------------------------------------
+
+def _run_recorder_overhead(im, args):
+    """Interleaved A/B of the steady workload with the flight recorder on
+    (every batch/terminal event lands in the ring) vs off (the event hop
+    is a no-op lambda; the ring itself stays constructed) — the PR 13
+    ``--trace-overhead`` methodology applied to the PR 15 recorder.
+    Events are per-BATCH and per-terminal (not per-record like spans), so
+    the true cost is far below the tracing one; the bench ASSERTS the median
+    overhead stays under 2% so the "recording is effectively free" claim
+    is a tested number.  Negative medians (recorder-on happened to win
+    the noise) clamp to 0."""
+    laps = max(1, int(args.recorder_laps))
+    args.flight_recorder = True
+    _run_once(im, args, args.batch)        # discarded compile-warm lap
+    on_rates, off_rates = [], []
+    for lap in range(laps):
+        for rec_on, rates in ((True, on_rates), (False, off_rates)):
+            args.flight_recorder = rec_on
+            out = _run_once(im, args, args.batch)
+            assert out["records"] == args.n, \
+                f"lost records: {out['records']}/{args.n}"
+            rates.append(out["wall_records_per_sec"])
+    on_med = float(np.median(on_rates))
+    off_med = float(np.median(off_rates))
+    overhead = max((off_med - on_med) / off_med * 100.0
+                   if off_med else 0.0, 0.0)
+    out = {
+        "mode": "recorder-overhead",
+        "records_per_lap": args.n,
+        "laps_per_side": laps,
+        "recorder_on_records_per_sec": round(on_med, 1),
+        "recorder_off_records_per_sec": round(off_med, 1),
+        "recorder_on_laps": on_rates,
+        "recorder_off_laps": off_rates,
+        "recorder_overhead_pct": round(overhead, 2),
+    }
+    assert overhead <= 2.0, (
+        f"flight-recorder overhead {overhead:.2f}% exceeds the 2% budget "
+        f"(on={on_med:.1f} rec/s off={off_med:.1f} rec/s over {laps} "
+        f"interleaved laps/side)")
+    return out
 
 
 # -- fused-dequant quantized predict A/B (PR 14) -------------------------------
@@ -1285,6 +1332,15 @@ def main(argv=None):
                     help="laps per side for --trace-overhead (7 default: "
                          "at 3 the lap noise on small containers is the "
                          "same order as the effect being measured)")
+    ap.add_argument("--recorder-overhead", action="store_true",
+                    help="PR 15 flight-recorder A/B: interleaved laps of "
+                         "the steady workload with the recorder on vs "
+                         "off; reports recorder_overhead_pct (median "
+                         "records/sec delta) in --json and ASSERTS it "
+                         "stays under 2%%")
+    ap.add_argument("--recorder-laps", type=int, default=7,
+                    help="laps per side for --recorder-overhead (same "
+                         "noise rationale as --trace-laps)")
     ap.add_argument("--quantize", choices=("off", "int8", "int4"),
                     default="off",
                     help="PR 14 fused-dequant quantized-predict A/B: "
@@ -1461,6 +1517,12 @@ def main(argv=None):
 
     if args.trace_overhead:
         out = _run_trace_overhead(im, args)
+        print(json.dumps(out))
+        _write_json([out])
+        return out
+
+    if args.recorder_overhead:
+        out = _run_recorder_overhead(im, args)
         print(json.dumps(out))
         _write_json([out])
         return out
