@@ -31,6 +31,7 @@ import json
 import logging
 import os
 import tempfile
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -139,7 +140,27 @@ def save_store(store_dir: str, tree) -> Dict:
 def load_flat(store_dir: str, mmap: bool = True) -> Dict[str, np.ndarray]:
     """The store's leaves as a ``{path: array}`` dict; with ``mmap`` each
     array is a read-only ``np.memmap`` view (zero bytes read until pages
-    fault in, page cache shared across processes)."""
+    fault in, page cache shared across processes).
+
+    Readers can race :func:`save_store`'s atomic dir-swap rewrite: between
+    its two ``os.replace`` calls the store path briefly does not exist
+    (ENOENT), and a manifest read before the swap can pair with a leaf
+    read after it (dtype/shape mismatch → ``ValueError``).  Both windows
+    are microseconds wide and the post-swap store is complete, so the load
+    retries ONCE with a short backoff before letting the error escape —
+    a genuinely missing or corrupt store still fails loudly."""
+    # resolve the path once per load: every manifest and leaf read below
+    # must refer to the same directory even if the caller's cwd (or a
+    # symlink along the way) changes mid-load
+    store_dir = os.path.abspath(store_dir)
+    try:
+        return _load_flat_once(store_dir, mmap)
+    except (OSError, ValueError):
+        time.sleep(0.05)
+        return _load_flat_once(store_dir, mmap)
+
+
+def _load_flat_once(store_dir: str, mmap: bool) -> Dict[str, np.ndarray]:
     manifest = read_manifest(store_dir)
     if manifest is None:
         raise FileNotFoundError(

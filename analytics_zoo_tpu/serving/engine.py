@@ -407,7 +407,9 @@ class ServingParams:
                  quantize=None,
                  flight_recorder: bool = True,
                  recorder_ring: Optional[int] = None,
-                 profiling: bool = True):
+                 profiling: bool = True,
+                 model_version: Optional[str] = None,
+                 faults=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -541,6 +543,18 @@ class ServingParams:
         self.recorder_ring = (None if recorder_ring is None
                               else max(16, int(recorder_ring)))
         self.profiling = bool(profiling)
+        # zero-drop rollout (PR 16).  `model_version`: the registry
+        # version this replica serves — normally injected by the
+        # supervisor's spawn spec, not set in config.yaml.  Rides the
+        # health doc, /healthz and every result payload so a mixed-version
+        # fleet mid-rollout is observable end to end.  `faults`:
+        # deterministic fault-injection points gated on model_version
+        # (serving/faults.py) — strictly opt-in chaos for rollout tests
+        # and the `serving_bench --rollout` A/B; None (the default) wires
+        # nothing into the hot path.
+        self.model_version = (None if model_version is None
+                              else str(model_version))
+        self.faults = faults if isinstance(faults, dict) else None
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -595,7 +609,9 @@ class ServingParams:
             flight_recorder=bool(p.get("flight_recorder", True)),
             recorder_ring=(None if p.get("recorder_ring") is None
                            else int(p["recorder_ring"])),
-            profiling=bool(p.get("profiling", True)))
+            profiling=bool(p.get("profiling", True)),
+            model_version=p.get("model_version"),
+            faults=p.get("faults"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -716,6 +732,19 @@ class ClusterServing:
             self.recorder.resize(self.params.recorder_ring)
         self._event = (self._record_event if self.params.flight_recorder
                        else (lambda *a, **kw: None))
+        # zero-drop rollout (PR 16): version identity + fault injection.
+        # The injector is built even when inert (describe() rides the
+        # health doc), but fault points only wire into the hot path when
+        # armed for THIS replica's version — a predict fault instance-
+        # patches do_predict, which `_dispatch_batch`'s custom-predict
+        # fallback keeps on the real quarantine/bisect path.
+        from analytics_zoo_tpu.serving.faults import FaultInjector
+        self.model_version = self.params.model_version
+        self._faults = FaultInjector(self.params.faults,
+                                     self.model_version)
+        if self._faults.predict_active and \
+                isinstance(model, InferenceModel):
+            model.do_predict = self._faults.wrap_predict(model.do_predict)
         # on-demand device profiling (PR 15): one jax.profiler trace at a
         # time, written under profile_dir (the manager points it at
         # <pidfile>.profiles)
@@ -1739,6 +1768,11 @@ class ClusterServing:
                                  trace_id=tmap.get(rid), uri=rid)
                 try:
                     value = {"value": self.postprocess(np.asarray(row))}
+                    if self.model_version is not None:
+                        # version identity (PR 16): clients can tell WHICH
+                        # published version answered — mid-rollout, a
+                        # mixed-version fleet answers with a mixed stream
+                        value["model_version"] = self.model_version
                     if tmap.get(rid) is not None:
                         # PR 13: the trace rides the SUCCESS result too
                         # (error markers and generation finishes already
@@ -1978,6 +2012,10 @@ class ClusterServing:
         self._warm_state["state"] = "warming"
         self._event("warmup", state="warming",
                     total=self._warm_state.get("total"))
+        # fault point (PR 16): an armed warmup_crash kills the PROCESS
+        # here — a real crash mid-warm-up, exercising the supervisor's
+        # respawn-at-assigned-version path, not the exception handler below
+        self._faults.check_warmup()
 
         def progress(done, total, entry):
             self._warm_state["compiled"] = done
@@ -2382,6 +2420,11 @@ class ClusterServing:
              "clock": {"wall": time.time(), "monotonic": time.monotonic()},
              # replica identity + failover counters (PR 5)
              "replica_id": self.replica_id,
+             # version identity (PR 16): the registry version this replica
+             # serves — None when unversioned.  Fleet aggregation reports
+             # the version MIX across replicas (normal mid-rollout); the
+             # canary judge compares replicas by it.
+             "model_version": self.model_version,
              "heartbeat_age_s": round(self._heartbeat_age(), 3),
              "reclaimed": self.reclaimed,
              "duplicates": self.duplicates,
@@ -2424,6 +2467,10 @@ class ClusterServing:
             # the health doc so fleet aggregation / FleetSignals can
             # consume them without a separate scrape
             h["slo"] = self._slo.snapshot()
+        if self._faults.any_active:
+            # fault injection (PR 16): an armed replica must be visible
+            # from the outside — never silently chaotic
+            h["faults"] = self._faults.describe()
         h["ready"] = self._readiness(h)
         return h
 
@@ -2457,6 +2504,12 @@ class ClusterServing:
         depth = q.get("depth", -1)
         if cap is not None and depth >= 0 and depth >= cap:
             reasons.append(f"queue-depth {depth} >= {cap}")
+        if self._faults.readyz_active:
+            # fault point (PR 16): hold readiness for the configured
+            # uptime — exercises the rollout's wait-for-ready timeout
+            fr = self._faults.readyz_block_reason(h["uptime_s"])
+            if fr:
+                reasons.append(fr)
         return {"ready": not reasons, "reasons": reasons}
 
     def ready(self) -> Dict:

@@ -1,0 +1,179 @@
+"""Deterministic, config-gated fault injection (PR 16) — the chaos half
+of the rollout subsystem.
+
+The rollback path must be exercised by REAL failures, not mocks: a
+``params.faults`` block arms named fault points inside a live replica, and
+every point is gated on the replica's ``model_version`` so a canary at v2
+misbehaves while its v1 incumbents stay healthy — exactly the divergence
+the canary judge must catch.
+
+Config shape (``params.faults`` in config.yaml)::
+
+    faults:
+      predict_error:            # do_predict raises (rows quarantine)
+        version: v2             # "*" = every version, absent = never
+        after: 0                # records served cleanly before failing
+      predict_slow:             # do_predict sleeps first (burn-rate fault)
+        version: v2
+        ms: 250
+      warmup_crash:             # process exits mid-warm-up (os._exit) —
+        version: v2             # a crash, not an exception, so the
+                                # supervisor's respawn path is exercised
+      readyz_delay:             # /readyz held not-ready after start
+        version: v2
+        seconds: 10
+
+Every knob is deterministic: no randomness, no time-of-day dependence —
+the same config and record sequence produce the same failures, so the
+acceptance tests assert exact outcomes.
+
+:func:`corrupt_store_leaf` is the offline companion: it truncates one leaf
+of a published weight store in place, the "corrupt store" fault the
+registry's integrity verification must reject loudly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class FaultError(RuntimeError):
+    """Raised by an armed ``predict_error`` fault point — the message
+    names the fault and version so quarantine markers are attributable."""
+
+
+def _gate(spec, model_version: Optional[str]) -> Optional[dict]:
+    """A fault point's config, iff it is armed for this replica's
+    version.  ``version: "*"`` arms it everywhere; a missing/empty
+    version selector never fires (faults are strictly opt-in)."""
+    if not isinstance(spec, dict):
+        return None
+    sel = spec.get("version")
+    if not sel:
+        return None
+    if sel != "*" and sel != (model_version or ""):
+        return None
+    return spec
+
+
+class FaultInjector:
+    """Holds the armed fault points for ONE replica (its parsed
+    ``params.faults`` dict + its ``model_version``).  Inactive injectors
+    (no faults config, or nothing gated to this version) cost nothing:
+    the engine only wires a fault point when ``active`` is true for it."""
+
+    def __init__(self, faults: Optional[dict],
+                 model_version: Optional[str] = None):
+        faults = faults if isinstance(faults, dict) else {}
+        self.model_version = model_version
+        self._predict_error = _gate(faults.get("predict_error"),
+                                    model_version)
+        self._predict_slow = _gate(faults.get("predict_slow"),
+                                   model_version)
+        self._warmup_crash = _gate(faults.get("warmup_crash"),
+                                   model_version)
+        self._readyz_delay = _gate(faults.get("readyz_delay"),
+                                   model_version)
+        self._predict_calls = 0
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def predict_active(self) -> bool:
+        return (self._predict_error is not None
+                or self._predict_slow is not None)
+
+    @property
+    def readyz_active(self) -> bool:
+        return self._readyz_delay is not None
+
+    @property
+    def any_active(self) -> bool:
+        return (self.predict_active or self.readyz_active
+                or self._warmup_crash is not None)
+
+    def describe(self) -> list:
+        """Armed fault-point names (rides the health doc so an armed
+        replica is visible from the outside)."""
+        out = []
+        if self._predict_error is not None:
+            out.append("predict_error")
+        if self._predict_slow is not None:
+            out.append("predict_slow")
+        if self._warmup_crash is not None:
+            out.append("warmup_crash")
+        if self._readyz_delay is not None:
+            out.append("readyz_delay")
+        return out
+
+    # -- fault points ---------------------------------------------------------
+    def wrap_predict(self, fn: Callable) -> Callable:
+        """Wrap ``do_predict``: sleep first when ``predict_slow`` is
+        armed, then raise :class:`FaultError` once ``predict_error``'s
+        ``after`` budget of clean calls is spent.  The wrapper is
+        instance-patched onto the model, which the engine's dispatch
+        fallback keeps on the hot path (same mechanism the chaos tests
+        use), so the injected failure flows through the REAL quarantine /
+        bisect machinery."""
+
+        def _predict(tensors, scales=None, **kw):
+            self._predict_calls += 1
+            slow = self._predict_slow
+            if slow is not None:
+                time.sleep(float(slow.get("ms", 100)) / 1000.0)
+            err = self._predict_error
+            if err is not None and \
+                    self._predict_calls > int(err.get("after", 0)):
+                raise FaultError(
+                    f"injected predict_error (version "
+                    f"{self.model_version or '*'}, call "
+                    f"#{self._predict_calls})")
+            return fn(tensors, scales=scales, **kw)
+
+        return _predict
+
+    def check_warmup(self) -> None:
+        """``warmup_crash``: kill the PROCESS (not an exception — the
+        warm-up loop catches those and degrades gracefully; the fault
+        must look like a real crash so the supervisor's
+        respawn-at-assigned-version path is what gets tested)."""
+        if self._warmup_crash is not None:
+            logger.error("faults: injected warmup_crash (version %s) — "
+                         "exiting", self.model_version)
+            os._exit(3)
+
+    def readyz_block_reason(self, uptime_s: float) -> Optional[str]:
+        """``readyz_delay``: a not-ready reason until ``seconds`` of
+        uptime have passed (exercises the rollout's wait-for-ready
+        timeout without harming served traffic)."""
+        d = self._readyz_delay
+        if d is None:
+            return None
+        hold = float(d.get("seconds", 10))
+        if uptime_s < hold:
+            return (f"fault-injected readyz_delay "
+                    f"({uptime_s:.1f}/{hold:g}s)")
+        return None
+
+
+def corrupt_store_leaf(store_dir: str, leaf_index: int = 0,
+                       truncate_to: int = 0) -> str:
+    """Truncate one leaf file of a weight store IN PLACE (the manifest is
+    left intact, so only integrity verification — not a directory listing
+    — can tell).  Returns the corrupted file's path.  Test/bench helper
+    for the "corrupt store leaf" fault: ``registry.verify`` must report
+    it and the rollout must refuse the version."""
+    import json
+    with open(os.path.join(store_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    files = sorted({m["file"] for m in manifest["leaves"].values()})
+    if not files:
+        raise ValueError(f"{store_dir!r}: store has no leaves")
+    target = os.path.join(store_dir, files[leaf_index % len(files)])
+    with open(target, "r+b") as f:
+        f.truncate(truncate_to)
+    return target

@@ -142,6 +142,10 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     proc = {"rss_bytes": 0, "rss_max_bytes": 0, "cpu_seconds": 0.0,
             "open_fds": 0, "threads": 0}
     proc_seen = False
+    # model-version mix (PR 16): during a rollout the fleet is
+    # intentionally heterogeneous — surface version -> replica count so
+    # `manager status` shows the canary/rolling split at a glance
+    versions: Dict[str, int] = {}
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -163,6 +167,9 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             hb[rid] = float("inf")
         if knobs is None and isinstance(doc.get("knobs"), dict):
             knobs = doc["knobs"]
+        mv = doc.get("model_version")
+        if mv is not None:
+            versions[str(mv)] = versions.get(str(mv), 0) + 1
         w = doc.get("warmup") or {}
         if w.get("state") in ("pending", "warming"):
             warming += 1
@@ -219,6 +226,9 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             # resource accounting (PR 15): fleet HBM decomposition +
             # summed per-process resources (None when no replica reports
             # them yet — old snapshots mid-rolling-upgrade)
+            # version mix (PR 16): None while every replica is
+            # unversioned (pre-registry deployments)
+            "versions": versions or None,
             "resources": res if res_seen else None,
             "process": dict(proc, cpu_seconds=round(proc["cpu_seconds"],
                                                     3))
@@ -245,6 +255,8 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
             "running": bool(doc.get("running")),
             "heartbeat_age_s": doc.get("heartbeat_age_s"),
             "p99_ms": e2e.get("p99_ms")}
+        if doc.get("model_version") is not None:
+            member["model_version"] = doc["model_version"]
         # warm-up visibility (PR 11): a replica that exists but is not
         # taking traffic yet shows `warming (k/n)` here, so `manager
         # metrics --all-replicas` explains the gap between desired and
@@ -283,6 +295,10 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
     if agg.get("slo_burn_rate") is not None:
         out["slo"] = {"burn_rate": agg["slo_burn_rate"],
                       "window_violations": agg["slo_window_violations"]}
+    # version mix (PR 16): which model versions the fleet is serving —
+    # heterogeneous exactly while a rollout is in flight
+    if agg.get("versions"):
+        out["versions"] = agg["versions"]
     # resource accounting (PR 15): the fleet HBM decomposition + summed
     # per-process stats ride the metrics doc next to the SLO block
     if agg.get("resources") is not None:

@@ -46,6 +46,10 @@ _CAPTURE_GLOBS = (
     "*.events.jsonl", "*.events.jsonl.1",
     "*.health.json",
     ".autoscaler.json", ".lb.json", ".knobs.json", ".replicas",
+    # rollout (PR 16): the phase / target / per-replica version
+    # assignments at capture time — a rollback bundle must show WHERE the
+    # fleet was mid-roll
+    ".rollout.state.json",
 )
 
 DEFAULT_MAX_BUNDLES = 20

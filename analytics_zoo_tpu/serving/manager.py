@@ -350,13 +350,19 @@ def serve_from_config(config_path: str,
                       replica_id: Optional[str] = None,
                       http_port_offset: int = 0,
                       cache_dir: Optional[str] = None,
-                      weight_store: Optional[str] = None) -> ClusterServing:
+                      weight_store: Optional[str] = None,
+                      model_version: Optional[str] = None) -> ClusterServing:
     cfg = load_config(config_path)
     params = serving_params(cfg)
     if replica_id is not None:
         # supervisor-assigned identity (PR 5) wins over the config default
         # so every replica of one deployment is distinguishable
         params.replica_id = replica_id
+    if model_version is not None:
+        # rollout version identity (PR 16): the supervisor's spawn spec
+        # pins the registry version this replica serves; it rides the
+        # health doc, /healthz and every result payload
+        params.model_version = str(model_version)
     if params.http_port and http_port_offset:
         # replicas cannot share one probe port: replica i listens on
         # http_port + i (documented in the module docstring)
@@ -415,6 +421,47 @@ def _weights_dir(pidfile: str) -> str:
     """Per-deployment mmap'd weight store (PR 11): `manager warmup`
     persists the params once, every replica boot maps the same pages."""
     return pidfile + ".weights"
+
+
+def _registry_dir(pidfile: str) -> str:
+    """Versioned model registry (PR 16): `manager publish <version>`
+    snapshots immutable version dirs under here; `manager rollout` moves
+    the fleet between them one replica at a time."""
+    return pidfile + ".registry"
+
+
+def _version_store(pidfile: str, version: str,
+                   model_name: str = "default") -> str:
+    """The weight store a replica assigned to ``version`` must load —
+    verified FIRST: a truncated/corrupt version must fail the spawn
+    loudly (the supervisor's crash accounting then rolls back), never
+    serve garbage weights."""
+    from analytics_zoo_tpu.serving import registry as _registry
+    problems = _registry.verify(_registry_dir(pidfile), version,
+                                model=model_name)
+    if problems:
+        raise _registry.RegistryError(
+            f"version {version!r} failed integrity verification: "
+            + "; ".join(problems[:3]))
+    return _registry.store_path(_registry_dir(pidfile), version,
+                                model=model_name)
+
+
+def _model_name(cfg: dict) -> str:
+    name = (cfg.get("model") or {}).get("name")
+    return str(name) if name else "default"
+
+
+def _jsonable(v):
+    """Best-effort JSON projection for registry metadata (warm-up
+    manifest entries carry dtypes/tuples json.dump chokes on)."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
 
 
 def _resolve_cache_dir(params: ServingParams, pidfile: str):
@@ -482,7 +529,8 @@ def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
                     http_port_offset: int = 0,
                     knobs_path: Optional[str] = None,
-                    base_pidfile: Optional[str] = None):
+                    base_pidfile: Optional[str] = None,
+                    model_version: Optional[str] = None):
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     # zero cold start (PR 11): every replica of one deployment shares the
@@ -490,15 +538,22 @@ def _run_foreground(config_path: str, pidfile: str,
     # `<base>.rN`); the cache dir must be live before the model loads so
     # no compile escapes it
     base = base_pidfile or pidfile
-    params0 = serving_params(load_config(config_path))
+    cfg0 = load_config(config_path)
+    params0 = serving_params(cfg0)
     cache_dir = _resolve_cache_dir(params0, base)
     if cache_dir:
         from analytics_zoo_tpu.inference import aot
         aot.enable_persistent_cache(cache_dir)
+    # rollout (PR 16): a version-assigned replica loads the REGISTRY's
+    # immutable snapshot for that version, integrity-verified first — a
+    # corrupt version fails the spawn loudly instead of serving garbage
+    weight_store = (_version_store(base, model_version, _model_name(cfg0))
+                    if model_version else _weights_dir(base))
     serving = serve_from_config(config_path, replica_id=replica_id,
                                 http_port_offset=http_port_offset,
                                 cache_dir=cache_dir,
-                                weight_store=_weights_dir(base))
+                                weight_store=weight_store,
+                                model_version=model_version)
     # on-demand profiling (PR 15): traces land next to the deployment's
     # other artifacts, shared across the replicas of one base pidfile
     serving.profile_dir = _profiles_dir(base)
@@ -576,20 +631,28 @@ def _run_foreground(config_path: str, pidfile: str,
 
 
 def _prewarm(config_path: str, pidfile: str,
-             timeout_s: float = 900.0) -> Optional[dict]:
+             timeout_s: float = 900.0,
+             version: Optional[str] = None) -> Optional[dict]:
     """One throwaway warm-up pass BEFORE any replica forks (PR 11): a
     subprocess (never a fork — the supervisor must stay jax-free so its
     children fork clean) runs `manager warmup`, which exports the mmap
     weight store and populates the per-deployment XLA compilation cache.
     Every replica spawned afterwards — including every future autoscaler
     scale-up — loads executables from disk instead of compiling.  Failure
-    is logged, not fatal: replicas fall back to compiling for themselves."""
+    is logged, not fatal: replicas fall back to compiling for themselves.
+
+    With ``version`` (PR 16 rollout), the pass loads the REGISTRY
+    snapshot for that version instead of re-exporting — run before the
+    canary takes traffic, so every replaced replica boots with zero
+    steady-state compiles."""
     import subprocess
+    cmd = [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+           "warmup", "-c", config_path, "--pidfile", pidfile]
+    if version:
+        cmd += ["--version", version]
     try:
         out = subprocess.run(
-            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
-             "warmup", "-c", config_path, "--pidfile", pidfile],
-            capture_output=True, text=True, timeout=timeout_s)
+            cmd, capture_output=True, text=True, timeout=timeout_s)
         doc = None
         for line in (out.stdout or "").splitlines():
             line = line.strip()
@@ -662,13 +725,17 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
     from analytics_zoo_tpu.common.observability import get_recorder
     recorder = get_recorder()
 
-    def _capture_incident(reason: str, meta=None):
+    def _capture_incident(reason: str, meta=None, force=False):
         from analytics_zoo_tpu.serving import incident as _incident
         now = time.monotonic()
-        if now - inc_last["t"] < inc_cooldown:
+        if not force and now - inc_last["t"] < inc_cooldown:
             return None
         inc_last["t"] = now
-        recorder.record("incident", reason=reason, **(meta or {}))
+        # the bundle meta may itself carry a "reason" (the rollback
+        # verdict) — the event's positional `reason` wins, drop the
+        # duplicate instead of TypeError-ing the capture away
+        extra = {k: v for k, v in (meta or {}).items() if k != "reason"}
+        recorder.record("incident", reason=reason, **extra)
         # flush the supervisor's own ring first so the bundle carries the
         # trigger event itself (replica spools were drained by their own
         # 1 s loops — capture reads files, never the hot path)
@@ -681,13 +748,43 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                   file=sys.stderr, flush=True)
         return bundle
 
+    # zero-drop rollout (PR 16): versioned-registry state.  The rollout
+    # STATE file persists the per-replica version assignments — the
+    # respawn pin (satellite bugfix: a replica that crashes mid-rollout
+    # respawns at its ASSIGNED version, incumbent or canary, never
+    # blindly at `latest`) — and survives a supervisor restart.
+    from analytics_zoo_tpu.serving import registry as _registry
+    from analytics_zoo_tpu.serving import rollout as _rollout
+    rparams = _rollout.RolloutParams.from_dict(cfg.get("rollout"))
+    model_name = _model_name(cfg)
+    reg_dir = _registry_dir(pidfile)
+    rst = _rollout.load_state(pidfile)
+    assigned: dict = rst.get("assignments") or {}
+    if rst.get("base") is None:
+        # fresh deployment: serve the registry's latest when one is
+        # published; an unversioned deployment (no registry) keeps the
+        # plain config/weight-store path exactly as before PR 16
+        rst["base"] = _registry.latest(reg_dir, model_name)
+    rolling: set = set()        # indices being intentionally replaced
+    rollout_meta = {"canary_crashes": 0, "t_phase": time.monotonic(),
+                    "dwell_start": None, "replacing": None}
+
+    def _assigned_version(index: int):
+        return assigned.get(index, rst.get("base"))
+
+    def _save_rollout():
+        rst["assignments"] = assigned
+        _rollout.save_state(pidfile, rst)
+
+    _save_rollout()
+
     if prewarm and params.warmup and \
             _resolve_cache_dir(params, pidfile):
         # pre-populate the deployment's compile cache + weight store so
         # the replicas about to fork (and every scale-up after them) boot
         # warm.  The fleet takes traffic a few seconds later but each
         # member reaches /readyz in seconds instead of a compile.
-        _prewarm(config_path, pidfile)
+        _prewarm(config_path, pidfile, version=rst.get("base"))
     scaler = None
     balancer = None
     if autoscale:
@@ -712,7 +809,13 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
 
     def _spawn(index: int):
         last_spawn[index] = time.monotonic()
-        recorder.record("replica_spawn", index=index)
+        # rollout (PR 16): the spawn spec pins the replica's ASSIGNED
+        # version — during a rollout the canary respawns at the target
+        # and every incumbent at the prior, so a crash mid-canary can
+        # never silently promote (or demote) a replica
+        version = _assigned_version(index)
+        recorder.record("replica_spawn", index=index,
+                        model_version=version)
         pid = os.fork()
         if pid == 0:
             # child: plain replica process with its own pidfile/health
@@ -730,10 +833,254 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                                 replica_id=f"replica-{index}",
                                 http_port_offset=index,
                                 knobs_path=_knobs_path(pidfile),
-                                base_pidfile=pidfile)
+                                base_pidfile=pidfile,
+                                model_version=version)
             finally:
                 os._exit(0)
         children[index] = pid
+
+    retire_sig = getattr(signal, "SIGUSR1", signal.SIGTERM)
+
+    def _read_rhealth(index: int):
+        try:
+            with open(_health_path(_replica_pidfile(pidfile, index))) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _replace(index: int, version):
+        """Move one replica slot onto ``version``: pin the assignment
+        (respawn-safe), then SIGUSR1-retire the old process — it drains
+        with shared-queue admission OPEN, its leases cover in-flight
+        records, and the reap/spawn passes bring the slot back up at the
+        new version.  The LB health-outs the retiring gateway, so the
+        swap is client-invisible."""
+        assigned[index] = version
+        _save_rollout()
+        recorder.record("rollout_replace", index=index, version=version)
+        pid = children.get(index)
+        if pid:
+            rolling.add(index)
+            try:
+                os.kill(pid, retire_sig)
+            except OSError:
+                pass
+
+    def _begin_rollback(reason: str):
+        target, prior = rst.get("target"), rst.get("base")
+        recorder.record("rollback", target=target, prior=prior,
+                        reason=str(reason)[:200])
+        print(json.dumps({"event": "rollout rollback",
+                          "from_version": target, "to_version": prior,
+                          "reason": reason}), file=sys.stderr, flush=True)
+        # the rollback IS the incident: bundle the evidence BEFORE the
+        # reverse restart rotates it, stamped with both versions.
+        # force=True — a crash capture moments earlier must not suppress
+        # the rollback's own forensics behind the cooldown
+        _capture_incident(
+            f"rollout-rollback {target} -> {prior or 'unversioned'}",
+            meta={"from_version": target, "to_version": prior,
+                  "reason": str(reason)[:500],
+                  "phase": rst.get("phase")},
+            force=True)
+        rst["phase"] = "rollback"
+        rst["reason"] = str(reason)
+        rollout_meta["t_phase"] = time.monotonic()
+        rollout_meta["replacing"] = None
+        rollout_meta["dwell_start"] = None
+        _save_rollout()
+
+    def _rollout_tick(desired: int):
+        """One pass of the rollout state machine (idle -> canary ->
+        rolling -> idle, or -> rollback -> idle), driven off the same
+        per-replica health snapshots the incident triggers read."""
+        now = time.monotonic()
+        phase = rst.get("phase", "idle")
+        if phase == "idle":
+            req = _rollout.read_request(pidfile)
+            if not req or not req.get("target"):
+                return
+            if float(req.get("ts") or 0) <= float(rst.get("req_ts") or 0):
+                return                     # request already processed
+            target = str(req["target"])
+            rst["req_ts"] = req.get("ts")
+            if target == rst.get("base"):
+                print(json.dumps({"event": "rollout no-op",
+                                  "target": target,
+                                  "detail": "fleet already at target"}),
+                      file=sys.stderr, flush=True)
+                _save_rollout()
+                return
+            try:
+                problems = _registry.verify(reg_dir, target,
+                                            model=model_name)
+            except Exception as e:  # noqa: BLE001 — registry unreadable
+                problems = [f"{type(e).__name__}: {e}"]
+            if problems:
+                # a truncated/corrupt version is rejected LOUDLY and the
+                # previous version keeps serving — no replica is touched
+                recorder.record("rollout_rejected", target=target,
+                                problems=len(problems))
+                rst["last_error"] = {"target": target,
+                                     "problems": problems[:5]}
+                _save_rollout()
+                print(json.dumps({"event": "rollout rejected",
+                                  "target": target,
+                                  "problems": problems[:5]}),
+                      file=sys.stderr, flush=True)
+                return
+            if rparams.prewarm and params.warmup and \
+                    _resolve_cache_dir(params, pidfile):
+                # pre-warm the new version's programs into the SHARED
+                # XLA cache before any replica is retired: every
+                # replaced replica then boots with zero steady-state
+                # compiles
+                _prewarm(config_path, pidfile, version=target)
+            rst.update(phase="canary", target=target, canary_index=0,
+                       started=time.time(), reason=None, diverged=None)
+            rollout_meta.update(canary_crashes=0, t_phase=now,
+                                dwell_start=None, replacing=None)
+            recorder.record("rollout_start", target=target,
+                            prior=rst.get("base"))
+            print(json.dumps({"event": "rollout start", "target": target,
+                              "prior": rst.get("base")}),
+                  file=sys.stderr, flush=True)
+            _replace(0, target)
+            return
+        target = rst.get("target")
+        if phase == "canary":
+            idx = int(rst.get("canary_index") or 0)
+            doc = _read_rhealth(idx)
+            at_target = (doc is not None
+                         and doc.get("model_version") == target
+                         and idx in children and idx not in rolling)
+            incumbents = []
+            for i in range(desired):
+                if i == idx:
+                    continue
+                d = _read_rhealth(i)
+                if d is not None:
+                    incumbents.append(d)
+            reason = _rollout.judge(doc if at_target else None, incumbents,
+                                    rparams,
+                                    rollout_meta["canary_crashes"])
+            if reason:
+                if rparams.auto_rollback:
+                    _begin_rollback(reason)
+                    return
+                if rst.get("diverged") != reason:
+                    # rollback disabled (chaos A/B control arm): record
+                    # the divergence verdict, keep rolling — the damage
+                    # this causes is the measurement
+                    rst["diverged"] = reason
+                    recorder.record("rollout_diverged", target=target,
+                                    reason=str(reason)[:200])
+                    _save_rollout()
+            if not at_target or not bool(
+                    (doc.get("ready") or {}).get("ready")):
+                if now - rollout_meta["t_phase"] > rparams.ready_timeout_s \
+                        and rparams.auto_rollback:
+                    _begin_rollback(
+                        f"canary not ready at {target} within "
+                        f"{rparams.ready_timeout_s:g}s")
+                return
+            if rollout_meta["dwell_start"] is None:
+                rollout_meta["dwell_start"] = now
+                recorder.record("canary_serving", index=idx,
+                                target=target)
+                return
+            if now - rollout_meta["dwell_start"] >= rparams.canary_dwell_s:
+                recorder.record("canary_pass", target=target,
+                                dwell_s=round(
+                                    now - rollout_meta["dwell_start"], 3))
+                print(json.dumps({"event": "canary pass",
+                                  "target": target}),
+                      file=sys.stderr, flush=True)
+                rst["phase"] = "rolling"
+                rollout_meta["t_phase"] = now
+                rollout_meta["replacing"] = None
+                _save_rollout()
+            return
+        if phase == "rolling":
+            r = rollout_meta["replacing"]
+            if r is not None:
+                doc = _read_rhealth(r)
+                up = (doc is not None
+                      and doc.get("model_version") == target
+                      and bool((doc.get("ready") or {}).get("ready"))
+                      and r in children and r not in rolling)
+                if up:
+                    rollout_meta["replacing"] = None
+                    rollout_meta["t_phase"] = now
+                elif now - rollout_meta["t_phase"] > \
+                        rparams.ready_timeout_s:
+                    if rparams.auto_rollback:
+                        _begin_rollback(
+                            f"replica {r} not ready at {target} within "
+                            f"{rparams.ready_timeout_s:g}s")
+                    return
+                else:
+                    return
+            pending = [i for i in range(desired)
+                       if _assigned_version(i) != target]
+            if pending:
+                # one at a time: the fleet is never more than one
+                # replica short of desired capacity
+                nxt = pending[0]
+                rollout_meta["replacing"] = nxt
+                rollout_meta["t_phase"] = now
+                _replace(nxt, target)
+                return
+            rst["base"] = target
+            assigned.clear()
+            rst.update(phase="idle", target=None, reason=None)
+            recorder.record("promote", version=target)
+            print(json.dumps({"event": "promote", "version": target}),
+                  file=sys.stderr, flush=True)
+            _save_rollout()
+            return
+        if phase == "rollback":
+            prior = rst.get("base")
+            r = rollout_meta["replacing"]
+            if r is not None:
+                doc = _read_rhealth(r)
+                home = (doc is not None
+                        and doc.get("model_version") == prior
+                        and r in children and r not in rolling)
+                if home:
+                    rollout_meta["replacing"] = None
+                    rollout_meta["t_phase"] = now
+                elif now - rollout_meta["t_phase"] > \
+                        rparams.ready_timeout_s:
+                    # never wedge the rollback on one slow slot — its
+                    # assignment is already pinned to prior, the respawn
+                    # loop keeps trying; move on
+                    recorder.record("rollback_replica_timeout", index=r)
+                    rollout_meta["replacing"] = None
+                    rollout_meta["t_phase"] = now
+                else:
+                    return
+            pending = [i for i in range(desired)
+                       if _assigned_version(i) != prior]
+            if pending:
+                nxt = pending[0]
+                rollout_meta["replacing"] = nxt
+                rollout_meta["t_phase"] = now
+                _replace(nxt, prior)
+                return
+            tgt = rst.get("target")
+            assigned.clear()
+            rst["last_rollback"] = {"target": tgt,
+                                    "reason": rst.get("reason"),
+                                    "finished": time.time()}
+            rst.update(phase="idle", target=None)
+            recorder.record("rollback_done", target=tgt, prior=prior)
+            print(json.dumps({"event": "rollback done", "target": tgt,
+                              "prior": prior}),
+                  file=sys.stderr, flush=True)
+            _save_rollout()
+            return
 
     def _terminate(signum, frame):
         for pid in children.values():
@@ -766,7 +1113,10 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                 except OSError:
                     pass
         for p in (pidfile, scale_path, _knobs_path(pidfile),
-                  _autoscaler_path(pidfile), _lb_path(pidfile)):
+                  _autoscaler_path(pidfile), _lb_path(pidfile),
+                  _rollout.request_path(pidfile)):
+            # the rollout STATE file deliberately survives: it pins the
+            # per-replica version assignments across a supervisor restart
             try:
                 os.unlink(p)
             except OSError:
@@ -792,20 +1142,37 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
             if done:
                 children.pop(index)
                 was_retiring = index in stopping
+                was_rolling = index in rolling
                 stopping.discard(index)
+                rolling.discard(index)
                 if index < desired:
                     print(json.dumps({"replica": index, "pid": pid,
-                                      "event": "exited; respawning"}),
+                                      "event": "exited; respawning",
+                                      "rolling": was_rolling}),
                           file=sys.stderr, flush=True)
                     recorder.record("replica_exit", index=index, pid=pid,
-                                    respawning=True)
-                    if inc_on_crash:
-                        # PR 15: an unexpected replica death IS the
-                        # incident — bundle every process's recent
-                        # events/spans/health before evidence rotates
-                        _capture_incident(
-                            f"replica-{index}-crash",
-                            meta={"replica": index, "pid": pid})
+                                    respawning=True, rolling=was_rolling)
+                    if was_rolling:
+                        # rollout (PR 16): an INTENTIONAL replace — the
+                        # old process finished its retire-drain; the
+                        # respawn below brings the slot up at its newly
+                        # assigned version.  Not a crash, no incident.
+                        pass
+                    else:
+                        if rst.get("phase") != "idle" and \
+                                _assigned_version(index) == rst.get(
+                                    "target"):
+                            # a replica already moved to the rollout
+                            # target died unexpectedly: crash evidence
+                            # for the canary judge
+                            rollout_meta["canary_crashes"] += 1
+                        if inc_on_crash:
+                            # PR 15: an unexpected replica death IS the
+                            # incident — bundle every process's recent
+                            # events/spans/health before evidence rotates
+                            _capture_incident(
+                                f"replica-{index}-crash",
+                                meta={"replica": index, "pid": pid})
                 else:
                     recorder.record("replica_exit", index=index, pid=pid,
                                     respawning=False,
@@ -830,6 +1197,16 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
             if index not in children and \
                     now - last_spawn.get(index, -1e9) >= 1.0:
                 _spawn(index)
+        # zero-drop rollout (PR 16): drive the canary / rolling-replace /
+        # rollback state machine off the same per-replica health
+        # snapshots the incident triggers read.  Never load-bearing for
+        # the fleet's liveness: a tick error logs and retries next pass.
+        try:
+            _rollout_tick(desired)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"event": "rollout tick error",
+                              "error": f"{type(e).__name__}: {e}"}),
+                  file=sys.stderr, flush=True)
         # SLO-burn incident trigger (PR 15): the replicas' health
         # snapshots already land next to the pidfile every second —
         # cheap file reads, throttled by the capture cooldown itself
@@ -893,12 +1270,14 @@ def main(argv=None):
     ap.add_argument("action",
                     choices=["start", "stop", "status", "restart", "health",
                              "replay", "metrics", "scale", "warmup",
-                             "trace", "incident", "profile"])
+                             "trace", "incident", "profile", "publish",
+                             "versions", "rollout"])
     ap.add_argument("value", nargs="?", default=None,
                     help="scale: target replica count; trace: the "
                          "trace_id to reconstruct; incident --show: the "
                          "bundle name (default latest); profile: the "
-                         "replica index (default 0)")
+                         "replica index (default 0); publish/rollout: "
+                         "the version name")
     ap.add_argument("-c", "--config", default="config.yaml")
     ap.add_argument("--pidfile", default=PIDFILE)
     ap.add_argument("--foreground", action="store_true")
@@ -950,6 +1329,11 @@ def main(argv=None):
                          "(default 200)")
     ap.add_argument("--seconds", type=float, default=5.0, metavar="S",
                     help="profile: trace duration (default 5s)")
+    ap.add_argument("--version", default=None, metavar="V",
+                    help="warmup: warm the registry snapshot for version "
+                         "V (no re-export) — the rollout's pre-warm pass "
+                         "runs this so replaced replicas boot with zero "
+                         "compiles")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -986,6 +1370,30 @@ def main(argv=None):
         cache_dir = _resolve_cache_dir(params, args.pidfile)
         if cache_dir:
             aot.enable_persistent_cache(cache_dir)
+        if args.version:
+            # rollout pre-warm (PR 16): warm the REGISTRY snapshot for
+            # this version into the shared compile cache — verified
+            # first, never re-exported (published versions are immutable)
+            from analytics_zoo_tpu.serving import registry as _registry
+            try:
+                ver = _registry.resolve(_registry_dir(args.pidfile),
+                                        args.version,
+                                        model=_model_name(cfg))
+                store = _version_store(args.pidfile, ver,
+                                       _model_name(cfg))
+            except _registry.RegistryError as e:
+                print(json.dumps({"error": str(e)}), file=sys.stderr)
+                return 1
+            im = load_model(cfg, weight_store=store)
+            if params.sharding != "off":
+                im.shard(mesh=params.mesh_shape, sharding=params.sharding)
+            stats = aot.warm_up(im, aot.resolve_manifest(
+                im, params.warmup if params.warmup else True))
+            print(json.dumps({"cache_dir": cache_dir,
+                              "weight_store": store, "version": ver,
+                              "load_seconds": im.load_seconds,
+                              "load_mmap": im.load_mmap, **stats}))
+            return 0 if stats["failed"] == 0 else 1
         store = _weights_dir(args.pidfile)
         im = load_model(cfg, weight_store=store)
         if params.quantize:
@@ -1028,6 +1436,127 @@ def main(argv=None):
                               getattr(im, "_params", None) or {}),
                           **stats}))
         return 0 if stats["failed"] == 0 else 1
+    if args.action == "publish":
+        # versioned model registry (PR 16): build the deployment's model
+        # per the CONFIG (never the shared weight store — a stale store
+        # would republish the previous version's weights under a new
+        # name), quantize like `manager warmup` would, export a staging
+        # weight store, and snapshot it as one immutable version.
+        if not args.value:
+            print(json.dumps({"error": "publish needs a version name: "
+                                       "manager publish <version>"}),
+                  file=sys.stderr)
+            return 1
+        import shutil
+        import tempfile
+        from analytics_zoo_tpu.inference import aot, weightstore
+        from analytics_zoo_tpu.serving import registry as _registry
+        cfg = load_config(args.config)
+        params = serving_params(cfg)
+        model_name = _model_name(cfg)
+        reg = _registry_dir(args.pidfile)
+        im = load_model(cfg)
+        if params.quantize:
+            from analytics_zoo_tpu.serving.engine import apply_quantize
+            apply_quantize(im, params.quantize)
+        if not getattr(im, "_params", None):
+            print(json.dumps({"error": "publish needs a model with "
+                                       "restorable params (zoo "
+                                       "topology)"}), file=sys.stderr)
+            return 1
+        os.makedirs(reg, exist_ok=True)
+        staging = tempfile.mkdtemp(prefix=".staging-", dir=reg)
+        try:
+            sdir = os.path.join(staging, "weights")
+            weightstore.save_store(sdir, {"params": im._params,
+                                          "state": im._state or {}})
+            try:
+                # the warm-up manifest rides the version doc, so ops can
+                # see WHAT program set a version pre-warms without
+                # loading it
+                entries = aot.resolve_manifest(
+                    im, params.warmup if params.warmup else True)
+                wdoc = [_jsonable(vars(e)) for e in entries]
+            except Exception:  # noqa: BLE001 — metadata, never fatal
+                wdoc = None
+            try:
+                doc = _registry.publish(
+                    reg, args.value, sdir, model=model_name,
+                    quantize=_jsonable(params.quantize),
+                    warmup=wdoc,
+                    meta={"config": os.path.abspath(args.config)})
+            except _registry.RegistryError as e:
+                print(json.dumps({"error": str(e)}), file=sys.stderr)
+                return 1
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+        print(json.dumps({"published": doc["version"],
+                          "model": model_name,
+                          "fingerprint": doc["fingerprint"],
+                          "registry": reg,
+                          "latest": _registry.latest(reg, model_name)}))
+        return 0
+    if args.action == "versions":
+        # registry inventory: every published version, latest marked
+        from analytics_zoo_tpu.serving import registry as _registry
+        try:
+            model_name = _model_name(load_config(args.config))
+        except OSError:
+            model_name = "default"
+        reg = _registry_dir(args.pidfile)
+        vs = _registry.versions(reg, model_name)
+        print(json.dumps({
+            "registry": reg, "model": model_name,
+            "latest": _registry.latest(reg, model_name),
+            "versions": [{k: v.get(k) for k in
+                          ("version", "fingerprint", "created",
+                           "quantize", "latest")} for v in vs]}))
+        return 0
+    if args.action == "rollout":
+        # zero-drop rollout (PR 16): verify the target version, then hand
+        # the supervisor a request file (same file-not-signal pattern as
+        # `manager scale`) — its poll loop runs the canary / rolling
+        # replace / auto-rollback state machine
+        from analytics_zoo_tpu.serving import registry as _registry
+        from analytics_zoo_tpu.serving import rollout as _rollout
+        if not args.value:
+            print(json.dumps({"error": "rollout needs a version: "
+                                       "manager rollout <version>"}),
+                  file=sys.stderr)
+            return 1
+        pid = read_pid()
+        if pid is None or not alive(pid):
+            print(json.dumps({"error": "serving not running"}),
+                  file=sys.stderr)
+            return 1
+        if not os.path.exists(_scale_path(args.pidfile)):
+            print(json.dumps({"error": "not running as a replica "
+                                       "supervisor (start with "
+                                       "--replicas N)"}), file=sys.stderr)
+            return 1
+        try:
+            model_name = _model_name(load_config(args.config))
+        except OSError:
+            model_name = "default"
+        reg = _registry_dir(args.pidfile)
+        try:
+            ver = _registry.resolve(reg, args.value, model=model_name)
+        except _registry.RegistryError as e:
+            print(json.dumps({"error": str(e)}), file=sys.stderr)
+            return 1
+        problems = _registry.verify(reg, ver, model=model_name)
+        if problems:
+            # reject a corrupt version at the CLI already — the
+            # supervisor re-verifies, but the operator should hear it now
+            print(json.dumps({"error": f"version {ver!r} failed "
+                                       "integrity verification",
+                              "problems": problems[:5]}),
+                  file=sys.stderr)
+            return 1
+        _rollout.write_request(args.pidfile, ver, time.time())
+        print(json.dumps({"rollout": ver,
+                          "state": _rollout.state_path(args.pidfile)}))
+        return 0
     if args.action == "incident":
         # incident forensics (PR 15): capture/list/show self-contained
         # bundles under <pidfile>.incidents/ — works on a live OR dead
@@ -1321,9 +1850,16 @@ def main(argv=None):
                         (doc.get("ready") or {}).get("ready"))
                     if doc.get("cold_start_s") is not None:
                         member["cold_start_s"] = doc["cold_start_s"]
+                    if doc.get("model_version") is not None:
+                        # rollout (PR 16): which registry version this
+                        # replica serves — mixed mid-rollout is normal
+                        member["model_version"] = doc["model_version"]
                 replicas[f"r{i}"] = member
             out["replicas"] = {"desired": desired, "warming": warming,
                                "members": replicas}
+            from analytics_zoo_tpu.serving import rollout as _rollout
+            if os.path.exists(_rollout.state_path(args.pidfile)):
+                out["rollout"] = _rollout.load_state(args.pidfile)
         health = read_health()
         if health is not None:
             out["health"] = health

@@ -91,6 +91,12 @@ def pytest_configure(config):
         "through the front door, so they carry a default 120 s SIGALRM "
         "budget (subprocess-heavy ones raise it with an explicit "
         "timeout mark)")
+    config.addinivalue_line(
+        "markers",
+        "rollout: versioned-rollout / canary / auto-rollback tests "
+        "(PR 16); the acceptance tests fork real manager supervisors, "
+        "publish registry versions and wait out canary dwell windows, so "
+        "they carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -107,6 +113,7 @@ GENERATION_DEFAULT_TIMEOUT_S = 300.0
 TRACING_DEFAULT_TIMEOUT_S = 120.0
 QUANT_DEFAULT_TIMEOUT_S = 120.0
 FORENSICS_DEFAULT_TIMEOUT_S = 300.0
+ROLLOUT_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -140,6 +147,8 @@ def pytest_runtest_call(item):
             seconds = QUANT_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("forensics") is not None:
             seconds = FORENSICS_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("rollout") is not None:
+            seconds = ROLLOUT_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

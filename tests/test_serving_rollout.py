@@ -1,0 +1,818 @@
+"""Zero-drop model rollout (PR 16).
+
+Covers the rollout tentpole end to end: the versioned model registry
+(publish/resolve/verify, immutability), the deterministic fault-injection
+harness (`params.faults` gated on model_version), the canary judge and
+rollout state file (respawn pins), version identity riding health docs /
+result payloads / fleet aggregation, and the weight-store dir-swap race
+fix.  The real-process acceptance tests (faulty v2 -> auto-rollback with
+incident capture and zero client-visible failures; clean v2 -> promote
+with warm replacements) run the production manager path and are
+`slow`-marked like the PR 10/15 chaos A/Bs.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.inference import weightstore
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.serving import faults as faults_mod
+from analytics_zoo_tpu.serving import incident
+from analytics_zoo_tpu.serving import registry
+from analytics_zoo_tpu.serving import rollout
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.faults import FaultError, FaultInjector
+from analytics_zoo_tpu.serving.queues import InProcQueue
+
+pytestmark = pytest.mark.rollout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {"params": {"dense": {"W": (rng.standard_normal((4, 3))
+                                       * scale).astype(np.float32),
+                                 "b": np.zeros(3, np.float32)}},
+            "state": {}}
+
+
+def _make_store(path, seed=0, scale=1.0):
+    weightstore.save_store(str(path), _tree(seed, scale))
+    return str(path)
+
+
+# -- registry -------------------------------------------------------------------
+
+def test_registry_publish_resolve_versions(tmp_path):
+    reg = str(tmp_path / "registry")
+    store = _make_store(tmp_path / "s1", seed=1)
+    doc = registry.publish(reg, "v1", store, meta={"note": "first"})
+    assert doc["version"] == "v1" and doc["fingerprint"]
+    assert registry.latest(reg) == "v1"
+    # the published snapshot is a loadable weight store of its own
+    flat = weightstore.load_flat(registry.store_path(reg, "v1"))
+    np.testing.assert_array_equal(flat["params/dense/W"],
+                                  _tree(1)["params"]["dense"]["W"])
+    store2 = _make_store(tmp_path / "s2", seed=2)
+    registry.publish(reg, "v2", store2)
+    assert registry.latest(reg) == "v2"
+    # resolution: explicit pin wins, None/"latest" follow the pointer
+    assert registry.resolve(reg, "v1") == "v1"
+    assert registry.resolve(reg, None) == "v2"
+    assert registry.resolve(reg, "latest") == "v2"
+    vs = registry.versions(reg)
+    assert [v["version"] for v in vs] == ["v1", "v2"]
+    assert [v["latest"] for v in vs] == [False, True]
+    # verify: both healthy
+    assert registry.verify(reg, "v1") == []
+    assert registry.verify(reg, "v2") == []
+
+
+def test_registry_immutable_and_idempotent(tmp_path):
+    reg = str(tmp_path / "registry")
+    store = _make_store(tmp_path / "s1", seed=3)
+    d1 = registry.publish(reg, "v1", store)
+    # identical bytes: idempotent no-op returning the original doc
+    d2 = registry.publish(reg, "v1", store)
+    assert d2["fingerprint"] == d1["fingerprint"]
+    assert len(registry.versions(reg)) == 1
+    # different bytes under the same name: refused loudly
+    other = _make_store(tmp_path / "s2", seed=4)
+    with pytest.raises(registry.RegistryError, match="immutable"):
+        registry.publish(reg, "v1", other)
+    # the original content survives the refused overwrite
+    assert registry.verify(reg, "v1") == []
+    flat = weightstore.load_flat(registry.store_path(reg, "v1"))
+    np.testing.assert_array_equal(flat["params/dense/W"],
+                                  _tree(3)["params"]["dense"]["W"])
+
+
+def test_registry_rejects_bad_names_and_missing(tmp_path):
+    reg = str(tmp_path / "registry")
+    store = _make_store(tmp_path / "s1")
+    with pytest.raises(registry.RegistryError, match="invalid version"):
+        registry.publish(reg, "../evil", store)
+    with pytest.raises(registry.RegistryError, match="invalid version"):
+        registry.publish(reg, "", store)
+    with pytest.raises(registry.RegistryError, match="not a weight store"):
+        registry.publish(reg, "v1", str(tmp_path / "nostore"))
+    with pytest.raises(registry.RegistryError, match="no published"):
+        registry.resolve(reg)
+    registry.publish(reg, "v1", store)
+    with pytest.raises(registry.RegistryError, match="not found"):
+        registry.resolve(reg, "v9")
+    assert registry.verify(reg, "v9") \
+        == ["version 'v9': no readable version.json"]
+
+
+def test_registry_verify_rejects_corrupt_leaf(tmp_path):
+    """The 'corrupt store' fault: truncate one leaf of a published version
+    in place — verify() must report it, so the rollout refuses the version
+    and the previous one keeps serving."""
+    reg = str(tmp_path / "registry")
+    registry.publish(reg, "v1", _make_store(tmp_path / "s1"))
+    hurt = faults_mod.corrupt_store_leaf(registry.store_path(reg, "v1"))
+    assert os.path.getsize(hurt) == 0
+    problems = registry.verify(reg, "v1")
+    assert problems, "truncated leaf not detected"
+    assert any("truncated" in p or "empty" in p for p in problems)
+    # an intact version next to it still verifies clean
+    registry.publish(reg, "v2", _make_store(tmp_path / "s2", seed=9))
+    assert registry.verify(reg, "v2") == []
+
+
+# -- weight-store rewrite race (satellite bugfix) -------------------------------
+
+def test_load_flat_retries_once_on_transient_error(monkeypatch):
+    """A reader racing save_store's dir-swap sees ENOENT (between the two
+    os.replace calls) or a manifest/leaf mismatch (manifest read before
+    the swap, leaf after).  load_flat must absorb ONE such transient and
+    succeed; a persistent failure still escapes."""
+    calls = {"n": 0}
+    real = weightstore._load_flat_once
+
+    def flaky(store_dir, mmap):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FileNotFoundError("transient: store mid-swap")
+        return real(store_dir, mmap)
+
+    monkeypatch.setattr(weightstore, "_load_flat_once", flaky)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        store = _make_store(os.path.join(d, "s"))
+        flat = weightstore.load_flat(store)
+        assert calls["n"] == 2 and "params/dense/W" in flat
+        # a mismatch that persists across the retry is NOT swallowed
+        calls["n"] = -10**9
+        monkeypatch.setattr(
+            weightstore, "_load_flat_once",
+            lambda s, m: (_ for _ in ()).throw(ValueError("corrupt")))
+        with pytest.raises(ValueError, match="corrupt"):
+            weightstore.load_flat(store)
+
+
+def test_load_flat_survives_concurrent_rewrites(tmp_path):
+    """Regression: a writer alternating save_store trees (each a full
+    dir-swap rewrite) while readers loop load_flat must never surface a
+    transient ENOENT/mismatch to the reader."""
+    store = str(tmp_path / "s")
+    weightstore.save_store(store, _tree(0))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                weightstore.save_store(store, _tree(i % 2, scale=2.0))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"writer: {e!r}")
+                return
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 3.0
+    loads = 0
+    try:
+        while time.monotonic() < deadline:
+            try:
+                flat = weightstore.load_flat(store, mmap=False)
+                assert "params/dense/W" in flat
+                loads += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"reader: {e!r}")
+                break
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, errors
+    assert loads > 10
+
+
+# -- fault injection ------------------------------------------------------------
+
+def test_fault_injector_gating():
+    # no faults config: inert
+    fi = FaultInjector(None, "v1")
+    assert not fi.any_active and fi.describe() == []
+    # gated to another version: inert for this replica
+    cfg = {"predict_error": {"version": "v2", "after": 0},
+           "warmup_crash": {"version": "v2"},
+           "readyz_delay": {"version": "v2", "seconds": 5}}
+    fi = FaultInjector(cfg, "v1")
+    assert not fi.any_active
+    # matching version (and "*"): armed
+    fi2 = FaultInjector(cfg, "v2")
+    assert fi2.predict_active and fi2.readyz_active and fi2.any_active
+    assert fi2.describe() == ["predict_error", "warmup_crash",
+                              "readyz_delay"]
+    assert FaultInjector({"predict_error": {"version": "*"}},
+                         None).predict_active
+    # a selector-less fault point never fires (strictly opt-in)
+    assert not FaultInjector({"predict_error": {"after": 0}},
+                             "v1").any_active
+
+
+def test_fault_wrap_predict_after_budget_and_slow():
+    fi = FaultInjector({"predict_error": {"version": "v2", "after": 2}},
+                       "v2")
+    seen = []
+    wrapped = fi.wrap_predict(lambda t, scales=None: seen.append(t) or t)
+    assert wrapped(1) == 1 and wrapped(2) == 2      # clean budget
+    with pytest.raises(FaultError, match="call #3"):
+        wrapped(3)
+    assert seen == [1, 2]
+    slow = FaultInjector({"predict_slow": {"version": "*", "ms": 30}},
+                         "vX")
+    w = slow.wrap_predict(lambda t, scales=None: t)
+    t0 = time.monotonic()
+    assert w("x") == "x"
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_fault_readyz_delay_window():
+    fi = FaultInjector({"readyz_delay": {"version": "v2", "seconds": 7}},
+                       "v2")
+    assert "readyz_delay" in fi.readyz_block_reason(1.0)
+    assert fi.readyz_block_reason(7.5) is None
+    assert FaultInjector({}, "v2").readyz_block_reason(0.0) is None
+
+
+# -- canary judge + rollout state -----------------------------------------------
+
+def test_rollout_params_from_dict():
+    p = rollout.RolloutParams.from_dict(None)
+    assert p.canary_dwell_s == 30.0 and p.auto_rollback and p.prewarm
+    p = rollout.RolloutParams.from_dict(
+        {"canary_dwell_s": 2, "auto_rollback": False, "crash_limit": 0,
+         "error_rate_max": 0.5, "unknown_knob": 1})
+    assert p.canary_dwell_s == 2.0 and not p.auto_rollback
+    assert p.crash_limit == 0 and p.error_rate_max == 0.5
+
+
+def _doc(served=100, dead=0, burn=0.0):
+    return {"total_records": served, "dead_lettered": dead,
+            "slo": {"burn_rate": burn}}
+
+
+def test_judge_crash_limit():
+    p = rollout.RolloutParams(crash_limit=2)
+    assert rollout.judge(None, [], p, canary_crashes=2) is None
+    reason = rollout.judge(None, [], p, canary_crashes=3)
+    assert reason and "crashed 3x" in reason
+    # a missing canary snapshot alone is not a verdict
+    assert rollout.judge(None, [_doc()], p) is None
+
+
+def test_judge_error_rate_after_min_records():
+    p = rollout.RolloutParams(error_rate_max=0.1, min_records=8)
+    # below min_records: one early quarantine cannot condemn the version
+    assert rollout.judge(_doc(served=2, dead=3), [], p) is None
+    reason = rollout.judge(_doc(served=4, dead=4), [], p)
+    assert reason and "error rate" in reason
+    assert rollout.judge(_doc(served=95, dead=5), [], p) is None
+
+
+def test_judge_burn_vs_incumbents():
+    p = rollout.RolloutParams(burn_factor=2.0, burn_min=1.0)
+    incumbents = [_doc(burn=0.4), _doc(burn=0.6)]
+    # worse than the fleet AND bad in absolute terms -> diverged
+    reason = rollout.judge(_doc(burn=1.5), incumbents, p)
+    assert reason and "SLO burn" in reason
+    # worse than incumbents but under the absolute floor: healthy
+    assert rollout.judge(_doc(burn=0.9), incumbents, p) is None
+    # a globally-degraded fleet doesn't scapegoat the canary
+    hot = [_doc(burn=2.0)]
+    assert rollout.judge(_doc(burn=3.0), hot, p) is None
+    assert rollout.judge(_doc(burn=4.5), hot, p) is not None
+
+
+def test_rollout_state_roundtrip(tmp_path):
+    base = str(tmp_path / "cs.pid")
+    st = rollout.load_state(base)
+    assert st["phase"] == "idle" and st["assignments"] == {}
+    st.update(phase="canary", target="v2", base="v1", canary_index=0)
+    st["assignments"] = {0: "v2", 1: "v1"}
+    rollout.save_state(base, st)
+    back = rollout.load_state(base)
+    assert back["phase"] == "canary" and back["target"] == "v2"
+    # json round-trip keeps int keys (the respawn pin is index -> version)
+    assert back["assignments"] == {0: "v2", 1: "v1"}
+    # request file: write/read, garbage tolerated
+    rollout.write_request(base, "v2", 123.0)
+    assert rollout.read_request(base) == {"target": "v2", "ts": 123.0}
+    with open(rollout.request_path(base), "w") as f:
+        f.write("not json")
+    assert rollout.read_request(base) is None
+
+
+# -- version identity + injected faults through a live engine -------------------
+
+def _model(din=16, dout=8):
+    m = Sequential()
+    m.add(Dense(dout, activation="softmax", input_shape=(din,),
+                name=f"ro{din}x{dout}"))
+    m.init_weights()
+    im = InferenceModel()
+    im.do_load_model(m)
+    return im
+
+
+def test_engine_version_identity_in_health_and_results():
+    q = InProcQueue()
+    s = ClusterServing(_model(), q,
+                       params=ServingParams(batch_size=4,
+                                            model_version="v1"))
+    cin, cout = InputQueue(q), OutputQueue(q)
+    uris = [cin.enqueue_tensor(f"u{i}",
+                               np.random.rand(16).astype(np.float32))
+            for i in range(4)]
+    s.start()
+    try:
+        res = cout.query_many(uris, timeout_s=30)
+        # every success payload is stamped with the serving version, so a
+        # client can tell which model answered mid-rollout
+        assert all(r and "value" in r and r["model_version"] == "v1"
+                   for r in res.values()), res
+        h = s.health()
+        assert h["model_version"] == "v1"
+        assert "faults" not in h              # nothing armed, no noise
+    finally:
+        s.shutdown()
+
+
+def test_engine_injected_predict_fault_quarantines():
+    """An armed predict_error flows through the REAL quarantine/bisect
+    machinery: records dead-letter with the injected reason, nothing
+    hangs, and the armed fault is visible in the health doc."""
+    q = InProcQueue()
+    s = ClusterServing(
+        _model(), q,
+        params=ServingParams(
+            batch_size=4, model_version="v2",
+            faults={"predict_error": {"version": "v2", "after": 0}}))
+    cin, cout = InputQueue(q), OutputQueue(q)
+    uris = [cin.enqueue_tensor(f"p{i}",
+                               np.random.rand(16).astype(np.float32))
+            for i in range(4)]
+    s.start()
+    try:
+        res = cout.query_many(uris, timeout_s=30)
+        assert all(r and "error" in r for r in res.values()), res
+        assert any("injected predict_error" in r["error"]
+                   for r in res.values())
+        assert s.dead_lettered == 4
+        h = s.health()
+        assert h["model_version"] == "v2"
+        assert h["faults"] == ["predict_error"]
+    finally:
+        s.shutdown()
+
+
+def test_engine_fault_gated_to_other_version_is_inert():
+    q = InProcQueue()
+    s = ClusterServing(
+        _model(), q,
+        params=ServingParams(
+            batch_size=4, model_version="v1",
+            faults={"predict_error": {"version": "v2", "after": 0}}))
+    cin, cout = InputQueue(q), OutputQueue(q)
+    uris = [cin.enqueue_tensor(f"c{i}",
+                               np.random.rand(16).astype(np.float32))
+            for i in range(4)]
+    s.start()
+    try:
+        res = cout.query_many(uris, timeout_s=30)
+        assert all(r and "value" in r for r in res.values()), res
+        assert s.dead_lettered == 0
+        assert "faults" not in s.health()
+    finally:
+        s.shutdown()
+
+
+def test_fleet_aggregates_version_mix():
+    from analytics_zoo_tpu.serving import fleet
+    docs = {0: {"total_records": 5, "running": True, "replica_id": "r0",
+                "model_version": "v1", "workers": {}, "queue": {}},
+            1: {"total_records": 5, "running": True, "replica_id": "r1",
+                "model_version": "v2", "workers": {}, "queue": {}},
+            2: {"total_records": 5, "running": True, "replica_id": "r2",
+                "model_version": "v1", "workers": {}, "queue": {}}}
+    agg = fleet.aggregate_health(docs)
+    assert agg["versions"] == {"v1": 2, "v2": 1}
+    doc = fleet.fleet_metrics(docs)
+    assert doc["versions"] == {"v1": 2, "v2": 1}
+    assert doc["per_replica"]["r1"]["model_version"] == "v2"
+    # pre-registry fleets (no version anywhere) stay version-silent
+    for d in docs.values():
+        d.pop("model_version")
+    agg = fleet.aggregate_health(docs)
+    assert agg["versions"] is None
+    assert "versions" not in fleet.fleet_metrics(docs)
+
+
+# -- real-process acceptance ----------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_json(url, data=None, headers=None, timeout=10, method=None):
+    req = urllib.request.Request(url, data=data, headers=headers or {},
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _write_topology(tmp_path, din=8):
+    topo = tmp_path / "topology.py"
+    topo.write_text(
+        "from analytics_zoo_tpu.nn import Sequential\n"
+        "from analytics_zoo_tpu.nn.layers import Dense\n"
+        "def build_model():\n"
+        "    m = Sequential()\n"
+        f"    m.add(Dense(4, activation='softmax', input_shape=({din},),"
+        " name='rofc'))\n"
+        "    return m\n")
+    return topo
+
+
+def _write_weights(tmp_path, name, din=8, seed=0):
+    from analytics_zoo_tpu.common.context import init_context
+    init_context(seed=seed)
+    m = Sequential()
+    m.add(Dense(4, activation="softmax", input_shape=(din,),
+                name="rofc"))
+    m.init_weights()
+    path = tmp_path / name
+    m.save_weights(str(path))
+    return path
+
+
+def _manager(env, cwd, *args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+         *args], env=env, cwd=cwd, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _tail(log_path, n=40):
+    try:
+        with open(log_path) as f:
+            return "".join(f.readlines()[-n:])
+    except OSError:
+        return "<no supervisor log>"
+
+
+def _wait_ready(proc, port, count, deadline_s=120, log=None):
+    deadline = time.monotonic() + deadline_s
+    ready = set()
+    while len(ready) < count and time.monotonic() < deadline:
+        assert proc.poll() is None, _tail(log) if log else "<died>"
+        for i in range(count):
+            if i in ready:
+                continue
+            try:
+                code, _ = _http_json(
+                    f"http://127.0.0.1:{port + i}/readyz", timeout=2)
+                if code == 200:
+                    ready.add(i)
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+        time.sleep(0.3)
+    return ready
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_rollout_faulty_v2_auto_rollback_zero_client_failures(tmp_path):
+    """ISSUE 16 acceptance (rollback proof): publish v1, serve it with 2
+    replicas behind the LB, publish a v2 armed with a warmup_crash fault
+    -> `manager rollout v2` canaries replica 0, the canary REALLY crashes
+    (os._exit mid-warm-up), respawns pinned at v2 (the assignment, not
+    `latest`), crashes past crash_limit -> auto-rollback restores v1 and
+    captures an incident bundle naming both versions.  A client hammering
+    the LB for the whole window sees ZERO transport failures and ZERO
+    dropped records."""
+    din = 8
+    topo = _write_topology(tmp_path, din)
+    w1 = _write_weights(tmp_path, "weights1.npz", din, seed=101)
+    w2 = _write_weights(tmp_path, "weights2.npz", din, seed=202)
+    qdir = tmp_path / "q"
+    port = _free_port()
+    lb_port = _free_port()
+    common = (
+        "  type: zoo\n"
+        f"  topology: {topo}\n"
+        "data:\n"
+        f"  src: file:{qdir}\n"
+        "params:\n"
+        "  batch_size: 4\n"
+        f"  http_port: {port}\n"
+        "  drain_s: 2\n"
+        "  lease_s: 2\n"
+        "  reclaim_interval_s: 0.5\n"
+        "  compile_cache_dir: off\n"
+        "  warmup: true\n"
+        "  faults:\n"
+        "    warmup_crash:\n"
+        "      version: v2\n"
+        "rollout:\n"
+        "  canary_dwell_s: 3\n"
+        # generous: the crash-limit verdict (three ~10 s jax-import
+        # crash cycles) must fire before the not-ready timeout does
+        "  ready_timeout_s: 120\n"
+        "  crash_limit: 2\n"
+        "  prewarm: false\n"
+        "incident:\n"
+        "  on_crash: true\n"
+        "  cooldown_s: 1\n")
+    cfg1 = tmp_path / "config.yaml"
+    cfg1.write_text(f"model:\n  path: {w1}\n" + common)
+    cfg2 = tmp_path / "config.v2.yaml"
+    cfg2.write_text(f"model:\n  path: {w2}\n" + common)
+    base = str(tmp_path / "cs.pid")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    cwd = str(tmp_path)
+    # publish v1 from its config
+    out = _manager(env, cwd, "publish", "v1", "-c", str(cfg1),
+                   "--pidfile", base)
+    assert out.returncode == 0, out.stderr
+    pub = json.loads(out.stdout)
+    assert pub["published"] == "v1" and pub["latest"] == "v1"
+    # supervisor stdout/stderr -> FILE, never an unread PIPE: the crash-
+    # looping canary re-prints engine boot output every respawn cycle, a
+    # full 64 KiB pipe would block the supervisor's own event prints and
+    # freeze the rollout state machine mid-canary
+    log = str(tmp_path / "supervisor.log")
+    log_f = open(log, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+         "start", "-c", str(cfg1), "--pidfile", base, "--replicas", "2",
+         "--lb-port", str(lb_port), "--foreground", "--no-prewarm"],
+        env=env, cwd=cwd, stdout=log_f, stderr=subprocess.STDOUT)
+    try:
+        assert _wait_ready(proc, port, 2, log=log) == {0, 1}
+        # both replicas serve the registry's v1 (base pinned at start)
+        code, h = _http_json(f"http://127.0.0.1:{port}/healthz")
+        assert code == 200 and h["model_version"] == "v1"
+        # v2, armed with the warmup_crash fault, goes into the registry
+        out = _manager(env, cwd, "publish", "v2", "-c", str(cfg2),
+                       "--pidfile", base)
+        assert out.returncode == 0, out.stderr
+        # hammer the front door for the whole rollout window: every
+        # record must round-trip with a value — the swap and the
+        # rollback must be client-invisible
+        stop = threading.Event()
+        stats = {"ok": 0, "failures": []}
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                uri = f"h{i}"
+                i += 1
+                try:
+                    body = json.dumps(
+                        {"uri": uri, "data": [0.1] * din}).encode()
+                    code, ack = _http_json(
+                        f"http://127.0.0.1:{lb_port}/v1/enqueue",
+                        data=body,
+                        headers={"Content-Type": "application/json"})
+                    if code != 200:
+                        stats["failures"].append((uri, code, ack))
+                        continue
+                    code, res = _http_json(
+                        f"http://127.0.0.1:{lb_port}/v1/result/{uri}"
+                        "?timeout_s=30", timeout=40)
+                    if code != 200 or "value" not in res:
+                        stats["failures"].append((uri, code, res))
+                    else:
+                        stats["ok"] += 1
+                except Exception as e:  # noqa: BLE001 — that's the test
+                    stats["failures"].append((uri, "exc", repr(e)))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        time.sleep(1.0)            # some pre-rollout traffic
+        out = _manager(env, cwd, "rollout", "v2", "-c", str(cfg1),
+                       "--pidfile", base)
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)["rollout"] == "v2"
+        # watch the state machine: the canary phase must pin slot 0 to
+        # v2 (the respawn pin — crash loops respawn at the ASSIGNMENT,
+        # never at `latest`), then the crash verdict rolls it back
+        saw_canary_pin = False
+        rolled_back = None
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            st = rollout.load_state(base)
+            if st["phase"] == "canary" \
+                    and st["assignments"].get(0) == "v2":
+                saw_canary_pin = True
+            if st["phase"] == "idle" and st.get("last_rollback"):
+                rolled_back = st
+                break
+            time.sleep(0.2)
+        assert rolled_back, \
+            f"no rollback: {rollout.load_state(base)}\n{_tail(log)}"
+        assert saw_canary_pin, "canary never pinned slot 0 to v2"
+        lr = rolled_back["last_rollback"]
+        assert lr["target"] == "v2" and "crashed" in lr["reason"]
+        # the fleet is whole again at v1 — every slot back on the prior
+        # version and ready
+        assert _wait_ready(proc, port, 2, deadline_s=90, log=log) == {0, 1}
+        for i in range(2):
+            code, h = _http_json(f"http://127.0.0.1:{port + i}/healthz")
+            assert code == 200 and h["model_version"] == "v1", (i, h)
+        # a little post-rollback traffic, then stop the hammer
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=60)
+        assert stats["ok"] > 10, stats
+        assert stats["failures"] == [], stats["failures"][:5]
+        # the rollback IS the incident: a bundle stamped with both
+        # versions and the crash verdict
+        bundles = incident.list_incidents(base)
+        rb = [b for b in bundles
+              if str(b.get("reason", "")).startswith("rollout-rollback")]
+        assert rb, [b.get("reason") for b in bundles]
+        meta = rb[-1]["meta"]
+        assert meta["from_version"] == "v2"
+        assert meta["to_version"] == "v1"
+        assert "crashed" in meta["reason"]
+        # `manager status` tells the same story: fleet at v1, rollout
+        # state carries the rollback verdict
+        out = _manager(env, cwd, "status", "--pidfile", base)
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        members = doc["replicas"]["members"]
+        assert all(m.get("model_version") == "v1"
+                   for m in members.values()), members
+        assert doc["rollout"]["last_rollback"]["target"] == "v2"
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log_f.close()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_rollout_clean_v2_promotes_with_warm_replicas(tmp_path):
+    """ISSUE 16 acceptance (promote proof): a healthy v2 canaries, dwells
+    clean, rolls through the fleet one replica at a time and promotes;
+    the registry prewarm means every replaced replica boots from the
+    shared XLA cache with ZERO backend compiles (cache_misses == 0)."""
+    din = 8
+    topo = _write_topology(tmp_path, din)
+    w1 = _write_weights(tmp_path, "weights1.npz", din, seed=11)
+    w2 = _write_weights(tmp_path, "weights2.npz", din, seed=22)
+    qdir = tmp_path / "q"
+    port = _free_port()
+    common = (
+        "  type: zoo\n"
+        f"  topology: {topo}\n"
+        "data:\n"
+        f"  src: file:{qdir}\n"
+        "params:\n"
+        "  batch_size: 4\n"
+        f"  http_port: {port}\n"
+        "  drain_s: 2\n"
+        "  lease_s: 2\n"
+        "  reclaim_interval_s: 0.5\n"
+        "  warmup: true\n"
+        "rollout:\n"
+        "  canary_dwell_s: 2\n"
+        "  ready_timeout_s: 120\n")
+    cfg1 = tmp_path / "config.yaml"
+    cfg1.write_text(f"model:\n  path: {w1}\n" + common)
+    cfg2 = tmp_path / "config.v2.yaml"
+    cfg2.write_text(f"model:\n  path: {w2}\n" + common)
+    base = str(tmp_path / "cs.pid")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    cwd = str(tmp_path)
+    out = _manager(env, cwd, "publish", "v1", "-c", str(cfg1),
+                   "--pidfile", base)
+    assert out.returncode == 0, out.stderr
+    out = _manager(env, cwd, "publish", "v2", "-c", str(cfg2),
+                   "--pidfile", base)
+    assert out.returncode == 0, out.stderr
+    # the registry inventory knows both, latest = v2
+    out = _manager(env, cwd, "versions", "--pidfile", base)
+    assert out.returncode == 0, out.stderr
+    inv = json.loads(out.stdout)
+    assert [v["version"] for v in inv["versions"]] == ["v1", "v2"]
+    assert inv["latest"] == "v2"
+    # a corrupt version is refused at rollout time, before any replica
+    # is touched: publish v3, truncate a leaf, ask for it
+    out = _manager(env, cwd, "publish", "v3", "-c", str(cfg1),
+                   "--pidfile", base)
+    assert out.returncode == 0, out.stderr
+    faults_mod.corrupt_store_leaf(
+        registry.store_path(base + ".registry", "v3"))
+    # publishing v3 moved `latest` there — point it back at v2, or the
+    # fresh fleet below would boot (and integrity-fail) on the corrupt
+    # version instead of serving v2
+    registry.set_latest(base + ".registry", "v2")
+    # supervisor output -> FILE (an unread PIPE can fill and block the
+    # supervisor's event prints, freezing the rollout state machine)
+    log = str(tmp_path / "supervisor.log")
+    log_f = open(log, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+         "start", "-c", str(cfg1), "--pidfile", base, "--replicas", "2",
+         "--foreground"],
+        env=env, cwd=cwd, stdout=log_f, stderr=subprocess.STDOUT)
+    try:
+        # initial prewarm + 2 warm boots; base pinned at... the latest
+        # at START time is v2, but replicas must serve what the state
+        # says — a fresh deployment starts at latest (v2)?  No: the
+        # state file does not exist yet, so base = latest = v2 would
+        # skip the rollout entirely.  Roll DOWN to v1 first to prove
+        # the machine moves both ways, then up to v2.
+        assert _wait_ready(proc, port, 2, deadline_s=180, log=log) \
+            == {0, 1}
+        code, h = _http_json(f"http://127.0.0.1:{port}/healthz")
+        assert code == 200 and h["model_version"] == "v2"
+        # corrupt v3 is rejected loudly; the fleet keeps serving
+        out = _manager(env, cwd, "rollout", "v3", "-c", str(cfg1),
+                       "--pidfile", base)
+        assert out.returncode == 1
+        assert "integrity" in (out.stderr or "")
+        # roll to v1 (a real rollout: canary -> dwell -> rolling ->
+        # promote)
+        out = _manager(env, cwd, "rollout", "v1", "-c", str(cfg1),
+                       "--pidfile", base)
+        assert out.returncode == 0, out.stderr
+        deadline = time.monotonic() + 240
+        promoted = None
+        while time.monotonic() < deadline:
+            st = rollout.load_state(base)
+            if st["phase"] == "idle" and st.get("base") == "v1" \
+                    and not st["assignments"]:
+                promoted = st
+                break
+            time.sleep(0.3)
+        assert promoted, \
+            f"no promote: {rollout.load_state(base)}\n{_tail(log)}"
+        assert promoted.get("last_rollback") in (None, {}) \
+            or promoted["last_rollback"].get("target") != "v1"
+        assert _wait_ready(proc, port, 2, deadline_s=120, log=log) \
+            == {0, 1}
+        for i in range(2):
+            code, h = _http_json(f"http://127.0.0.1:{port + i}/healthz")
+            assert code == 200, h
+            assert h["model_version"] == "v1", (i, h)
+            # zero cold start held through the rollout: the replaced
+            # replica compiled NOTHING — the registry prewarm filled the
+            # shared cache before the swap
+            cs = (h.get("warmup") or {}).get("compile_stats") or {}
+            assert cs.get("cache_misses") == 0, (i, h.get("warmup"))
+        # traffic serves at the new version, results stamped with it
+        body = json.dumps({"uri": "post-promote",
+                           "data": [0.2] * din}).encode()
+        code, ack = _http_json(
+            f"http://127.0.0.1:{port}/v1/enqueue", data=body,
+            headers={"Content-Type": "application/json"})
+        assert code == 200, ack
+        code, res = _http_json(
+            f"http://127.0.0.1:{port}/v1/result/post-promote"
+            "?timeout_s=30", timeout=40)
+        assert code == 200 and "value" in res, res
+        assert res["model_version"] == "v1"
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log_f.close()

@@ -41,6 +41,17 @@ Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
          # --autoscale off holds the initial fleet.  Emits the
          # p50/p99/shed/replica trajectory in --json; diff the on/off
          # documents (RUNLOG_serving.md records the acceptance A/B)
+     python tools/serving_bench.py --rollout --json rollout.json
+         # PR 16 zero-drop rollout chaos A/B: two REAL manager
+         # deployments (registry + supervisor + fault-injected v2 whose
+         # every predict fails).  Arm 1 rolls out v2 with auto_rollback
+         # on -> the canary judge catches the error rate and rolls the
+         # fleet back; arm 2 disables auto_rollback -> the divergence is
+         # recorded but v2 promotes and the whole fleet serves errors.
+         # Reports client-visible errors per arm (the damage rollback
+         # prevents), time_to_rollback_s, and records_dropped (ASSERTED
+         # zero on both arms — faults error records, they never lose
+         # them)
 """
 
 from __future__ import annotations
@@ -1150,6 +1161,319 @@ def _run_swing(args):
     return doc
 
 
+def _run_rollout(args):
+    """PR 16 zero-drop rollout chaos A/B over REAL manager deployments.
+
+    Each arm publishes v1 and a fault-armed v2 (`predict_error` gated on
+    v2: every record it claims dead-letters) into a fresh registry, serves
+    v1 with 2 supervised replicas over a shared FileQueue, then requests
+    `manager rollout v2` under steady client load:
+
+    - arm "on": the canary judge catches the error rate and auto-rolls
+      back; the damage is the handful of records the canary ate.
+    - arm "off" (`rollout.auto_rollback: false`): the divergence is
+      recorded but v2 promotes, and from then on the WHOLE fleet errors
+      every record — the damage rollback exists to prevent.
+
+    Both arms assert records_dropped == 0: every enqueued record resolves
+    (value or error), through the canary, the rollback and the promote.
+    """
+    import shutil
+    import signal as _signal
+    import socket
+    import subprocess
+    import urllib.request
+
+    from analytics_zoo_tpu.serving import rollout as _rollout
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def manager(cwd, *cli, timeout=180):
+        return subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+             *cli], env=env, cwd=cwd, capture_output=True, text=True,
+            timeout=timeout)
+
+    def readyz(port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=2) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001 — booting / replaced
+            return False
+
+    def run_arm(auto_rollback):
+        root = tempfile.mkdtemp(prefix="serving_rollout_")
+        din = 8
+        topo = os.path.join(root, "topology.py")
+        with open(topo, "w") as f:
+            f.write(
+                "from analytics_zoo_tpu.nn import Sequential\n"
+                "from analytics_zoo_tpu.nn.layers import Dense\n"
+                "def build_model():\n"
+                "    m = Sequential()\n"
+                "    m.add(Dense(4, activation='softmax', "
+                f"input_shape=({din},), name='rollfc'))\n"
+                "    return m\n")
+        from analytics_zoo_tpu.nn import Sequential
+        from analytics_zoo_tpu.nn.layers import Dense
+        weights = {}
+        for name, seed in (("w1.npz", 1), ("w2.npz", 2)):
+            from analytics_zoo_tpu.common.context import init_context
+            init_context(seed=seed)
+            m = Sequential()
+            m.add(Dense(4, activation="softmax", input_shape=(din,),
+                        name="rollfc"))
+            m.init_weights()
+            weights[name] = os.path.join(root, name)
+            m.save_weights(weights[name])
+        qdir = os.path.join(root, "q")
+        port = free_port()
+        # the judge must convict within the canary window on the "on"
+        # arm (long dwell), and the "off" arm must promote quickly
+        # (short dwell) so the post-promote damage is measurable
+        common = (
+            "  type: zoo\n"
+            f"  topology: {topo}\n"
+            "data:\n"
+            f"  src: file:{qdir}\n"
+            "params:\n"
+            "  batch_size: 4\n"
+            f"  http_port: {port}\n"
+            "  drain_s: 2\n"
+            "  lease_s: 2\n"
+            "  reclaim_interval_s: 0.5\n"
+            "  compile_cache_dir: off\n"
+            "  faults:\n"
+            "    predict_error:\n"
+            "      version: v2\n"
+            "      after: 0\n"
+            "rollout:\n"
+            f"  canary_dwell_s: {20 if auto_rollback else 4}\n"
+            "  ready_timeout_s: 120\n"
+            "  min_records: 4\n"
+            "  error_rate_max: 0.2\n"
+            f"  auto_rollback: {'true' if auto_rollback else 'false'}\n"
+            "  prewarm: false\n"
+            "incident:\n"
+            "  on_crash: true\n"
+            "  cooldown_s: 1\n")
+        cfg1 = os.path.join(root, "config.yaml")
+        with open(cfg1, "w") as f:
+            f.write(f"model:\n  path: {weights['w1.npz']}\n" + common)
+        cfg2 = os.path.join(root, "config.v2.yaml")
+        with open(cfg2, "w") as f:
+            f.write(f"model:\n  path: {weights['w2.npz']}\n" + common)
+        base = os.path.join(root, "cs.pid")
+        # publish ONLY v1 before the fleet starts: a fresh deployment
+        # serves the registry's `latest`, and the faulted v2 must arrive
+        # as a ROLLOUT, not as the boot version
+        out = manager(root, "publish", "v1", "-c", cfg1,
+                      "--pidfile", base)
+        assert out.returncode == 0, \
+            f"publish v1 failed: {out.stderr[-2000:]}"
+        # supervisor stdout/stderr to a FILE: an unread PIPE would fill
+        # and block the supervisor's own event prints mid-rollout
+        log_path = os.path.join(root, "supervisor.log")
+        log_f = open(log_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.manager",
+             "start", "-c", cfg1, "--pidfile", base, "--replicas", "2",
+             "--foreground", "--no-prewarm"],
+            env=env, cwd=root, stdout=log_f, stderr=subprocess.STDOUT)
+
+        def log_tail():
+            try:
+                with open(log_path) as f:
+                    return "".join(f.readlines()[-40:])
+            except OSError:
+                return "<no supervisor log>"
+
+        doc = {"auto_rollback": auto_rollback}
+        enq_ts, arrived, errors = {}, {}, {}
+        state = {"enqueued": 0, "stop": False}
+        lock = threading.Lock()
+        try:
+            deadline = time.time() + 180
+            while time.time() < deadline and \
+                    not (readyz(port) and readyz(port + 1)):
+                assert proc.poll() is None, log_tail()
+                time.sleep(0.3)
+            assert readyz(port) and readyz(port + 1), "fleet never ready"
+            out = manager(root, "publish", "v2", "-c", cfg2,
+                          "--pidfile", base)
+            assert out.returncode == 0, \
+                f"publish v2 failed: {out.stderr[-2000:]}"
+            queue = FileQueue(qdir)
+            cin = InputQueue(queue)
+            g = np.random.default_rng(0)
+
+            def driver():
+                i = 0
+                period = 1.0 / max(args.rollout_rps, 0.1)
+                nxt = time.monotonic()
+                while not state["stop"]:
+                    uri = f"ro-{i}"
+                    i += 1
+                    try:
+                        cin.enqueue_tensor(uri, g.random(din, np.float32),
+                                           timeout_s=45.0)
+                        with lock:
+                            enq_ts[uri] = time.monotonic()
+                            state["enqueued"] += 1
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            errors[uri] = f"enqueue: {e!r}"
+                    nxt += period
+                    d = nxt - time.monotonic()
+                    if d > 0:
+                        time.sleep(d)
+
+            def poller():
+                while not state["stop"]:
+                    with lock:
+                        outstanding = [u for u in enq_ts
+                                       if u not in arrived
+                                       and u not in errors]
+                    try:
+                        res = queue.get_results(outstanding)
+                    except Exception:  # noqa: BLE001 — transient FS race
+                        time.sleep(0.1)
+                        continue
+                    now = time.monotonic()
+                    with lock:
+                        for u, r in res.items():
+                            if r is None:
+                                continue
+                            if OutputQueue.is_error(r):
+                                errors[u] = str(r.get("error"))
+                            else:
+                                arrived[u] = now - enq_ts[u]
+                    time.sleep(0.1)
+
+            drv = threading.Thread(target=driver, daemon=True)
+            pol = threading.Thread(target=poller, daemon=True)
+            drv.start()
+            pol.start()
+            time.sleep(2.0)            # pre-rollout baseline traffic
+            t_req = time.monotonic()
+            out = manager(root, "rollout", "v2", "-c", cfg1,
+                          "--pidfile", base)
+            assert out.returncode == 0, \
+                f"rollout request failed: {out.stderr[-2000:]}"
+            terminal = None
+            t_done = None
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                st = _rollout.load_state(base)
+                if st["phase"] == "idle":
+                    if st.get("last_rollback"):
+                        terminal, t_done = "rolled_back", time.monotonic()
+                        break
+                    if st.get("base") == "v2":
+                        terminal, t_done = "promoted", time.monotonic()
+                        break
+                time.sleep(0.3)
+            assert terminal, \
+                f"rollout never terminal: {_rollout.load_state(base)}"
+            # post-terminal traffic: the promoted "off" arm keeps paying
+            # for its bad version here; the "on" arm serves clean
+            time.sleep(args.rollout_damage_s)
+            state["stop"] = True
+            drv.join(timeout=10)
+            pol.join(timeout=10)
+            # drain: every record must resolve (value or error)
+            drain_deadline = time.monotonic() + 60
+            while time.monotonic() < drain_deadline:
+                with lock:
+                    outstanding = [u for u in enq_ts
+                                   if u not in arrived and u not in errors]
+                if not outstanding:
+                    break
+                try:
+                    res = queue.get_results(outstanding)
+                    now = time.monotonic()
+                    with lock:
+                        for u, r in res.items():
+                            if r is None:
+                                continue
+                            if OutputQueue.is_error(r):
+                                errors[u] = str(r.get("error"))
+                            else:
+                                arrived[u] = now - enq_ts[u]
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.2)
+            st = _rollout.load_state(base)
+            dropped = [u for u in enq_ts
+                       if u not in arrived and u not in errors]
+            dropped += [u for u, e in errors.items()
+                        if "deadline-exceeded" in e]
+            faulted = sum(1 for e in errors.values()
+                          if "injected predict_error" in e
+                          or "quarantine" in e)
+            doc.update({
+                "terminal": terminal,
+                "time_to_terminal_s": round(t_done - t_req, 2),
+                "time_to_rollback_s": (round(t_done - t_req, 2)
+                                       if terminal == "rolled_back"
+                                       else None),
+                "serving_version": st.get("base"),
+                "diverged": (st.get("diverged")
+                             or (st.get("last_rollback") or {}).get(
+                                 "reason")),
+                "enqueued": state["enqueued"],
+                "served": len(arrived),
+                "client_errors": len(errors),
+                "faulted_records": faulted,
+                "records_dropped": len(dropped),
+            })
+            assert not dropped, \
+                f"{len(dropped)} record(s) dropped: {dropped[:5]}"
+            return doc
+        finally:
+            state["stop"] = True
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            log_f.close()
+            shutil.rmtree(root, ignore_errors=True)
+
+    on = run_arm(True)
+    off = run_arm(False)
+    # the A/B verdict: rollback bounded the damage to the canary's share
+    # of the window; without it the promoted bad version errors the fleet
+    assert on["terminal"] == "rolled_back", on
+    assert on["serving_version"] == "v1", on
+    assert off["terminal"] == "promoted", off
+    assert off["serving_version"] == "v2", off
+    assert off["client_errors"] > on["client_errors"], (on, off)
+    return {
+        "profile": "rollout",
+        "rps": args.rollout_rps,
+        "rollback_on": on,
+        "rollback_off": off,
+        "errors_prevented": off["client_errors"] - on["client_errors"],
+        "time_to_rollback_s": on["time_to_rollback_s"],
+        "records_dropped": on["records_dropped"]
+        + off["records_dropped"],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2048)
@@ -1360,6 +1684,20 @@ def main(argv=None):
     ap.add_argument("--quantize-percentile", type=float, default=None,
                     help="quantize A/B: int8 calibration percentile clip "
                          "(default absmax)")
+    ap.add_argument("--rollout", action="store_true",
+                    help="PR 16 zero-drop rollout chaos A/B: two real "
+                         "manager deployments roll out a fault-injected "
+                         "v2 (every predict errors) — once with "
+                         "auto_rollback on (canary judge rolls the fleet "
+                         "back) and once with it off (v2 promotes; the "
+                         "fleet-wide error stream is the damage rollback "
+                         "prevents).  records_dropped is asserted 0 on "
+                         "both arms")
+    ap.add_argument("--rollout-rps", type=float, default=5.0,
+                    help="client offered load during the rollout A/B")
+    ap.add_argument("--rollout-damage-s", type=float, default=5.0,
+                    help="post-terminal traffic window: how long to keep "
+                         "measuring after the rollback / promote lands")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 smoke: tiny MLP workload, asserts the "
                          "pipeline completes with stage metrics populated")
@@ -1407,6 +1745,22 @@ def main(argv=None):
             args.gen_prompt_max = min(args.gen_prompt_max, 8)
             args.gen_laps = 1
         out = _run_generate(args)
+        print(json.dumps(out))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
+    if args.rollout:
+        # the rollout chaos A/B is self-contained: registry + supervised
+        # fleets in throwaway temp dirs, tiny fixed model
+        out = _run_rollout(args)
         print(json.dumps(out))
         if args.json_path:
             doc = {"bench": "serving_bench", "ts": time.time(),
