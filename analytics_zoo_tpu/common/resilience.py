@@ -16,6 +16,9 @@ primitives live here as a plain library:
                        ``health()`` (serving ``_pre_loop``/``_predict_loop``).
 - ``Deadline``       — tiny remaining-time helper (client ``get_result``,
                        engine shutdown joins).
+- ``RetryBudget``    — windowed cap on the retry FRACTION of traffic
+                       (PR 17: the LB's anti-retry-storm gate; exhaustion
+                       is counted, never silent).
 
 Everything takes injectable ``clock``/``sleep`` so the fault-injection tests
 (`tests/test_resilience.py`, driven by `utils/chaos.FaultInjector`) run with
@@ -27,6 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional, Tuple, Type
 
 logger = logging.getLogger(__name__)
@@ -87,13 +91,15 @@ class RetryPolicy:
                  max_delay_s: float = 2.0, multiplier: float = 2.0,
                  jitter: float = 0.0, deadline_s: Optional[float] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 budget: Optional["RetryBudget"] = None):
         self.max_retries = int(max_retries)
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
         self.multiplier = float(multiplier)
         self.jitter = float(jitter)
         self.deadline_s = deadline_s
+        self.budget = budget
         self._sleep = sleep
         self._clock = clock
 
@@ -108,6 +114,21 @@ class RetryPolicy:
             d *= 1.0 + self.jitter * frac
         return d
 
+    def delay_for(self, attempt: int, exc: Optional[BaseException]) -> float:
+        """``delay(attempt)``, stretched to honor a server-supplied
+        ``retry_after_s`` riding on the exception (PR 17: 429/admission
+        rejections carry the bucket's computed refill time) — never
+        beyond ``max_delay_s``, so a hostile hint cannot park the
+        caller."""
+        d = self.delay(attempt)
+        hint = getattr(exc, "retry_after_s", None)
+        try:
+            if hint is not None and float(hint) > 0:
+                d = max(d, float(hint))
+        except (TypeError, ValueError):
+            pass
+        return min(d, self.max_delay_s)
+
     def sleep(self, attempt: int) -> None:
         self._sleep(self.delay(attempt))
 
@@ -119,6 +140,8 @@ class RetryPolicy:
         ``RetryExhausted`` (chained to the last error) when attempts or the
         deadline run out."""
         deadline = Deadline(self.deadline_s, clock=self._clock)
+        if self.budget is not None:
+            self.budget.note_request()
         attempt = 0
         while True:
             try:
@@ -128,7 +151,12 @@ class RetryPolicy:
                     raise RetryExhausted(
                         f"{getattr(fn, '__name__', fn)!s} failed after "
                         f"{attempt + 1} attempts") from e
-                d = self.delay(attempt)
+                if self.budget is not None and not self.budget.allow_retry():
+                    # budget dry: surface the ORIGINAL failure — a retry
+                    # storm amplifying an overload is worse than one more
+                    # visible error (PR 17; the budget counts the denial)
+                    raise
+                d = self.delay_for(attempt, e)
                 if deadline.remaining() < d:
                     raise RetryExhausted(
                         f"{getattr(fn, '__name__', fn)!s} deadline "
@@ -138,6 +166,78 @@ class RetryPolicy:
                     on_retry(attempt, e)
                 self._sleep(d)
                 attempt += 1
+
+
+class RetryBudget:
+    """Windowed cap on the FRACTION of traffic that may be retries
+    (PR 17 tentpole, the LB's anti-retry-storm gate).
+
+    Under partial overload every failed proxy attempt becomes a reroute;
+    at fleet scale those reroutes are themselves load, and the amplified
+    load finishes the overload off.  A retry budget bounds the blast
+    radius: retries are allowed while the retries-in-window stay under
+    ``ratio`` x requests-in-window (with a ``min_retries`` floor so a
+    near-idle window can still retry at all).  Exhaustion is COUNTED
+    (``exhausted``), never silent — the LB exports it as
+    ``lb_retry_budget_exhausted_total``.
+
+    Thread-safe; clock-injectable for fake-clock tests.
+    """
+
+    def __init__(self, ratio: float = 0.2, min_retries: int = 3,
+                 window_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ratio = max(0.0, float(ratio))
+        self.min_retries = max(0, int(min_retries))
+        self.window_s = max(0.001, float(window_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._requests: deque = deque()
+        self._retries: deque = deque()
+        self.exhausted = 0
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._requests and self._requests[0] < horizon:
+            self._requests.popleft()
+        while self._retries and self._retries[0] < horizon:
+            self._retries.popleft()
+
+    def note_request(self, now: Optional[float] = None) -> None:
+        """Count one first-attempt request into the window."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._prune(now)
+            self._requests.append(now)
+
+    def allow_retry(self, now: Optional[float] = None) -> bool:
+        """Consume one retry slot if the window has budget; a denial is
+        counted in ``exhausted``."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._prune(now)
+            cap = max(self.min_retries,
+                      int(self.ratio * len(self._requests)))
+            if len(self._retries) < cap:
+                self._retries.append(now)
+                return True
+            self.exhausted += 1
+            return False
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            return {
+                "ratio": self.ratio,
+                "min_retries": self.min_retries,
+                "window_s": self.window_s,
+                "requests_in_window": len(self._requests),
+                "retries_in_window": len(self._retries),
+                "exhausted": self.exhausted,
+            }
 
 
 class CircuitBreakerOpen(RuntimeError):

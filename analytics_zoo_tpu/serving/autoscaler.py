@@ -96,6 +96,11 @@ class FleetSignals:
     inflight_batches: int = 2
     inflight_ceiling: int = 64
     preprocess_workers: int = 1
+    # overload armor (PR 17): the fleet's worst brownout ladder stage
+    # (serving/brownout.py; 0 = healthy).  A browned-out fleet is by
+    # definition shedding work to protect its SLO — the policy treats
+    # stage >= 2 as overload pressure alongside the p99/backlog signals.
+    brownout_stage: int = 0
 
 
 @dataclass
@@ -239,9 +244,14 @@ class AutoscalerPolicy:
             projected = backlog + rates["backlog_rate"] * lead
         overload = ((p99 is not None and p99 > p.p99_high * p.slo_p99_ms)
                     or projected > p.backlog_high * batch_quantum
-                    or rates["shed"] > 0)
+                    or rates["shed"] > 0
+                    # brownout (PR 17): a replica deep in the degradation
+                    # ladder is already sacrificing quality — treat it as
+                    # overload so capacity arrives before stage 3 sheds
+                    or s.brownout_stage >= 2)
         underload = (backlog < p.backlog_low * batch_quantum
                      and rates["shed"] == 0
+                     and s.brownout_stage == 0
                      and (p99 is None or p99 < p.p99_low * p.slo_p99_ms))
 
         # hysteresis bookkeeping: the dead band resets BOTH dwell timers, so
@@ -652,12 +662,15 @@ class EngineFleet:
         served = shed = quarantined = reclaimed = 0.0
         warming = 0
         cold_start = None
+        brownout = 0
         hb: Dict[str, float] = {}
         for e in engines:
             served += e.total_records
             shed += e.shed
             quarantined += e.dead_lettered
             reclaimed += e.reclaimed
+            brownout = max(brownout, int(getattr(e, "brownout_stage", 0)
+                                         or 0))
             hb[e.replica_id] = e._heartbeat_age()
             w = getattr(e, "_warm_state", None) or {}
             if w.get("state") in ("pending", "warming"):
@@ -699,7 +712,8 @@ class EngineFleet:
                 e._stages["predict"] for e in engines),
             heartbeat_ages=hb,
             replicas_warming=warming,
-            cold_start_s=cold_start)
+            cold_start_s=cold_start,
+            brownout_stage=brownout)
         if engines:
             k = engines[0].knobs()
             sig.max_batch = int(k["max_batch"])
@@ -769,6 +783,7 @@ class ManagerFleet:
             heartbeat_ages=dict(agg.get("heartbeat_ages", {})),
             replicas_warming=int(agg.get("replicas_warming", 0) or 0),
             cold_start_s=agg.get("cold_start_s"),
+            brownout_stage=int(agg.get("brownout_stage") or 0),
             max_batch=int(knobs.get("max_batch", 4)),
             max_batch_ceiling=int(knobs.get("max_batch_ceiling", 1024)),
             inflight_batches=int(knobs.get("inflight_batches", 2)),

@@ -39,9 +39,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from analytics_zoo_tpu.common.observability import new_trace_id
-from analytics_zoo_tpu.common.resilience import Deadline
+from analytics_zoo_tpu.common.resilience import Deadline, RetryPolicy
 from analytics_zoo_tpu.serving import wire as _wire
-from analytics_zoo_tpu.serving.queues import BaseQueue
+from analytics_zoo_tpu.serving.queues import (BaseQueue, QueueClosed,
+                                              QueueFull)
 
 logger = logging.getLogger(__name__)
 
@@ -79,6 +80,13 @@ class InputQueue:
         self._shm_slot_bytes = shm_slot_bytes
         self._shm_ring: Optional[_wire.ShmRing] = None
         self._shm_warned = False
+        # briefly-full-queue retry (PR 17): a queue pinned at max_depth is
+        # usually one engine batch-drain away from having room, so the
+        # producer retries with capped jittered backoff instead of
+        # surfacing a typed failure for a transient.  Tests swap the
+        # policy for one with an injected sleep.
+        self._full_retry = RetryPolicy(max_retries=4, base_delay_s=0.02,
+                                       max_delay_s=0.5, jitter=0.5)
 
     def close(self) -> None:
         """Release the shm ring (producer side owns the segment).  Safe to
@@ -123,10 +131,32 @@ class InputQueue:
             record["u8"] = 1
         return self._xadd(record, timeout_s)
 
+    def _xadd_admitted(self, payload):
+        """``queue.xadd`` with a bounded retry on ``QueueFull``.
+        ``QueueClosed`` (draining) subclasses QueueFull but is TERMINAL —
+        re-raised untouched, retrying a shutdown is pointless — and a
+        server-stamped ``retry_after_s`` riding on the exception stretches
+        the backoff (the admission 429 contract), capped by the policy's
+        ``max_delay_s`` so a hostile hint cannot park the producer.  The
+        final QueueFull re-raises as ITSELF, keeping the typed rejection
+        callers already handle."""
+        attempt = 0
+        while True:
+            try:
+                return self.queue.xadd(payload)
+            except QueueClosed:
+                raise
+            except QueueFull as e:
+                if attempt >= self._full_retry.max_retries:
+                    raise
+                self._full_retry._sleep(
+                    self._full_retry.delay_for(attempt, e))
+                attempt += 1
+
     def _xadd(self, record: Dict, timeout_s: Optional[float]) -> str:
         record = _stamp_deadline(record, timeout_s)
         self._tl.trace_id = record["trace_id"]
-        rid = self.queue.xadd(record)
+        rid = self._xadd_admitted(record)
         # wire accounting: the b64 string dominates a legacy record's bytes;
         # the rest of the header is serialized here only because it is tiny
         b64 = record.get("b64") or record.get("image") or ""
@@ -138,7 +168,7 @@ class InputQueue:
 
     def _xadd_frame(self, frame: bytes, trace_id: str) -> str:
         self._tl.trace_id = trace_id
-        rid = self.queue.xadd(frame)
+        rid = self._xadd_admitted(frame)
         self.wire_bytes_enqueued += len(frame)
         self.records_enqueued += 1
         return rid

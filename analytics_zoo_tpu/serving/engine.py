@@ -409,7 +409,9 @@ class ServingParams:
                  recorder_ring: Optional[int] = None,
                  profiling: bool = True,
                  model_version: Optional[str] = None,
-                 faults=None):
+                 faults=None,
+                 admission=None,
+                 brownout=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -555,6 +557,15 @@ class ServingParams:
         self.model_version = (None if model_version is None
                               else str(model_version))
         self.faults = faults if isinstance(faults, dict) else None
+        # overload armor (PR 17).  `admission`: tenant-aware token-bucket
+        # admission at the gateway trust edge (serving/admission.py —
+        # enabled, rate, burst, tenants, depth_fractions); None = the
+        # pre-PR-17 fleet-wide max_depth 429 only.  `brownout`: the
+        # hysteresis degradation ladder driven by the SLO burn rate
+        # (serving/brownout.py — enter, exit_ratio, dwell_s, hold_s,
+        # batch_max_tokens); needs `serving_slo` for its input signal.
+        self.admission = admission if isinstance(admission, dict) else None
+        self.brownout = brownout if isinstance(brownout, dict) else None
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -611,7 +622,9 @@ class ServingParams:
                            else int(p["recorder_ring"])),
             profiling=bool(p.get("profiling", True)),
             model_version=p.get("model_version"),
-            faults=p.get("faults"))
+            faults=p.get("faults"),
+            admission=p.get("admission"),
+            brownout=p.get("brownout"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -745,6 +758,38 @@ class ClusterServing:
         if self._faults.predict_active and \
                 isinstance(model, InferenceModel):
             model.do_predict = self._faults.wrap_predict(model.do_predict)
+        # overload armor (PR 17): the brownout degradation ladder (driven
+        # by the SLO burn rate the read loop feeds it) and the tenant-
+        # aware admission gate the gateway consults per request.  Both
+        # are config-gated — None wires nothing into the hot path.
+        self._brownout = None
+        self._brownout_next = 0.0            # next ladder tick (throttled)
+        if self.params.brownout is not None:
+            from analytics_zoo_tpu.serving.brownout import BrownoutLadder
+            self._brownout = BrownoutLadder(
+                self.params.brownout,
+                recorder=(self.recorder if self.params.flight_recorder
+                          else None),
+                registry=self.registry, replica_id=self.replica_id)
+        self._admission = None
+        if self.params.admission is not None:
+            from analytics_zoo_tpu.serving.admission import (
+                AdmissionController)
+            self._admission = AdmissionController(
+                self.params.admission, registry=self.registry,
+                queue_depth_fn=self._admission_depth,
+                max_depth=getattr(queue, "max_depth", None),
+                brownout_stage_fn=(lambda: self.brownout_stage),
+                faults=self._faults)
+        # smoothed per-batch predict service time — the early-drop gate's
+        # "can this record still make its deadline" estimate (None until
+        # the first batch lands: never drop on a guess)
+        self._predict_ewma_s: Optional[float] = None
+        # scheduler-side armor (priority-ordered claim/shed + deadline
+        # early drop) rides the same opt-in as the config blocks, so a
+        # deployment without them keeps the exact pre-PR-17 claim path
+        self._armor = (self.params.admission is not None
+                       or self.params.brownout is not None)
         # on-demand device profiling (PR 15): one jax.profiler trace at a
         # time, written under profile_dir (the manager points it at
         # <pidfile>.profiles)
@@ -963,6 +1008,77 @@ class ClusterServing:
 
     def _heartbeat_age(self) -> float:
         return time.monotonic() - self._hb_ts
+
+    # -- overload armor (PR 17) ----------------------------------------------
+    def _admission_depth(self) -> Optional[int]:
+        """Queue depth for the admission gate's class caps; None (no
+        signal, admit) when the backend is unreachable — a dead backend
+        is the breaker's problem, not a reason to 429."""
+        try:
+            return int(self.queue.depth())
+        except Exception:  # noqa: BLE001 — backend down
+            return None
+
+    @property
+    def brownout_stage(self) -> int:
+        return self._brownout.stage if self._brownout is not None else 0
+
+    def admit_record(self, tenant=None, priority=None):
+        """The gateway's per-request admission consult.  Returns an
+        ``admission.Decision``, or None when no controller is configured
+        (the gateway falls through to the legacy fleet-wide 429)."""
+        if self._admission is None:
+            return None
+        d = self._admission.admit(tenant, priority)
+        if not d.admitted:
+            # rejections belong on the incident timeline next to the
+            # brownout transitions they usually accompany
+            self._event("admission_reject", reason=d.reason,
+                        tenant=d.tenant, priority=d.priority)
+        return d
+
+    def _brownout_tick(self) -> None:
+        """Feed the ladder the current SLO burn rate (throttled to 4 Hz —
+        the ladder's dwell/hold windows are seconds, per-claim sampling
+        would only add gauge reads to the hot loop)."""
+        if self._brownout is None or self._slo is None:
+            return
+        now = time.monotonic()
+        if now < self._brownout_next:
+            return
+        self._brownout_next = now + 0.25
+        try:
+            burn = self._slo.snapshot().get("burn_rate", 0.0)
+        except Exception:  # noqa: BLE001 — ladder input, not load-bearing
+            return
+        self._brownout.observe(burn, now)
+
+    def _note_predict_time(self, seconds: float) -> None:
+        """EWMA of per-batch predict wall time (alpha 0.2) — the early
+        drop gate's service-time estimate."""
+        if seconds <= 0:
+            return
+        prev = self._predict_ewma_s
+        self._predict_ewma_s = seconds if prev is None \
+            else 0.8 * prev + 0.2 * seconds
+
+    def _pressure_level(self) -> int:
+        """Engine-side shed aggressiveness (0/1/2) from the staged-buffer
+        backlog, the queue-depth fraction, and the brownout stage — see
+        ``admission.pressure_level``."""
+        from analytics_zoo_tpu.serving.admission import pressure_level
+        staged = getattr(self, "_staged", None)
+        staged_frac = 0.0
+        if staged is not None:
+            cap = max(1, staged.maxsize or 1)
+            staged_frac = staged.qsize() / cap
+        depth_frac = 0.0
+        max_depth = getattr(self.queue, "max_depth", None)
+        if max_depth:
+            depth = self._admission_depth()
+            if depth is not None:
+                depth_frac = depth / float(max_depth)
+        return pressure_level(staged_frac, depth_frac, self.brownout_stage)
 
     # -- incident flight recorder (PR 15) ------------------------------------
     def _record_event(self, kind: str, **attrs) -> None:
@@ -1392,6 +1508,46 @@ class ClusterServing:
         self._redelivered.pop(rid, None)
         self._ack([rid])
 
+    def _claim_shed(self, rid, rec, to_shed) -> bool:
+        """PR 17 claim gates, armored deployments only.  True when the
+        record left the pipeline: either its priority class is being
+        shed under the current pressure level, or the deadline early
+        drop judged it unmeetable — remaining budget shorter than the
+        estimated wait through the staged backlog at the smoothed
+        per-batch service time (no estimate yet = never drop)."""
+        from analytics_zoo_tpu.serving.admission import (
+            deadline_unmeetable, normalize_priority)
+        if not isinstance(rec, dict):
+            return False
+        trace_id = rec.get("trace_id")
+        if to_shed:
+            prio = normalize_priority(rec.get("priority"))
+            if prio in to_shed:
+                self._shed_terminal(
+                    rid, stage="claim", trace_id=trace_id,
+                    error=f"shed: {prio} class dropped under overload "
+                          f"pressure")
+                return True
+        dl = rec.get("deadline_ns")
+        if dl is not None and self._predict_ewma_s:
+            try:
+                remaining_s = (int(dl) - time.time_ns()) / 1e9
+            except (TypeError, ValueError, OverflowError):
+                return False     # junk deadline: _shed_expired's business
+            backlog = 0
+            for q in (getattr(self, "_staged", None),
+                      getattr(self, "_writeq", None)):
+                if q is not None:
+                    backlog += q.qsize()
+            if deadline_unmeetable(remaining_s, backlog,
+                                   self._predict_ewma_s):
+                self._shed_terminal(
+                    rid, stage="claim", trace_id=trace_id,
+                    error="deadline-unmeetable: estimated queue wait "
+                          "exceeds the remaining budget")
+                return True
+        return False
+
     # -- adaptive micro-batching (PR 3 tentpole) -----------------------------
     def _read_coalesced(self):
         """Coalescing read: pull up to ``max_batch`` records, and once a
@@ -1561,6 +1717,16 @@ class ClusterServing:
         t0 = time.monotonic()
         self._hb_ts = t0      # replica heartbeat: the read loop is alive
         self._apply_pending_knobs()
+        # brownout ladder tick (PR 17): feed the SLO burn rate in, so the
+        # stage the gateway/scheduler consult tracks the live window
+        self._brownout_tick()
+        if self._faults.claim_active:
+            # claim_stall fault (PR 17): a deterministic backlog-builder
+            # for overload chaos — the read loop stalls BEFORE claiming
+            stall = self._faults.take_claim_stall()
+            if stall > 0.0:
+                self._event("claim_stall", state=f"{stall:g}s")
+                self._stop.wait(stall)
         if self._retiring.is_set():
             # decommissioning: claim NOTHING new (no reads, no reclaims) so
             # the pipeline flushes and the drain exit fires; the backlog
@@ -1600,9 +1766,28 @@ class ClusterServing:
                 format=_wire_fmt_label(rec)).inc(nbytes)
             self._span("read", t0, t_read,
                              trace_id=rec["trace_id"], uri=rid)
+        # priority-ordered claim and shed (PR 17): interactive records
+        # stage first; under pressure the lowest classes are shed before
+        # they spend a predict slot, and a record that can no longer make
+        # its deadline through the current backlog is dropped at claim
+        # instead of timing out mid-pipeline.  Opt-in (self._armor) — an
+        # unarmored deployment keeps the exact legacy claim path.
+        if self._armor:
+            from analytics_zoo_tpu.serving.admission import (
+                PRIORITIES, normalize_priority, shed_classes)
+            rank = {p: i for i, p in enumerate(PRIORITIES)}
+            batch = sorted(
+                batch, key=lambda kv: rank[normalize_priority(
+                    kv[1].get("priority")
+                    if isinstance(kv[1], dict) else None)])
+            to_shed = shed_classes(self._pressure_level())
+        else:
+            to_shed = ()
         kept = []
         for rid, rec in batch:
             if self._shed_expired(rid, rec):
+                continue
+            if self._armor and self._claim_shed(rid, rec, to_shed):
                 continue
             kept.append((rid, rec))
 
@@ -1631,9 +1816,17 @@ class ClusterServing:
                 # per-record generation options (PR 12): `gen` rides the
                 # record untyped — the scheduler validates/clamps values
                 meta = rec.get("gen")
+                meta = meta if isinstance(meta, dict) else None
+                if self._armor:
+                    # the brownout clamp (_submit_group) needs the class
+                    # after the record dict is gone: ride it on the meta
+                    from analytics_zoo_tpu.serving.admission import (
+                        normalize_priority)
+                    meta = dict(meta or {})
+                    meta["_priority"] = normalize_priority(
+                        rec.get("priority"))
                 items.append((rid, item, rec.get("deadline_ns"),
-                              rec.get("trace_id"),
-                              meta if isinstance(meta, dict) else None))
+                              rec.get("trace_id"), meta))
             except Exception as e:  # noqa: BLE001 — malformed record
                 self._quarantine(rid, "preprocess", e, record=rec)
         if kept:
@@ -1761,6 +1954,7 @@ class ClusterServing:
             chunks = self._bisect_halves(ids, tensors, scales, e, tmap=tmap)
         t_done = time.monotonic()
         self._stages["predict"].record(t_done - inflight.t_dispatch)
+        self._note_predict_time(t_done - inflight.t_dispatch)
         pairs: List[Tuple[str, Dict]] = []
         for chunk_ids, probs in chunks:
             for rid, row in zip(chunk_ids, probs):
@@ -2164,6 +2358,13 @@ class ClusterServing:
                 mt = None if mt is None else int(mt)
             except (TypeError, ValueError):
                 mt = None
+            if self._brownout is not None:
+                # brownout stage 2 (PR 17): clamp generation length for
+                # non-interactive traffic — lower-only, never a raise
+                clamp = self._brownout.clamp_max_tokens(
+                    meta.get("_priority", "batch"))
+                if clamp is not None:
+                    mt = clamp if mt is None else min(mt, clamp)
             req = GenRequest(rid, np.asarray(tensors[i]),
                              deadline_ns=deadlines[i],
                              trace_id=traces[i], t_read=group.t_read,
@@ -2240,6 +2441,12 @@ class ClusterServing:
                     self._span("prefill", now0 - ev.ttft_s, now0,
                                trace_id=ev.trace_id, uri=ev.rid)
             elif ev.kind == "partial":
+                if self._brownout is not None \
+                        and self._brownout.suppress_partials:
+                    # brownout stage 1 (PR 17): partials are progress
+                    # cosmetics — under SLO burn the write bandwidth
+                    # goes to finals; the terminal result still flows
+                    continue
                 value = {"partial": True, "tokens": ev.tokens,
                          "n": len(ev.tokens)}
                 if ev.trace_id is not None:
@@ -2467,6 +2674,14 @@ class ClusterServing:
             # the health doc so fleet aggregation / FleetSignals can
             # consume them without a separate scrape
             h["slo"] = self._slo.snapshot()
+        if self._admission is not None:
+            # overload armor (PR 17): admitted/rejected tallies the fleet
+            # aggregation sums, and the per-reason split for triage
+            h["admission"] = self._admission.snapshot()
+        if self._brownout is not None:
+            # the ladder stage (fleet-merged as MAX) + transition history
+            # — what incident bundles show for "when did we degrade"
+            h["brownout"] = self._brownout.snapshot()
         if self._faults.any_active:
             # fault injection (PR 16): an armed replica must be visible
             # from the outside — never silently chaotic
@@ -2531,16 +2746,22 @@ class ClusterServing:
         """The `/metrics` JSON document derived from a health() document —
         shared with `manager metrics`, which only has the snapshot file."""
         e2e = h["stages"]["e2e"]
-        return {"served": h["total_records"],
-                "quarantined": h["dead_lettered"],
-                "shed": h["shed"],
-                "restarts": sum(w["restart_count"]
-                                for w in h["workers"].values()),
-                "queue_depth": h["queue"].get("depth", -1),
-                "dead_letters": h["queue"].get("dead_letters", -1),
-                "breaker_trips": h["breaker"]["trip_count"],
-                "stages": h["stages"],
-                "latency_ms": {"p50": e2e["p50_ms"], "p99": e2e["p99_ms"]}}
+        doc = {"served": h["total_records"],
+               "quarantined": h["dead_lettered"],
+               "shed": h["shed"],
+               "restarts": sum(w["restart_count"]
+                               for w in h["workers"].values()),
+               "queue_depth": h["queue"].get("depth", -1),
+               "dead_letters": h["queue"].get("dead_letters", -1),
+               "breaker_trips": h["breaker"]["trip_count"],
+               "stages": h["stages"],
+               "latency_ms": {"p50": e2e["p50_ms"], "p99": e2e["p99_ms"]}}
+        if isinstance(h.get("admission"), dict):
+            doc["admitted"] = h["admission"].get("admitted", 0)
+            doc["rejected"] = h["admission"].get("rejected", 0)
+        if isinstance(h.get("brownout"), dict):
+            doc["brownout_stage"] = h["brownout"].get("stage", 0)
+        return doc
 
     def metrics(self) -> Dict:
         """Flat JSON counters + the per-stage timing breakdown (`/metrics`)

@@ -22,6 +22,14 @@ Config shape (``params.faults`` in config.yaml)::
       readyz_delay:             # /readyz held not-ready after start
         version: v2
         seconds: 10
+      claim_stall:              # read loop stalls before claiming (PR 17
+        version: v2             # overload chaos: a backlog forms without
+        seconds: 0.5            # real saturation)
+        count: 10               # stalls injected before the point disarms
+      admission_reject:         # admission gate rejects the next N
+        version: v2             # requests with reason "fault"
+        count: 5
+        priority: best_effort   # optional: only this class is rejected
 
 Every knob is deterministic: no randomness, no time-of-day dependence —
 the same config and record sequence produce the same failures, so the
@@ -79,7 +87,15 @@ class FaultInjector:
                                    model_version)
         self._readyz_delay = _gate(faults.get("readyz_delay"),
                                    model_version)
+        self._claim_stall = _gate(faults.get("claim_stall"),
+                                  model_version)
+        self._admission_reject = _gate(faults.get("admission_reject"),
+                                       model_version)
         self._predict_calls = 0
+        self._claim_stalls_left = int(
+            (self._claim_stall or {}).get("count", 1))
+        self._admission_rejects_left = int(
+            (self._admission_reject or {}).get("count", 1))
 
     # -- introspection -------------------------------------------------------
     @property
@@ -92,8 +108,17 @@ class FaultInjector:
         return self._readyz_delay is not None
 
     @property
+    def claim_active(self) -> bool:
+        return self._claim_stall is not None
+
+    @property
+    def admission_active(self) -> bool:
+        return self._admission_reject is not None
+
+    @property
     def any_active(self) -> bool:
         return (self.predict_active or self.readyz_active
+                or self.claim_active or self.admission_active
                 or self._warmup_crash is not None)
 
     def describe(self) -> list:
@@ -108,6 +133,10 @@ class FaultInjector:
             out.append("warmup_crash")
         if self._readyz_delay is not None:
             out.append("readyz_delay")
+        if self._claim_stall is not None:
+            out.append("claim_stall")
+        if self._admission_reject is not None:
+            out.append("admission_reject")
         return out
 
     # -- fault points ---------------------------------------------------------
@@ -145,6 +174,30 @@ class FaultInjector:
             logger.error("faults: injected warmup_crash (version %s) — "
                          "exiting", self.model_version)
             os._exit(3)
+
+    def take_claim_stall(self) -> float:
+        """``claim_stall`` (PR 17): seconds the read loop should stall
+        before this claim, 0.0 when disarmed or the ``count`` budget is
+        spent.  The ENGINE sleeps (not this method) so tests can call it
+        without waiting."""
+        if self._claim_stall is None or self._claim_stalls_left <= 0:
+            return 0.0
+        self._claim_stalls_left -= 1
+        return max(0.0, float(self._claim_stall.get("seconds", 0.5)))
+
+    def take_admission_reject(self, priority: Optional[str] = None) -> bool:
+        """``admission_reject`` (PR 17): True when the admission gate
+        must reject THIS request (reason "fault").  An optional
+        ``priority`` selector restricts the fault to one class; the
+        ``count`` budget makes outcomes exact."""
+        spec = self._admission_reject
+        if spec is None or self._admission_rejects_left <= 0:
+            return False
+        want = spec.get("priority")
+        if want and priority is not None and str(want) != str(priority):
+            return False
+        self._admission_rejects_left -= 1
+        return True
 
     def readyz_block_reason(self, uptime_s: float) -> Optional[str]:
         """``readyz_delay``: a not-ready reason until ``seconds`` of

@@ -46,7 +46,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 SHARED_MAX_METRICS = frozenset({"serving_queue_depth",
                                 "serving_dead_letters",
                                 "serving_slo_burn_rate",
-                                "serving_slo_latency_objective_ms"})
+                                "serving_slo_latency_objective_ms",
+                                # PR 17: a ladder STAGE is an ordinal,
+                                # not a quantity — the fleet's brownout
+                                # verdict is its worst replica's
+                                "serving_brownout_stage"})
 
 
 def read_scale(pidfile: str, default: int = 0) -> int:
@@ -133,6 +137,13 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     cold_start: Optional[float] = None   # slowest measured cold start
     slo_burn: Optional[float] = None     # worst replica burn rate (PR 13)
     slo_violations = 0
+    # overload armor (PR 17): admission tallies SUM (each replica's gate
+    # is its own stream of verdicts); the brownout stage is an ordinal —
+    # the fleet is as browned-out as its WORST replica
+    admitted = rejected = 0
+    rejected_by: Dict[str, int] = {}
+    admission_seen = False
+    brownout_stage: Optional[int] = None
     # resource accounting (PR 15): HBM components SUM across replicas
     # (each replica pins its own copy), per-process stats sum with a max
     # alongside RSS so one bloated replica stands out
@@ -183,6 +194,18 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
         wv = slo.get("window_violations")
         if isinstance(wv, int):
             slo_violations += wv
+        adm = doc.get("admission") or {}
+        if isinstance(adm.get("admitted"), int):
+            admission_seen = True
+            admitted += int(adm.get("admitted") or 0)
+            rejected += int(adm.get("rejected") or 0)
+            for reason, n in (adm.get("rejected_by_reason") or {}).items():
+                if isinstance(n, int):
+                    rejected_by[reason] = rejected_by.get(reason, 0) + n
+        bo = doc.get("brownout") or {}
+        if isinstance(bo.get("stage"), int):
+            brownout_stage = bo["stage"] if brownout_stage is None \
+                else max(brownout_stage, bo["stage"])
         r = doc.get("resources") or {}
         if isinstance(r.get("weights_bytes"), (int, float)):
             res_seen = True
@@ -223,6 +246,12 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             # (ROADMAP item 1) will judge overload on
             "slo_burn_rate": slo_burn,
             "slo_window_violations": slo_violations,
+            # overload armor (PR 17): summed gate verdicts + the worst
+            # replica's brownout stage (None = no replica reports them)
+            "admitted": admitted if admission_seen else None,
+            "rejected": rejected if admission_seen else None,
+            "rejected_by_reason": rejected_by if admission_seen else None,
+            "brownout_stage": brownout_stage,
             # resource accounting (PR 15): fleet HBM decomposition +
             # summed per-process resources (None when no replica reports
             # them yet — old snapshots mid-rolling-upgrade)
@@ -295,6 +324,13 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
     if agg.get("slo_burn_rate") is not None:
         out["slo"] = {"burn_rate": agg["slo_burn_rate"],
                       "window_violations": agg["slo_window_violations"]}
+    if agg.get("admitted") is not None:
+        out["admission"] = {
+            "admitted": agg["admitted"],
+            "rejected": agg["rejected"],
+            "rejected_by_reason": agg["rejected_by_reason"]}
+    if agg.get("brownout_stage") is not None:
+        out["brownout_stage"] = agg["brownout_stage"]
     # version mix (PR 16): which model versions the fleet is serving —
     # heterogeneous exactly while a rollout is in flight
     if agg.get("versions"):

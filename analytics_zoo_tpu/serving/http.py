@@ -238,10 +238,20 @@ class HealthServer:
                 try:
                     if parts.path == "/healthz":
                         h = serving.health()
-                        self._reply(200 if h.get("running") else 503, h)
+                        ok = bool(h.get("running"))
+                        # PR 17: every shed/reject/not-ready answer in
+                        # the serving surface carries a Retry-After hint
+                        self._reply(200 if ok else 503, h,
+                                    extra_headers=(
+                                        () if ok
+                                        else (("Retry-After", "1"),)))
                     elif parts.path == "/readyz":
                         r = serving.ready()
-                        self._reply(200 if r.get("ready") else 503, r)
+                        ok = bool(r.get("ready"))
+                        self._reply(200 if ok else 503, r,
+                                    extra_headers=(
+                                        () if ok
+                                        else (("Retry-After", "1"),)))
                     elif parts.path == "/metrics":
                         if self._wants_prom(parts.query):
                             from analytics_zoo_tpu.common.observability \
@@ -467,6 +477,32 @@ class HealthServer:
                                               f"cap {MAX_BODY_BYTES}"})
                         return
                     body = self.rfile.read(length)
+                    # tenant-aware admission (PR 17): identity + priority
+                    # come from the HEADERS — this is the trust edge, the
+                    # same one that owns trace_ctx — and the decision is
+                    # made before any parse/stamp work is spent on a
+                    # record that will be rejected.  Rejections answer
+                    # 429 with a Retry-After COMPUTED from the tenant
+                    # bucket's refill (not a constant), so a compliant
+                    # client converges on its admitted rate.
+                    tenant = (self.headers.get("X-Api-Key")
+                              or self.headers.get("X-Tenant"))
+                    prio_hdr = self.headers.get("X-Priority")
+                    admit_fn = getattr(serving, "admit_record", None)
+                    decision = admit_fn(tenant, prio_hdr) \
+                        if callable(admit_fn) else None
+                    if decision is not None and not decision.admitted:
+                        self._reply(
+                            429,
+                            {"error": "admission rejected "
+                                      f"({decision.reason})",
+                             "reason": decision.reason,
+                             "tenant": decision.tenant,
+                             "priority": decision.priority},
+                            extra_headers=(
+                                ("Retry-After",
+                                 f"{decision.retry_after_s:.3f}"),))
+                        return
                     import math
                     timeout_s = self._query_float(parts.query, "timeout_s")
                     # inf = "no budget": no deadline stamped (int(inf)
@@ -523,12 +559,21 @@ class HealthServer:
                             # ingest timestamp (and through it the SLO
                             # burn the fleet merges as MAX) and
                             # mis-parent every engine span
+                            # the trust edge also owns tenant/priority
+                            # (PR 17): a client-written tenant field in
+                            # the frame would bill another tenant's
+                            # bucket and jump the priority lanes
                             frame, header = \
                                 _wire.restamp_frame_with_header(
                                     body, trace_id=trace_id,
                                     deadline_ns=deadline_ns,
                                     trace_ctx_fn=_mk_ctx,
-                                    overwrite_trace_ctx=True)
+                                    overwrite_trace_ctx=True,
+                                    set_fields=(
+                                        {"tenant": decision.tenant,
+                                         "priority": decision.priority}
+                                        if decision is not None
+                                        else None))
                         except _wire.FrameError as e:
                             self._reply(400, {"error": f"malformed "
                                                        f"frame: {e}"})
@@ -648,6 +693,11 @@ class HealthServer:
                         # sent (a junk ts would skew queue-wait; a forged
                         # parent would mis-thread the timeline)
                         record["trace_ctx"] = _mk_ctx(record)
+                        if decision is not None:
+                            # trust edge for identity (PR 17): the header
+                            # verdict overwrites any body-carried fields
+                            record["tenant"] = decision.tenant
+                            record["priority"] = decision.priority
                         if deadline_ns is not None:
                             record.setdefault("deadline_ns", deadline_ns)
                         uri, deadline_ns = record["uri"], \

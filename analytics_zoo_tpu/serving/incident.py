@@ -213,7 +213,7 @@ def render(bundle: str, last: int = 200) -> Dict:
         }
         for key in ("uri", "trace_id", "error", "rid", "state",
                     "count", "action", "reason", "replica", "index",
-                    "clock_skewed"):
+                    "clock_skewed", "stage", "tenant", "priority"):
             if s.get(key) is not None:
                 entry[key] = s[key]
         if s.get("dur_s"):                 # zero-width marks stay terse

@@ -136,7 +136,8 @@ class LoadBalancer:
                  probe_timeout_s: float = 1.0,
                  tracer: Optional[Tracer] = None,
                  trace_sample: float = 1.0,
-                 span_spool: Optional[str] = None):
+                 span_spool: Optional[str] = None,
+                 retry_budget: Optional[Dict] = None):
         self.member_source = member_source
         self.host = host
         self.port = port                    # actual port after start()
@@ -172,6 +173,26 @@ class LoadBalancer:
             "after a transport failure or 5xx", labels=("endpoint",))
         for ep in ("enqueue", "result"):
             self._m_retries.labels(endpoint=ep).inc(0)
+        # retry budget (PR 17): re-routes are amplification — under a
+        # fleet-wide brownout every member answers 5xx and N members x M
+        # clients of blind retries would triple the offered load exactly
+        # when capacity is scarcest.  The budget caps the retry fraction
+        # per window; a denied retry returns the member's LAST answer
+        # (the truth: everyone is overloaded) instead of hammering on.
+        self._retry_budget = None
+        if retry_budget is not None and (
+                retry_budget.get("enabled", True) if
+                isinstance(retry_budget, dict) else bool(retry_budget)):
+            from analytics_zoo_tpu.common.resilience import RetryBudget
+            cfg = retry_budget if isinstance(retry_budget, dict) else {}
+            self._retry_budget = RetryBudget(
+                ratio=float(cfg.get("ratio", 0.2)),
+                min_retries=int(cfg.get("min_retries", 3)),
+                window_s=float(cfg.get("window_s", 10.0)))
+        self._m_budget_exhausted = reg.counter(
+            "lb_retry_budget_exhausted_total", "Re-routes denied because "
+            "the retry budget was spent")
+        self._m_budget_exhausted.inc(0)
         self._m_latency = reg.histogram(
             "lb_request_seconds", "Front-door request latency, by endpoint",
             labels=("endpoint",))
@@ -235,6 +256,17 @@ class LoadBalancer:
                                         hash((m.url, self._rr)) & 0xffff))
 
     # -- proxying -------------------------------------------------------------
+    def _retry_allowed(self, endpoint: str) -> bool:
+        """One re-route, if the retry budget (PR 17) has room.  Counts the
+        retry when taken, the exhaustion when denied — a denied re-route
+        surfaces the member's last answer instead of amplifying load."""
+        if self._retry_budget is not None \
+                and not self._retry_budget.allow_retry():
+            self._m_budget_exhausted.inc()
+            return False
+        self._m_retries.labels(endpoint=endpoint).inc()
+        return True
+
     @staticmethod
     def _forward(member: _Member, method: str, path_qs: str,
                  body: Optional[bytes], ctype: Optional[str],
@@ -273,6 +305,8 @@ class LoadBalancer:
         tried: set = set()
         last = None
         attempts = 0
+        if self._retry_budget is not None:
+            self._retry_budget.note_request()
         while True:
             member = self._pick(tried)
             if member is None:
@@ -303,9 +337,10 @@ class LoadBalancer:
                     headers=headers)
             except _Transport as e:
                 member.mark(False)
-                self._m_retries.labels(endpoint=endpoint).inc()
                 logger.info("lb: member %s failed (%s); re-routing",
                             member.url, e)
+                if not self._retry_allowed(endpoint):
+                    break
                 continue
             finally:
                 with member.lock:
@@ -316,7 +351,8 @@ class LoadBalancer:
                 last = (status, payload, resp_headers, attempts)
                 if status == 503:
                     member.mark(False)
-                self._m_retries.labels(endpoint=endpoint).inc()
+                if not self._retry_allowed(endpoint):
+                    break
                 continue
             return status, payload, resp_headers, attempts
         if last is not None:
@@ -463,18 +499,24 @@ class LoadBalancer:
                 try:
                     if parts.path == "/healthz":
                         members = lb._snapshot_members()
-                        self._reply_json(200, {
+                        doc = {
                             "running": True,
                             "members": {m.url: {"ready": m.healthy,
                                                 "inflight": m.inflight,
                                                 "fails": m.fails}
-                                        for m in members}})
+                                        for m in members}}
+                        if lb._retry_budget is not None:
+                            doc["retry_budget"] = \
+                                lb._retry_budget.snapshot()
+                        self._reply_json(200, doc)
                     elif parts.path == "/readyz":
                         ready = [m.url for m in lb._snapshot_members()
                                  if m.healthy]
                         self._reply_json(
                             200 if ready else 503,
-                            {"ready": bool(ready), "members": ready})
+                            {"ready": bool(ready), "members": ready},
+                            extra=(() if ready
+                                   else (("Retry-After", "1"),)))
                     elif parts.path == "/metrics":
                         fmt = (parse_qs(parts.query).get("format")
                                or [None])[0]
@@ -567,11 +609,20 @@ class LoadBalancer:
                         ctx = SpanContext(
                             tid, sampled=trace_sampled(
                                 tid, lb.trace_sample))
+                    fwd = [("traceparent", ctx.to_traceparent())]
+                    # tenant identity + priority class (PR 17) ride to the
+                    # gateway trust edge, where admission normalizes and
+                    # stamps them — dropping them here would collapse every
+                    # client into the anonymous default/batch lane
+                    for h in ("X-Api-Key", "X-Tenant", "X-Priority"):
+                        v = self.headers.get(h)
+                        if v:
+                            fwd.append((h, v))
                     result = lb._proxy(
                         "enqueue", "POST", parts.path, parts.query,
                         body, self.headers.get("Content-Type"),
                         deadline=t0 + ENQUEUE_TIMEOUT_S, retry_503=True,
-                        headers=[("traceparent", ctx.to_traceparent())])
+                        headers=fwd)
                     self._passthrough(result, "enqueue", t0)
                     if result[0] == 200:
                         lb._record_root_span(
@@ -652,10 +703,17 @@ def main(argv=None) -> int:
                      "explicitly")
         source = manager_members(args.pidfile, http_host=params.http_host,
                                  http_port=params.http_port)
+    retry_budget = None
+    try:
+        from analytics_zoo_tpu.serving.manager import load_config
+        retry_budget = load_config(args.config).get("retry_budget")
+    except OSError:
+        pass
     lb = LoadBalancer(source, host=args.host, port=args.port,
                       probe_interval_s=args.probe_interval,
                       trace_sample=args.trace_sample,
-                      span_spool=args.span_spool).start()
+                      span_spool=args.span_spool,
+                      retry_budget=retry_budget).start()
     print(json.dumps({"lb": lb.url}), flush=True)
     try:
         while True:

@@ -805,7 +805,8 @@ def _run_supervisor(config_path: str, pidfile: str, replicas: int,
                             http_port=params.http_port),
             host=params.http_host, port=lb_port,
             trace_sample=params.trace_sample,
-            span_spool=spool_path(pidfile + ".lb")).start()
+            span_spool=spool_path(pidfile + ".lb"),
+            retry_budget=cfg.get("retry_budget")).start()
 
     def _spawn(index: int):
         last_spawn[index] = time.monotonic()

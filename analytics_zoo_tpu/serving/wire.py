@@ -302,7 +302,8 @@ def restamp_frame_with_header(
         buf, trace_id: Optional[str] = None,
         deadline_ns: Optional[int] = None,
         trace_ctx_fn=None,
-        overwrite_trace_ctx: bool = False) -> Tuple[bytes, Dict]:
+        overwrite_trace_ctx: bool = False,
+        set_fields: Optional[Dict] = None) -> Tuple[bytes, Dict]:
     """``restamp_frame`` plus the (post-stamp) decoded header, so a caller
     that needs both — the gateway reads back uri/trace_id/deadline for its
     reply — pays ONE header parse instead of re-decoding the result.
@@ -318,6 +319,13 @@ def restamp_frame_with_header(
     and mis-parent every engine span."""
     flags, header, payload = decode_frame(buf)
     changed = False
+    # trust-edge stamps (PR 17): fields the gateway OWNS — tenant
+    # identity and priority class — overwrite whatever the remote frame
+    # carried (a client-supplied tenant would bill someone else's bucket)
+    for k, v in (set_fields or {}).items():
+        if header.get(k) != v:
+            header[k] = v
+            changed = True
     if trace_id is not None and "trace_id" not in header:
         header["trace_id"] = trace_id
         changed = True

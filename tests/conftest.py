@@ -97,6 +97,12 @@ def pytest_configure(config):
         "(PR 16); the acceptance tests fork real manager supervisors, "
         "publish registry versions and wait out canary dwell windows, so "
         "they carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "overload: overload-armor tests (PR 17: tenant admission, "
+        "priority shedding, brownout ladder, retry budget); the "
+        "acceptance test floods a live mixed-priority fleet through the "
+        "gateway, so they carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -114,6 +120,7 @@ TRACING_DEFAULT_TIMEOUT_S = 120.0
 QUANT_DEFAULT_TIMEOUT_S = 120.0
 FORENSICS_DEFAULT_TIMEOUT_S = 300.0
 ROLLOUT_DEFAULT_TIMEOUT_S = 300.0
+OVERLOAD_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -149,6 +156,8 @@ def pytest_runtest_call(item):
             seconds = FORENSICS_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("rollout") is not None:
             seconds = ROLLOUT_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("overload") is not None:
+            seconds = OVERLOAD_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

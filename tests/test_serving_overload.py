@@ -1,0 +1,737 @@
+"""Overload armor (PR 17 tentpole): tenant-aware token-bucket admission,
+priority-ordered claim shedding, deadline-aware early drop, the brownout
+degradation ladder, and the LB retry budget.
+
+The policy layer (admission.py, brownout.py, resilience.RetryBudget) is
+pure and fake-clock injectable, so most of this file is golden tests with
+no engine.  The engine-level tests drive a real ClusterServing over an
+InProcQueue; the acceptance flood (marked `slow`) pushes a mixed-priority
+load through two live gateway replicas and asserts the armor's contract:
+zero interactive drops, best-effort 429s carrying a computed Retry-After.
+"""
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.resilience import (RetryBudget, RetryPolicy)
+from analytics_zoo_tpu.serving.admission import (
+    AdmissionController, TokenBucket, deadline_unmeetable,
+    normalize_priority, normalize_tenant, pressure_level, shed_classes)
+from analytics_zoo_tpu.serving.brownout import BrownoutLadder
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.faults import FaultInjector
+from analytics_zoo_tpu.serving.queues import (FileQueue, InProcQueue,
+                                              QueueClosed, QueueFull)
+
+DIM, NCLS = 3, 4
+
+pytestmark = pytest.mark.overload
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- token bucket goldens ------------------------------------------------------
+
+class TestTokenBucket:
+    def test_burst_then_refill_derived_retry_after(self):
+        b = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+        # the whole burst admits back-to-back
+        assert [b.try_acquire(0.0) for _ in range(4)] == [0.0] * 4
+        # empty: Retry-After is the ACTUAL refill time for one token
+        assert b.try_acquire(0.0) == pytest.approx(0.5)
+        # half a token refilled after 0.25 s -> deficit 0.5 token = 0.25 s
+        assert b.try_acquire(0.25) == pytest.approx(0.25)
+        # after the hinted wait the request goes through
+        assert b.try_acquire(0.5 + 0.25) == 0.0
+
+    def test_refill_caps_at_burst(self):
+        b = TokenBucket(rate=10.0, burst=2.0, now=0.0)
+        assert b.try_acquire(100.0) == 0.0      # long idle != infinite burst
+        assert b.tokens == pytest.approx(1.0)
+
+    def test_clock_never_runs_backwards(self):
+        b = TokenBucket(rate=1.0, burst=1.0, now=10.0)
+        assert b.try_acquire(10.0) == 0.0
+        # a stale timestamp neither refills nor corrupts the refill anchor
+        assert b.try_acquire(5.0) == pytest.approx(1.0)
+        assert b.try_acquire(11.0) == 0.0
+
+
+# -- admission controller ------------------------------------------------------
+
+def _controller(cfg=None, **kw):
+    return AdmissionController(cfg or {}, clock=FakeClock(), **kw)
+
+
+class TestAdmissionController:
+    def test_tenant_isolation(self):
+        """One tenant draining its bucket cannot touch another's."""
+        c = _controller({"rate": 1.0, "burst": 2.0})
+        for _ in range(2):
+            assert c.admit("noisy", "batch", now=0.0).admitted
+        d = c.admit("noisy", "batch", now=0.0)
+        assert not d.admitted and d.reason == "tenant_rate"
+        assert d.retry_after_s == pytest.approx(1.0)
+        # the quiet tenant's lane is untouched
+        assert c.admit("quiet", "batch", now=0.0).admitted
+        snap = c.snapshot()
+        assert snap["admitted"] == 3 and snap["rejected"] == 1
+        assert snap["rejected_by_reason"] == {"tenant_rate": 1}
+
+    def test_priority_lanes_are_separate_buckets(self):
+        """A tenant's bulk lane cannot drain its own interactive lane."""
+        c = _controller({"rate": 1.0, "burst": 1.0})
+        assert c.admit("t", "best_effort", now=0.0).admitted
+        assert not c.admit("t", "best_effort", now=0.0).admitted
+        assert c.admit("t", "interactive", now=0.0).admitted
+
+    def test_per_tenant_overrides(self):
+        c = _controller({"rate": 1.0, "burst": 1.0,
+                         "tenants": {"gold": {"rate": 100.0,
+                                              "burst": 10.0}}})
+        admitted = sum(c.admit("gold", "batch", now=0.0).admitted
+                       for _ in range(10))
+        assert admitted == 10
+        # the unconfigured tenant still rides the default burst of 1
+        verdicts = [c.admit("plain", "batch", now=0.0).admitted
+                    for _ in range(3)]
+        assert verdicts == [True, False, False]
+
+    def test_depth_caps_shed_lowest_class_first(self):
+        """Priority ordering at the door: best-effort stops adding to a
+        backlog at half depth, batch at 0.8, interactive only at the cap."""
+        depth = {"v": 0}
+        c = _controller({"rate": 1e9, "burst": 1e9},
+                        queue_depth_fn=lambda: depth["v"], max_depth=100)
+        for v, expect in [
+                (49, {"best_effort": True, "batch": True,
+                      "interactive": True}),
+                (50, {"best_effort": False, "batch": True,
+                      "interactive": True}),
+                (80, {"best_effort": False, "batch": False,
+                      "interactive": True}),
+                (100, {"best_effort": False, "batch": False,
+                       "interactive": False})]:
+            depth["v"] = v
+            for prio, want in expect.items():
+                d = c.admit("t", prio, now=0.0)
+                assert d.admitted is want, (v, prio, d)
+                if not want:
+                    assert d.reason == "queue_pressure"
+                    assert d.retry_after_s > 0
+
+    def test_brownout_stage3_sheds_best_effort_only(self):
+        c = _controller({"rate": 1e9, "burst": 1e9},
+                        brownout_stage_fn=lambda: 3)
+        d = c.admit("t", "best_effort", now=0.0)
+        assert not d.admitted and d.reason == "brownout"
+        assert d.retry_after_s > 0
+        assert c.admit("t", "batch", now=0.0).admitted
+        assert c.admit("t", "interactive", now=0.0).admitted
+
+    def test_fault_injected_rejects_are_exact(self):
+        inj = FaultInjector({"admission_reject": {
+            "version": "*", "count": 2, "priority": "best_effort"}})
+        c = _controller({"rate": 1e9, "burst": 1e9}, faults=inj)
+        assert c.admit("t", "batch", now=0.0).admitted     # wrong class
+        d = c.admit("t", "best_effort", now=0.0)
+        assert not d.admitted and d.reason == "fault"
+        assert not c.admit("t", "best_effort", now=0.0).admitted
+        # count budget spent: the point disarms deterministically
+        assert c.admit("t", "best_effort", now=0.0).admitted
+
+    def test_disabled_admits_everything(self):
+        c = _controller({"enabled": False, "rate": 1e-9, "burst": 1.0})
+        assert all(c.admit("t", "batch").admitted for _ in range(100))
+
+    def test_tenant_cardinality_bound(self):
+        """A tenant-id spray degrades to the shared `other` lane instead
+        of unbounded bucket state."""
+        c = _controller({"rate": 1e9, "burst": 1e9, "max_tenants": 1})
+        for p in ("interactive", "batch", "best_effort"):
+            assert c.admit("t0", p, now=0.0).admitted
+        for i in range(20):
+            assert c.admit(f"spray-{i}", "batch", now=0.0).admitted
+        # 3 lanes for t0 + 1 shared "other" lane, nothing else
+        assert c.snapshot()["buckets"] == 4
+
+
+# -- normalization + pure shed/drop policy helpers -----------------------------
+
+def test_normalize_priority():
+    assert normalize_priority("interactive") == "interactive"
+    assert normalize_priority("Best-Effort") == "best_effort"
+    assert normalize_priority(" BATCH ") == "batch"
+    # unknown / missing: batch — neither promoted nor silently discarded
+    assert normalize_priority("admin") == "batch"
+    assert normalize_priority(None) == "batch"
+    assert normalize_priority(7) == "batch"
+
+
+def test_normalize_tenant():
+    assert normalize_tenant("team-a_1.x") == "team-a_1.x"
+    assert normalize_tenant(None) == "default"
+    assert normalize_tenant("") == "default"
+    # junk shapes never become metric labels
+    assert normalize_tenant("a b") == "other"
+    assert normalize_tenant("x" * 65) == "other"
+    assert normalize_tenant(42) == "other"
+
+
+def test_pressure_level_and_shed_classes():
+    assert pressure_level(0.0, 0.0, 0) == 0
+    assert pressure_level(1.0, 0.0, 0) == 1     # staged pipeline full
+    assert pressure_level(0.0, 0.5, 0) == 1     # backlog at half depth
+    assert pressure_level(0.0, 0.0, 3) == 1     # deep brownout
+    assert pressure_level(1.0, 0.9, 0) == 2     # both saturated
+    assert pressure_level(0.0, 0.95, 0) == 1    # depth alone never level 2
+    assert shed_classes(0) == ()
+    assert shed_classes(1) == ("best_effort",)
+    assert shed_classes(2) == ("best_effort", "batch")
+
+
+def test_deadline_unmeetable():
+    # no service-time estimate yet: never drop on a guess
+    assert not deadline_unmeetable(0.01, 100, None)
+    assert not deadline_unmeetable(0.01, 100, 0.0)
+    # est = (backlog + 1) * ewma — conservative by the record's own batch
+    assert not deadline_unmeetable(1.0, 3, 0.2)      # 0.8 est < 1.0
+    assert deadline_unmeetable(0.7, 3, 0.2)          # 0.8 est > 0.7
+    assert deadline_unmeetable(0.0, 0, 0.2)          # already expired
+    assert not deadline_unmeetable(0.3, 0, 0.2)      # one batch fits
+
+
+# -- brownout ladder hysteresis ------------------------------------------------
+
+class _FakeRecorder:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **attrs):
+        self.events.append({"event": kind, **attrs})
+
+
+def _ladder(clock, rec=None, **cfg):
+    base = {"dwell_s": 2.0, "hold_s": 10.0}
+    base.update(cfg)
+    return BrownoutLadder(base, clock=clock, recorder=rec)
+
+
+class TestBrownoutLadder:
+    def test_dwell_filters_transient_spikes(self):
+        clk, rec = FakeClock(), _FakeRecorder()
+        lad = _ladder(clk, rec)
+        assert lad.observe(1.5) == 0            # spike starts the dwell timer
+        clk.advance(1.0)
+        assert lad.observe(0.0) == 0            # ...and recovery resets it
+        clk.advance(0.5)
+        assert lad.observe(1.5) == 0
+        clk.advance(1.9)
+        assert lad.observe(1.5) == 0            # still inside dwell
+        clk.advance(0.2)
+        assert lad.observe(1.5) == 1            # sustained: stage 1
+        assert rec.events == [
+            {"event": "brownout", "stage": 1, "action": "enter",
+             "reason": "burn=1.50", "count": 0, "replica": None}]
+
+    def test_climbs_one_rung_per_dwell_window(self):
+        clk = FakeClock()
+        lad = _ladder(clk)
+        stages = []
+        for _ in range(40):                     # burn 10 > every threshold
+            stages.append(lad.observe(10.0))
+            clk.advance(0.5)
+        # gradual degradation: 0 -> 1 -> 2 -> 3, never a jump
+        assert [s for i, s in enumerate(stages) if i == 0
+                or s != stages[i - 1]] == [0, 1, 2, 3]
+        assert lad.shed_best_effort
+
+    def test_exit_needs_hold_and_exit_ratio(self):
+        clk = FakeClock()
+        lad = _ladder(clk, dwell_s=0.0, hold_s=10.0)
+        assert lad.observe(1.5) == 1
+        clk.advance(5.0)
+        # burn recovered but the stage has not been HELD long enough
+        assert lad.observe(0.1) == 1
+        clk.advance(5.0)
+        # held 10 s but burn above exit_ratio * enter[0] = 0.5: stay
+        assert lad.observe(0.6) == 1
+        assert lad.observe(0.5) == 0            # below: descend one rung
+
+    def test_policy_helpers_by_stage(self):
+        clk = FakeClock()
+        lad = _ladder(clk, dwell_s=0.0, batch_max_tokens=16)
+        assert not lad.suppress_partials
+        assert lad.clamp_max_tokens("batch") is None
+        lad.observe(1.5)                        # stage 1
+        assert lad.suppress_partials and not lad.shed_best_effort
+        assert lad.clamp_max_tokens("batch") is None
+        clk.advance(0.1)
+        lad.observe(2.5)                        # stage 2
+        assert lad.clamp_max_tokens("batch") == 16
+        assert lad.clamp_max_tokens("best_effort") == 16
+        # interactive keeps its requested budget at every stage
+        assert lad.clamp_max_tokens("interactive") is None
+
+    def test_snapshot_history(self):
+        clk = FakeClock()
+        lad = _ladder(clk, dwell_s=0.0)
+        lad.observe(1.5)
+        clk.advance(3.0)
+        snap = lad.snapshot()
+        assert snap["stage"] == 1 and snap["burn"] == 1.5
+        assert snap["in_stage_s"] == pytest.approx(3.0)
+        assert snap["transitions"] == [
+            {"from": 0, "to": 1, "burn": 1.5, "age_s": 3.0}]
+
+    def test_disabled_never_climbs(self):
+        lad = BrownoutLadder({"enabled": False, "dwell_s": 0.0},
+                             clock=FakeClock())
+        assert lad.observe(100.0) == 0 and lad.observe(100.0) == 0
+
+
+# -- retry budget --------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_windowed_fraction_cap(self):
+        clk = FakeClock()
+        b = RetryBudget(ratio=0.2, min_retries=1, window_s=10.0, clock=clk)
+        for _ in range(10):
+            b.note_request()
+        # cap = max(1, 0.2 * 10) = 2
+        assert b.allow_retry() and b.allow_retry()
+        assert not b.allow_retry()
+        assert b.exhausted == 1                 # denial is COUNTED
+        # the window slides: old requests AND old retries age out
+        clk.advance(11.0)
+        b.note_request()
+        assert b.allow_retry()                  # min_retries floor
+        snap = b.snapshot()
+        assert snap["requests_in_window"] == 1
+        assert snap["retries_in_window"] == 1
+        assert snap["exhausted"] == 1
+
+    def test_min_retries_floor_on_idle_window(self):
+        b = RetryBudget(ratio=0.2, min_retries=3, window_s=10.0,
+                        clock=FakeClock())
+        # zero requests in window: the floor still allows a trickle
+        assert [b.allow_retry() for _ in range(4)] == [True] * 3 + [False]
+
+    def test_policy_budget_denial_reraises_original_error(self):
+        """A dry budget surfaces the ORIGINAL failure, not RetryExhausted:
+        the caller sees what actually broke, and no retry amplifies the
+        overload."""
+        budget = RetryBudget(ratio=0.0, min_retries=0, clock=FakeClock())
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise ConnectionError("replica gone")
+
+        pol = RetryPolicy(max_retries=5, sleep=lambda s: None, budget=budget)
+        with pytest.raises(ConnectionError, match="replica gone"):
+            pol.call(boom)
+        assert calls["n"] == 1                  # no retry ever ran
+        assert budget.exhausted == 1
+
+    def test_delay_honors_retry_after_hint_capped(self):
+        pol = RetryPolicy(base_delay_s=0.05, max_delay_s=2.0)
+
+        class E(Exception):
+            retry_after_s = 0.75
+
+        assert pol.delay_for(0, E()) == pytest.approx(0.75)
+        # a hostile hint cannot park the caller beyond max_delay_s
+        E.retry_after_s = 60.0
+        assert pol.delay_for(0, E()) == pytest.approx(2.0)
+        # no hint: the policy's own schedule
+        assert pol.delay_for(0, ValueError()) == pytest.approx(0.05)
+
+
+# -- client-side QueueFull retry -----------------------------------------------
+
+class TestClientQueueFullRetry:
+    def test_briefly_full_queue_recovers_without_caller_error(self):
+        """Regression (satellite 2): a briefly-full queue used to surface
+        QueueFull straight to the caller; now the client backs off and
+        retries before giving up."""
+        q = InProcQueue(max_depth=1)
+        q.xadd({"uri": "blocker", "data": [0.0] * DIM})
+        cin = InputQueue(q)
+        slept = []
+
+        def drain_on_sleep(s):
+            slept.append(s)
+            q.read_batch(1, 0.0)                # capacity frees mid-backoff
+
+        cin._full_retry = RetryPolicy(max_retries=4, base_delay_s=0.02,
+                                      max_delay_s=0.5, sleep=drain_on_sleep)
+        rid = cin.enqueue_tensor("r1", np.zeros(DIM, np.float32))
+        assert rid == "r1" and len(slept) == 1
+        assert slept[0] >= 0.02
+
+    def test_persistently_full_queue_raises_queuefull(self):
+        q = InProcQueue(max_depth=1)
+        q.xadd({"uri": "blocker", "data": [0.0] * DIM})
+        cin = InputQueue(q)
+        slept = []
+        cin._full_retry = RetryPolicy(max_retries=2, base_delay_s=0.01,
+                                      sleep=slept.append)
+        with pytest.raises(QueueFull):
+            cin.enqueue_tensor("r1", np.zeros(DIM, np.float32))
+        assert len(slept) == 2                  # retried, THEN gave up
+
+    def test_closed_queue_is_terminal_not_retried(self):
+        """QueueClosed subclasses QueueFull but a drain is not transient:
+        no backoff, straight to the caller."""
+        q = InProcQueue()
+        q.close_admission()
+        cin = InputQueue(q)
+        slept = []
+        cin._full_retry = RetryPolicy(max_retries=4, sleep=slept.append)
+        with pytest.raises(QueueClosed):
+            cin.enqueue_tensor("r1", np.zeros(DIM, np.float32))
+        assert slept == []
+
+
+# -- fleet aggregation ---------------------------------------------------------
+
+def test_fleet_aggregation_sums_gates_and_maxes_stage():
+    from analytics_zoo_tpu.serving.fleet import aggregate_health
+    docs = {
+        0: {"admission": {"admitted": 10, "rejected": 2,
+                          "rejected_by_reason": {"tenant_rate": 2}},
+            "brownout": {"stage": 1}},
+        1: {"admission": {"admitted": 5, "rejected": 3,
+                          "rejected_by_reason": {"tenant_rate": 1,
+                                                 "brownout": 2}},
+            "brownout": {"stage": 3}},
+    }
+    agg = aggregate_health(docs)
+    assert agg["admitted"] == 15 and agg["rejected"] == 5
+    assert agg["rejected_by_reason"] == {"tenant_rate": 3, "brownout": 2}
+    # the fleet is as browned-out as its WORST replica
+    assert agg["brownout_stage"] == 3
+    # replicas that predate the armor report None, not zeros
+    agg2 = aggregate_health({0: {}})
+    assert agg2["admitted"] is None and agg2["brownout_stage"] is None
+
+
+def test_lb_forwards_identity_headers_to_gateway():
+    """Regression: the front door must forward X-Api-Key/X-Tenant/
+    X-Priority to the replica gateway (the trust edge) — dropping them
+    collapsed every client into the anonymous default/batch lane."""
+    import http.server
+
+    seen = {}
+
+    class _Member(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _ok(self, doc=b"{}"):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(doc)))
+            self.end_headers()
+            self.wfile.write(doc)
+
+        def do_GET(self):
+            self._ok()                      # /readyz probe
+
+        def do_POST(self):
+            seen.update({h: self.headers.get(h)
+                         for h in ("X-Api-Key", "X-Tenant", "X-Priority")})
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self._ok(b'{"uri": "x"}')
+
+    from analytics_zoo_tpu.serving.lb import LoadBalancer
+    member = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Member)
+    threading.Thread(target=member.serve_forever, daemon=True).start()
+    lb = LoadBalancer(
+        lambda: [f"http://127.0.0.1:{member.server_address[1]}"],
+        probe_interval_s=0.05)
+    try:
+        lb.start()
+        deadline = time.time() + 10
+        while not any(m.healthy for m in lb._members.values()) \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{lb.port}/v1/enqueue",
+            data=b'{"uri": "x", "data": [0.1]}',
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": "acme", "X-Priority": "interactive"})
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+        assert seen == {"X-Api-Key": None, "X-Tenant": "acme",
+                        "X-Priority": "interactive"}
+    finally:
+        lb.stop()
+        member.shutdown()
+
+
+def test_lb_retry_budget_gates_and_counts():
+    from analytics_zoo_tpu.serving.lb import LoadBalancer
+    lb = LoadBalancer(lambda: [], retry_budget={
+        "ratio": 0.0, "min_retries": 1, "window_s": 10.0})
+    try:
+        assert lb._retry_allowed("enqueue") is True
+        assert lb._retry_allowed("enqueue") is False    # budget dry
+        assert lb._retry_budget.exhausted == 1
+        # exhaustion is observable as a counter, not just a log line
+        assert lb._m_budget_exhausted.value == 1.0
+        # retries-taken counter only counts ALLOWED retries
+        assert lb._m_retries.labels(endpoint="enqueue").value == 1.0
+    finally:
+        lb.stop()
+
+
+# -- engine integration --------------------------------------------------------
+
+def _serving(queue, **params):
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.nn import Sequential
+    from analytics_zoo_tpu.nn.layers import Dense
+
+    model = Sequential()
+    model.add(Dense(NCLS, input_shape=(DIM,), activation="softmax"))
+    model.init_weights()
+    im = InferenceModel().do_load_model(model, model._params, model._state)
+    defaults = dict(batch_size=4, poll_timeout_s=0.02, write_backoff_s=0.01,
+                    worker_backoff_s=0.01)
+    defaults.update(params)
+    return ClusterServing(im, queue, params=ServingParams(**defaults))
+
+
+def _drain(out_q, rids, timeout_s=30.0):
+    got = {}
+    deadline = time.time() + timeout_s
+    while len(got) < len(rids) and time.time() < deadline:
+        for rid in rids:
+            if rid not in got:
+                r = out_q.query(rid)
+                if r is not None:
+                    got[rid] = r
+        time.sleep(0.01)
+    return got
+
+
+def _b64(vec):
+    return base64.b64encode(np.asarray(vec, "<f4").tobytes()).decode()
+
+
+def test_engine_priority_shed_order(ctx):
+    """Under pressure level 1 the engine sheds best-effort AT CLAIM while
+    interactive and batch records still serve."""
+    q = InProcQueue()
+    serving = _serving(q, admission={}, brownout={})
+    serving._pressure_level = lambda: 1         # pin the pressure signal
+    rids = []
+    for i, prio in enumerate(["best_effort", "interactive", "batch",
+                              "best_effort"]):
+        rid = f"p{i}-{prio}"
+        q.xadd({"uri": rid, "b64": _b64([0.1] * DIM), "dtype": "<f4",
+                "shape": [DIM], "priority": prio})
+        rids.append(rid)
+    serving.start()
+    try:
+        got = _drain(OutputQueue(q), rids)
+        assert len(got) == 4
+        for rid in rids:
+            if "best_effort" in rid:
+                assert OutputQueue.is_error(got[rid])
+                assert "shed" in got[rid]["error"]
+            else:
+                assert not OutputQueue.is_error(got[rid]), got[rid]
+        h = serving.health()
+        assert h["shed"] >= 2
+    finally:
+        serving.shutdown()
+
+
+def test_engine_unarmored_never_sheds_by_priority(ctx):
+    """No admission/brownout config = the exact legacy claim path: a
+    best-effort label is inert on unarmored deployments."""
+    q = InProcQueue()
+    serving = _serving(q)
+    serving._pressure_level = lambda: 2
+    q.xadd({"uri": "legacy", "b64": _b64([0.1] * DIM), "dtype": "<f4",
+            "shape": [DIM], "priority": "best_effort"})
+    serving.start()
+    try:
+        got = _drain(OutputQueue(q), ["legacy"])
+        assert not OutputQueue.is_error(got["legacy"])
+    finally:
+        serving.shutdown()
+
+
+def test_engine_deadline_early_drop(ctx):
+    """A record whose remaining budget cannot cover the estimated queue
+    wait is dropped at claim — before preprocessing spends anything on
+    it — while a record with headroom serves."""
+    q = InProcQueue()
+    serving = _serving(q, admission={}, brownout={})
+    serving._predict_ewma_s = 5.0               # smoothed batch cost: 5 s
+    now = time.time_ns()
+    q.xadd({"uri": "doomed", "b64": _b64([0.1] * DIM), "dtype": "<f4",
+            "shape": [DIM], "deadline_ns": now + int(2e9)})
+    q.xadd({"uri": "roomy", "b64": _b64([0.1] * DIM), "dtype": "<f4",
+            "shape": [DIM], "deadline_ns": now + int(600e9)})
+    serving.start()
+    try:
+        got = _drain(OutputQueue(q), ["doomed", "roomy"])
+        assert OutputQueue.is_error(got["doomed"])
+        assert "deadline-unmeetable" in got["doomed"]["error"]
+        assert not OutputQueue.is_error(got["roomy"]), got["roomy"]
+    finally:
+        serving.shutdown()
+
+
+def test_engine_health_and_metrics_blocks(ctx):
+    q = InProcQueue()
+    serving = _serving(q, admission={"rate": 50.0}, brownout={},
+                       serving_slo={"latency_ms": 1000.0, "window_s": 5.0,
+                                    "target": 0.9})
+    serving.start()
+    try:
+        d = serving.admit_record("acme", "interactive")
+        assert d.admitted and d.tenant == "acme"
+        h = serving.health()
+        assert h["admission"]["enabled"] is True
+        assert h["admission"]["admitted"] >= 1
+        assert h["brownout"]["stage"] == 0
+        m = serving.metrics_from_health(h)
+        assert m["brownout_stage"] == 0
+        assert m["admitted"] >= 1 and "rejected" in m
+    finally:
+        serving.shutdown()
+
+
+def test_gateway_admission_429_with_computed_retry_after(ctx):
+    """The trust edge: headers pick the (tenant, priority) lane, the 429's
+    Retry-After is the bucket's refill time — numeric, positive."""
+    q = InProcQueue()
+    serving = _serving(q, http_port=0,
+                       admission={"rate": 0.5, "burst": 1.0})
+    serving.start()
+    try:
+        port = serving._http.port
+        url = f"http://127.0.0.1:{port}/v1/enqueue?timeout_s=15"
+        hdrs = {"Content-Type": "application/json",
+                "X-Tenant": "acme", "X-Priority": "interactive"}
+
+        def post(uri):
+            body = json.dumps({"uri": uri, "b64": _b64([0.1] * DIM),
+                               "dtype": "<f4", "shape": [DIM]}).encode()
+            return urllib.request.urlopen(
+                urllib.request.Request(url, data=body, headers=hdrs))
+
+        assert post("ok-1").status == 200       # the burst token
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("rejected")
+        assert ei.value.code == 429
+        retry_after = float(ei.value.headers["Retry-After"])
+        assert retry_after > 0
+        doc = json.loads(ei.value.read())
+        assert doc["reason"] == "tenant_rate"
+        assert doc["tenant"] == "acme" and doc["priority"] == "interactive"
+        # a different tenant is not collateral damage
+        hdrs["X-Tenant"] = "other-co"
+        assert post("ok-2").status == 200
+        assert q.depth() >= 0                   # rejected record never queued
+        assert serving.health()["admission"]["rejected"] == 1
+    finally:
+        serving.shutdown()
+
+
+# -- acceptance: mixed-priority flood through live gateways --------------------
+
+@pytest.mark.slow
+def test_overload_flood_protects_interactive(tmp_path, ctx):
+    """ISSUE acceptance: two armored replicas behind their gateways take a
+    mixed-priority flood well past capacity.  Every interactive request
+    completes (zero drops), best-effort 429s carry a Retry-After, and the
+    admission verdicts land in health()."""
+    q = FileQueue(str(tmp_path / "q"), max_depth=40)
+    engines = []
+    for i in range(2):
+        s = _serving(q, http_port=0, gateway=True,
+                     max_batch=4, max_wait_ms=20.0,
+                     replica_id=f"ov-{i}", lease_s=60,
+                     reclaim_interval_s=30,
+                     faults={"predict_slow": {"version": "*", "ms": 60}},
+                     admission={"rate": 10000.0, "burst": 10000.0,
+                                "depth_fractions": {"best_effort": 0.3,
+                                                    "batch": 0.6,
+                                                    "interactive": 1.0}},
+                     brownout={"dwell_s": 0.3, "hold_s": 1.5},
+                     serving_slo={"latency_ms": 250.0, "window_s": 5.0,
+                                  "target": 0.9})
+        s.start()
+        engines.append(s)
+    ports = [s._http.port for s in engines]
+    results = {"interactive": [], "best_effort": []}
+    lock = threading.Lock()
+
+    def post(i, prio):
+        uri = f"{prio}-{i}"
+        body = json.dumps({"uri": uri, "b64": _b64([0.1] * DIM),
+                           "dtype": "<f4", "shape": [DIM]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[i % 2]}/v1/enqueue?timeout_s=60",
+            data=body, headers={"Content-Type": "application/json",
+                                "X-Tenant": f"t-{prio}",
+                                "X-Priority": prio})
+        try:
+            resp = urllib.request.urlopen(req, timeout=30)
+            out = (uri, resp.status, None)
+        except urllib.error.HTTPError as e:
+            out = (uri, e.code, e.headers.get("Retry-After"))
+        with lock:
+            results[prio].append(out)
+
+    threads = []
+    for i in range(120):                        # ~3x the two-replica rate
+        prio = "interactive" if i % 3 == 0 else "best_effort"
+        t = threading.Thread(target=post, args=(i, prio), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.005)
+    for t in threads:
+        t.join(30)
+    # every interactive request was ADMITTED...
+    assert all(code == 200 for _, code, _ in results["interactive"]), \
+        [r for r in results["interactive"] if r[1] != 200]
+    # ...and every admitted interactive record completes with a value
+    out_q = OutputQueue(q)
+    rids = [uri for uri, _, _ in results["interactive"]]
+    got = _drain(out_q, rids, timeout_s=60.0)
+    assert len(got) == len(rids), f"missing {sorted(set(rids) - set(got))}"
+    dropped = [r for r in rids if OutputQueue.is_error(got[r])]
+    assert dropped == [], got[dropped[0]] if dropped else None
+    # best-effort paid for it: 429s present, each with a Retry-After hint
+    rejected = [r for r in results["best_effort"] if r[1] == 429]
+    assert rejected, "flood never tripped the armor"
+    assert all(ra is not None and float(ra) > 0 for _, _, ra in rejected)
+    health = [s.health() for s in engines]
+    assert sum(h["admission"]["rejected"] for h in health) >= len(rejected)
+    for s in engines:
+        s.shutdown(drain_s=1.0)

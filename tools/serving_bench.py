@@ -52,11 +52,21 @@ Run: python tools/serving_bench.py [--n 2048] [--batch 64] [--image 224]
          # prevents), time_to_rollback_s, and records_dropped (ASSERTED
          # zero on both arms — faults error records, they never lose
          # them)
+     python tools/serving_bench.py --overload --json overload.json
+         # PR 17 overload-armor chaos A/B: a predict_slow-faulted
+         # 2-gateway fleet flooded at 3x its faulted capacity with mixed
+         # interactive/batch/best_effort traffic, armor off (naked FIFO)
+         # vs armor on (tenant admission + priority shedding + brownout
+         # ladder + deadline early-drop).  ASSERTS zero interactive
+         # drops with armor on, a strictly better interactive p99 than
+         # the naked arm, and >= 1 brownout ladder transition in the
+         # flight recorder
 """
 
 from __future__ import annotations
 
 import argparse
+import base64
 import json
 import os
 import sys
@@ -1161,6 +1171,301 @@ def _run_swing(args):
     return doc
 
 
+# -- overload-armor chaos A/B (PR 17) -----------------------------------------
+
+# (priority class, tenant header, offered load as a fraction of fleet
+# capacity, per-record e2e budget seconds).  Totals 3x capacity: the
+# regime where an unprotected fleet's FIFO queue drowns the interactive
+# class behind bulk traffic.
+_OVERLOAD_CLASSES = (
+    ("interactive", "tenant-int", 0.5, 30.0),
+    ("batch", "tenant-batch", 1.0, 20.0),
+    ("best_effort", "tenant-bulk", 1.5, 8.0),
+)
+
+
+def _overload_post(port, uri, b64, cls, tenant, timeout_s):
+    """One gateway enqueue.  Returns (status, retry_after_header)."""
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/enqueue?timeout_s={timeout_s:g}",
+        data=json.dumps({"uri": uri, "b64": b64, "dtype": "<f4",
+                         "shape": [3]}).encode(),
+        method="POST")
+    req.add_header("Content-Type", "application/json")
+    req.add_header("X-Tenant", tenant)
+    req.add_header("X-Priority", cls)
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            resp.read()
+            return resp.status, None
+    except urllib.error.HTTPError as e:
+        try:
+            e.read()
+        except OSError:
+            pass
+        return e.code, e.headers.get("Retry-After")
+    except Exception:  # noqa: BLE001 — transport failure counts as a drop
+        return -1, None
+
+
+def _run_overload_arm(args, armor):
+    """One overload arm: a 2-gateway-engine fleet over a bounded
+    FileQueue, every replica carrying a ``predict_slow`` fault (the
+    chaos: the fleet is SLOWER than provisioned), flooded at 3x its
+    faulted capacity with the mixed-priority traffic above.  Armor on
+    wires admission + brownout; armor off is the same fleet naked.
+    Returns the per-class outcome document."""
+    from analytics_zoo_tpu.common.observability import get_recorder
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    get_recorder().drain_events()           # isolate this arm's events
+    qdir = tempfile.mkdtemp(prefix="serving_overload_")
+    queue = FileQueue(qdir, max_depth=args.overload_max_depth)
+    faults = {"predict_slow": {"version": "*",
+                               "ms": args.overload_fault_ms}}
+    admission = brownout = None
+    if armor:
+        admission = {
+            # generous rate: this A/B's rejections must come from QUEUE
+            # pressure and the brownout ladder, not per-tenant throttles
+            "rate": 10000.0, "burst": 10000.0,
+            "depth_fractions": {"best_effort": 0.25, "batch": 0.4,
+                                "interactive": 1.0}}
+        brownout = {"dwell_s": 0.3, "hold_s": 1.5}
+    engines = []
+    for i in range(2):
+        # one model PER engine: the predict_slow wrap is instance-patched
+        # onto the model, so a shared one would stack both replicas' sleeps
+        im = _swing_model(args.overload_batch)
+        b = 1
+        while b <= args.overload_batch:
+            im.do_predict(np.zeros((b, 3), np.float32))
+            b *= 2
+        engines.append(ClusterServing(im, queue, params=ServingParams(
+            batch_size=args.overload_batch,
+            max_batch=args.overload_batch,
+            poll_timeout_s=0.02, max_wait_ms=50.0, worker_backoff_s=0.01,
+            pipeline_depth=1,
+            replica_id=f"ov-{'on' if armor else 'off'}-{i}",
+            lease_s=60.0, reclaim_interval_s=30.0, trim_interval_s=3600.0,
+            http_port=0, gateway=True,
+            serving_slo={"latency_ms": args.overload_slo_ms,
+                         "window_s": 5.0, "target": 0.9},
+            faults=faults, admission=admission,
+            brownout=brownout)).start())
+    ports = [e._http.port for e in engines]
+
+    capacity_rps = (len(engines) * args.overload_batch
+                    / max(args.overload_fault_ms / 1000.0, 1e-3))
+    g = np.random.default_rng(0)
+    b64 = base64.b64encode(
+        np.ascontiguousarray(g.random(3, np.float32).astype("<f4"))
+    ).decode("ascii")
+
+    lock = threading.Lock()
+    per = {cls: {"sent": 0, "accepted": 0, "rejected_429": 0,
+                 "http_other": 0, "transport_err": 0,
+                 "retry_after_seen": 0, "retry_after_max": 0.0,
+                 "enq_ts": {}, "arrived": {}, "errors": {}}
+           for cls, _, _, _ in _OVERLOAD_CLASSES}
+
+    def driver(cls, tenant, frac, budget_s):
+        rps = max(capacity_rps * frac, 0.1)
+        period = 1.0 / rps
+        d = per[cls]
+        i = 0
+        t_end = time.monotonic() + args.overload_phase_s
+        next_t = time.monotonic()
+        while time.monotonic() < t_end:
+            uri = f"{cls}-{i}"
+            status, retry_after = _overload_post(
+                ports[i % len(ports)], uri, b64, cls, tenant, budget_s)
+            now = time.monotonic()
+            with lock:
+                d["sent"] += 1
+                if status == 200:
+                    d["accepted"] += 1
+                    d["enq_ts"][uri] = now
+                elif status == 429:
+                    d["rejected_429"] += 1
+                elif status == -1:
+                    d["transport_err"] += 1
+                else:
+                    d["http_other"] += 1
+                if retry_after is not None:
+                    d["retry_after_seen"] += 1
+                    try:
+                        d["retry_after_max"] = max(d["retry_after_max"],
+                                                   float(retry_after))
+                    except ValueError:
+                        pass
+            i += 1
+            next_t += period
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    def poller():
+        from analytics_zoo_tpu.serving.client import OutputQueue
+        while not poll_stop.is_set():
+            for cls in per:
+                d = per[cls]
+                with lock:
+                    outstanding = [u for u in d["enq_ts"]
+                                   if u not in d["arrived"]
+                                   and u not in d["errors"]]
+                for at in range(0, len(outstanding), 512):
+                    chunk = outstanding[at:at + 512]
+                    try:
+                        res = queue.get_results(chunk)
+                    except Exception:  # noqa: BLE001 — transient FS race
+                        continue
+                    now = time.monotonic()
+                    with lock:
+                        for u, r in res.items():
+                            if r is None:
+                                continue
+                            if OutputQueue.is_error(r):
+                                d["errors"][u] = str(r.get("error"))
+                            else:
+                                d["arrived"][u] = now - d["enq_ts"][u]
+            poll_stop.wait(0.05)
+
+    poll_stop = threading.Event()
+    drivers = [threading.Thread(target=driver, args=spec, daemon=True,
+                                name=f"overload-{spec[0]}")
+               for spec in _OVERLOAD_CLASSES]
+    pol = threading.Thread(target=poller, name="overload-poller",
+                           daemon=True)
+    for t in drivers:
+        t.start()
+    pol.start()
+    for t in drivers:
+        t.join()
+    # drain: every ACCEPTED record must resolve (result or error) —
+    # deadline stamps guarantee forward progress; stragglers count as drops
+    drain_deadline = time.monotonic() + args.drain_timeout_s
+    while time.monotonic() < drain_deadline:
+        with lock:
+            if all(len(d["arrived"]) + len(d["errors"])
+                   >= len(d["enq_ts"]) for d in per.values()):
+                break
+        time.sleep(0.2)
+    poll_stop.set()
+    pol.join(timeout=10)
+
+    health = [e.health() for e in engines]
+    for e in engines:
+        e.shutdown(drain_s=1.0)
+    events = get_recorder().drain_events()
+    transitions = [e for e in events if e.get("event") == "brownout"]
+    shed_events = [e for e in events
+                   if e.get("event") == "admission_reject"]
+
+    def pct(lat, q):
+        if not lat:
+            return None
+        lat = sorted(lat)
+        return round(lat[min(len(lat) - 1, int(q / 100 * len(lat)))]
+                     * 1e3, 1)
+
+    classes = {}
+    for cls, _, frac, budget_s in _OVERLOAD_CLASSES:
+        d = per[cls]
+        unresolved = len(d["enq_ts"]) - len(d["arrived"]) - len(d["errors"])
+        lat = list(d["arrived"].values())
+        classes[cls] = {
+            "offered_rps": round(capacity_rps * frac, 1),
+            "budget_s": budget_s,
+            "sent": d["sent"],
+            "accepted": d["accepted"],
+            "rejected_429": d["rejected_429"],
+            "http_other": d["http_other"],
+            "transport_err": d["transport_err"],
+            "served": len(lat),
+            "error_results": len(d["errors"]),
+            "unresolved": max(0, unresolved),
+            # a drop is anything that was offered and did not produce a
+            # real result: HTTP rejection, transport failure, error
+            # result (shed/deadline/quarantine), or never resolving
+            "drops": (d["rejected_429"] + d["http_other"]
+                      + d["transport_err"] + len(d["errors"])
+                      + max(0, unresolved)),
+            "retry_after_seen": d["retry_after_seen"],
+            "retry_after_max_s": round(d["retry_after_max"], 3),
+            "p50_ms": pct(lat, 50),
+            "p99_ms": pct(lat, 99),
+        }
+    admission_doc = None
+    brownout_doc = None
+    if armor:
+        admission_doc = {
+            "admitted": sum(h.get("admission", {}).get("admitted", 0)
+                            for h in health),
+            "rejected": sum(h.get("admission", {}).get("rejected", 0)
+                            for h in health),
+            "rejected_by_reason": {}}
+        for h in health:
+            for reason, n in (h.get("admission", {})
+                              .get("rejected_by_reason") or {}).items():
+                admission_doc["rejected_by_reason"][reason] = \
+                    admission_doc["rejected_by_reason"].get(reason, 0) + n
+        brownout_doc = {
+            "max_stage": max(h.get("brownout", {}).get("stage", 0)
+                             for h in health),
+            "transitions": len(transitions)}
+    return {
+        "armor": bool(armor),
+        "capacity_rps": round(capacity_rps, 1),
+        "classes": classes,
+        "admission": admission_doc,
+        "brownout": brownout_doc,
+        "brownout_events": len(transitions),
+        "claim_shed_events": len(shed_events),
+    }
+
+
+def _run_overload(args):
+    """The PR 17 acceptance A/B: the same 3x-capacity mixed-priority flood
+    against a ``predict_slow``-faulted fleet, armor off then armor on.
+    Asserts the armor contract: zero interactive drops with armor on, a
+    strictly better interactive p99 than the naked fleet, and at least
+    one brownout ladder transition in the flight recorder."""
+    off = _run_overload_arm(args, armor=False)
+    on = _run_overload_arm(args, armor=True)
+    p99_on = on["classes"]["interactive"]["p99_ms"]
+    p99_off = off["classes"]["interactive"]["p99_ms"]
+    doc = {
+        "profile": "overload",
+        "capacity_rps": on["capacity_rps"],
+        "offered_x_capacity": sum(f for _, _, f, _ in _OVERLOAD_CLASSES),
+        "fault_ms": args.overload_fault_ms,
+        "phase_s": args.overload_phase_s,
+        "armor_off": off,
+        "armor_on": on,
+        "interactive_p99_on_ms": p99_on,
+        "interactive_p99_off_ms": p99_off,
+        "interactive_drops_on": on["classes"]["interactive"]["drops"],
+        "interactive_drops_off": off["classes"]["interactive"]["drops"],
+        "best_effort_429s_on":
+            on["classes"]["best_effort"]["rejected_429"],
+        "brownout_transitions": on["brownout_events"],
+    }
+    assert doc["interactive_drops_on"] == 0, (
+        f"armor on dropped {doc['interactive_drops_on']} interactive "
+        f"records: {on['classes']['interactive']}")
+    assert p99_on is not None and p99_off is not None \
+        and p99_on < p99_off, (
+        f"armor did not improve interactive p99: on={p99_on}ms "
+        f"off={p99_off}ms")
+    assert doc["brownout_transitions"] >= 1, (
+        "no brownout ladder transition reached the flight recorder")
+    return doc
+
+
 def _run_rollout(args):
     """PR 16 zero-drop rollout chaos A/B over REAL manager deployments.
 
@@ -1693,6 +1998,30 @@ def main(argv=None):
                          "fleet-wide error stream is the damage rollback "
                          "prevents).  records_dropped is asserted 0 on "
                          "both arms")
+    ap.add_argument("--overload", action="store_true",
+                    help="PR 17 overload-armor chaos A/B: flood a "
+                         "predict_slow-faulted 2-gateway fleet at 3x its "
+                         "faulted capacity with mixed-priority traffic, "
+                         "armor off vs on; asserts zero interactive drops "
+                         "armor-on, a better interactive p99 than the "
+                         "naked arm, and >= 1 brownout transition in the "
+                         "flight recorder")
+    ap.add_argument("--overload-batch", type=int, default=4,
+                    help="overload A/B: engine max_batch (sets the "
+                         "faulted fleet capacity together with "
+                         "--overload-fault-ms)")
+    ap.add_argument("--overload-fault-ms", type=float, default=200.0,
+                    help="overload A/B: injected predict_slow sleep per "
+                         "batch — the chaos that makes the fleet slower "
+                         "than provisioned")
+    ap.add_argument("--overload-phase-s", type=float, default=8.0,
+                    help="overload A/B: flood duration per arm")
+    ap.add_argument("--overload-max-depth", type=int, default=300,
+                    help="overload A/B: queue admission cap (depth "
+                         "fractions gate each priority class against it)")
+    ap.add_argument("--overload-slo-ms", type=float, default=500.0,
+                    help="overload A/B: latency objective driving the "
+                         "brownout ladder's burn-rate signal")
     ap.add_argument("--rollout-rps", type=float, default=5.0,
                     help="client offered load during the rollout A/B")
     ap.add_argument("--rollout-damage-s", type=float, default=5.0,
@@ -1746,6 +2075,23 @@ def main(argv=None):
             args.gen_laps = 1
         out = _run_generate(args)
         print(json.dumps(out))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
+    if args.overload:
+        # the overload-armor chaos A/B is self-contained: tiny fixed
+        # model, FileQueue fleet, fault-injected service time
+        out = _run_overload(args)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("armor_off", "armor_on")}))
         if args.json_path:
             doc = {"bench": "serving_bench", "ts": time.time(),
                    "config": {k: v for k, v in vars(args).items()
