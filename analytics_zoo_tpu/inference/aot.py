@@ -279,16 +279,22 @@ class GenWarmupEntry(NamedTuple):
     (prefill-bucket x decode-step) set a warm replica must hold to serve
     its first token with zero compiles."""
 
-    kind: str                        # prefill | decode_step | insert
+    kind: str                        # prefill | decode_step | insert |
+    #                                  paged_prefill | paged_shared |
+    #                                  paged_decode
     prefill_bucket: Optional[int]    # prompt padding bucket (prefill only)
     lane_bucket: int                 # decode lane capacity bucket
     prefill_batch: Optional[int] = None   # admission batch bucket (pow-2)
+    prefix_blocks: Optional[int] = None   # prefix-table bucket
+    #                                       (paged_shared only)
 
 
 def generation_manifest(prefill_buckets: Sequence[int],
                         lane_buckets: Sequence[int],
                         prefill_batches: Sequence[int] = (1,),
-                        cache_model: bool = True
+                        cache_model: bool = True,
+                        paged: bool = False,
+                        prefix_blocks: Sequence[int] = ()
                         ) -> List[GenWarmupEntry]:
     """Enumerate the continuous-batching program set: for every decode
     lane, its step program, plus — per admission-batch bucket — one
@@ -299,9 +305,27 @@ def generation_manifest(prefill_buckets: Sequence[int],
     buckets that fit the lane (prefill allocates the KV cache at lane
     capacity, so bigger prompts can never run there); bare-state models
     (lane capacity is not a prompt bound — the scheduler pads any
-    admissible prompt to any bucket of the ladder) keep them all."""
+    admissible prompt to any bucket of the ladder) keep them all.
+
+    ``paged=True`` (PR 18) swaps the set for the paged-pool programs:
+    one ``paged_decode`` per lane, one ``paged_prefill`` (prompt forward
+    + block commit, no separate insert) per (batch, prompt bucket), and
+    — when prefix sharing is on (``prefix_blocks`` non-empty) — one
+    ``paged_shared`` per (batch, suffix bucket, prefix-table bucket)."""
     entries: List[GenWarmupEntry] = []
     for lane in sorted({int(b) for b in lane_buckets}):
+        if paged:
+            entries.append(GenWarmupEntry("paged_decode", None, lane))
+            for bb in sorted({int(b) for b in prefill_batches}):
+                for pb in sorted({int(b) for b in prefill_buckets}):
+                    if pb > lane and cache_model:
+                        continue
+                    entries.append(GenWarmupEntry(
+                        "paged_prefill", pb, lane, bb))
+                    for npb in sorted({int(b) for b in prefix_blocks}):
+                        entries.append(GenWarmupEntry(
+                            "paged_shared", pb, lane, bb, npb))
+            continue
         entries.append(GenWarmupEntry("decode_step", None, lane))
         for bb in sorted({int(b) for b in prefill_batches}):
             entries.append(GenWarmupEntry("insert", None, lane, bb))

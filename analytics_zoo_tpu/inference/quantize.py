@@ -207,6 +207,35 @@ def _quantize_w4(W: np.ndarray, group_size: int) -> dict:
             "s_g": jnp.asarray(s_rows, jnp.float32)}
 
 
+# -- int8 KV-cache packing (PR 18 paged KV) -----------------------------------
+# The ONE pack/unpack contract shared by the paged-attention kernels
+# (ops/paged_attention.py), the decode append path
+# (models/textmodels.TransformerLM.decode_paged) and the prefill commit
+# program (serving/generate.py): symmetric int8 with one scale per
+# (block, head) — same recipe as `_quantize_w8` (scale = absmax/127,
+# round-clip to [-127, 127]) but jnp-traceable, because the quantize
+# happens INSIDE the compiled decode/commit programs as tokens append.
+
+def kv_pack_int8(x):
+    """Quantize KV block(s) ``x`` (..., block_len, heads, head_dim) f32 ->
+    ``(q int8 same shape, scale f32 (..., heads))``.  The scale is the
+    per-(block, head) absmax over the (block_len, head_dim) axes — padded
+    /unwritten positions must arrive ZEROED so they cannot inflate it
+    (zeros quantize to zero exactly at any scale)."""
+    x = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=(-3, -1))
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x / scale[..., None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def kv_unpack_int8(q, scale):
+    """Inverse of :func:`kv_pack_int8`: int8 blocks + per-(block, head)
+    scales -> f32 values (exact for zeros; |err| <= scale/2 elsewhere)."""
+    return jnp.asarray(q, jnp.float32) * jnp.asarray(
+        scale, jnp.float32)[..., None, :, None]
+
+
 def quantize_params(model, params, absmax: Dict[str, float], bits: int = 8,
                     group_size: int = 64):
     """Return a new params pytree with quantizable layers' weights replaced

@@ -79,6 +79,18 @@ class ResourceLedger:
         except Exception:  # noqa: BLE001 — mid-construction race
             return 0
 
+    def kv_state_doc(self) -> Optional[Dict]:
+        """The kv_state decomposition (PR 18): ``{lanes, paged_pool,
+        scales, aux, total}`` from the scheduler, or None for a batcher
+        without the breakdown (or no batcher at all)."""
+        fn = getattr(self.batcher, "state_bytes_doc", None)
+        if not callable(fn):
+            return None
+        try:
+            return dict(fn())
+        except Exception:  # noqa: BLE001 — mid-construction race
+            return None
+
     def executables(self) -> Dict:
         stats = {"count": 0, "code_bytes": None, "programs": {}}
         aot_stats = getattr(self.model, "aot_stats", None)
@@ -126,6 +138,9 @@ class ResourceLedger:
             "executables": exes,
             "total_bytes": w + kv + (code or 0),
         }
+        kvd = self.kv_state_doc()
+        if kvd is not None:
+            out["kv_state"] = kvd
         # cached per epoch like weights: quantized_bits flattens the
         # whole params tree, and this runs on every /healthz scrape
         epoch = getattr(self.model, "_aot_epoch", None)

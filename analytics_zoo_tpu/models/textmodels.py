@@ -26,7 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.estimator.estimator import Estimator
+from analytics_zoo_tpu.inference.quantize import kv_pack_int8
 from analytics_zoo_tpu.nn.layers.core import Dense, Dropout, Embedding
+from analytics_zoo_tpu.ops.paged_attention import paged_attention
 from analytics_zoo_tpu.nn.layers.crf import CRF
 from analytics_zoo_tpu.nn.layers.recurrent import LSTM, Bidirectional
 from analytics_zoo_tpu.nn.module import Layer
@@ -151,7 +153,27 @@ class TransformerLM(Layer):
       token's K/V at each row's own cursor (``state["pos"]``), attend over
       the cache positions written so far, advance the cursor.  Every state
       leaf keeps a leading batch (slot) axis for ``.at[slot].set``
-      insertion."""
+      insertion.
+
+    Paged-cache paths (PR 18): KV lives in a fixed block POOL instead of
+    per-row monolithic caches; each row carries a block table.
+
+    - ``init_paged_pools`` — allocate the zeroed pool pytree (int8 pools
+      carry per-(block, head) scale planes and per-slot f32 staging
+      buffers for the active block).
+    - ``prefill_kv`` — the prompt forward WITHOUT cache allocation:
+      raw per-layer K/V for the scheduler's commit program to scatter
+      into pool blocks.  ``init_decode`` shares the same core, so the
+      paged and monolithic prefills are bitwise-identical.
+    - ``prefill_shared`` — suffix-only prefill for prefix-cache hits:
+      the shared prefix contributes K/V (gathered from the pool by the
+      caller), only the suffix runs through the stack — the prefill-work
+      saving prefix sharing is for.
+    - ``decode_paged`` — one token per row against the pool via
+      ``ops/paged_attention``: append the token's K/V through the block
+      table (int8 mode re-quantizes the row's ACTIVE block from its f32
+      staging copy each step, so values are quantized once from exact
+      inputs — no requantization drift), then attend."""
 
     def __init__(self, vocab_size: int, hidden: int = 64, n_head: int = 4,
                  n_layers: int = 2, max_len: int = 512,
@@ -244,23 +266,16 @@ class TransformerLM(Layer):
         return self._logits(params, self._ln(params["ln_f"], x))
 
     # -- step-wise decode (PR 12) ---------------------------------------------
-    def init_decode(self, params, prompt, lengths=None,
-                    cache_len: Optional[int] = None):
-        """Prefill: run the prompt through the stack once, parking K/V in
-        ``cache_len``-capacity caches.  Padded positions (>= the row's
-        length) are masked out of attention and overwritten later by
-        generated tokens — the cache layout stays gap-free because the
-        cursor starts AT the row's length."""
+    def _prefill_core(self, params, prompt, lengths):
+        """Shared prompt forward: the exact math ``init_decode`` has always
+        run, factored out so the paged prefill (PR 18) reuses it and stays
+        BITWISE-identical to the monolithic path.  Returns ``(ks, vs,
+        logits0, lengths)`` with ``ks``/``vs`` per-layer (B, P, nh, hd)."""
         prompt = jnp.asarray(prompt)
         if prompt.ndim == 3 and prompt.shape[-1] == 1:
             prompt = prompt[..., 0]
         prompt = prompt.astype(jnp.int32)
         B, P = prompt.shape
-        C = int(cache_len) if cache_len is not None else int(P)
-        if C < P:
-            raise ValueError(f"cache_len={C} < prompt bucket {P}")
-        if C > self.max_len:
-            raise ValueError(f"cache_len={C} > max_len={self.max_len}")
         lengths = (jnp.full((B,), P, jnp.int32) if lengths is None
                    else jnp.asarray(lengths, jnp.int32))
         nh, hd = self.n_head, self.hidden // self.n_head
@@ -269,8 +284,7 @@ class TransformerLM(Layer):
         # causal within the prompt AND key < row length (padding masked)
         mask = (pos_idx[None, :, None] >= pos_idx[None, None, :]) \
             & (pos_idx[None, None, :] < lengths[:, None, None])  # (B,P,P)
-        state = {"pos": lengths,
-                 "k": [], "v": []}
+        ks, vs = [], []
         for blk in params["blocks"]:
             h = self._ln(blk["ln1"], x)
             q, k, v = jnp.split(self._lin(blk["qkv"], h), 3, axis=-1)
@@ -284,15 +298,48 @@ class TransformerLM(Layer):
             h2 = self._ln(blk["ln2"], x)
             x = x + self._lin(blk["fc2"],
                               jax.nn.gelu(self._lin(blk["fc1"], h2)))
-            kc = jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(k)
-            vc = jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(v)
-            state["k"].append(kc)
-            state["v"].append(vc)
+            ks.append(k)
+            vs.append(v)
         h = self._ln(params["ln_f"], x)
         # each row's next-token logits live at its LAST REAL position
         last = jnp.take_along_axis(
             h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
-        return state, self._logits(params, last)
+        return ks, vs, self._logits(params, last), lengths
+
+    def init_decode(self, params, prompt, lengths=None,
+                    cache_len: Optional[int] = None):
+        """Prefill: run the prompt through the stack once, parking K/V in
+        ``cache_len``-capacity caches.  Padded positions (>= the row's
+        length) are masked out of attention and overwritten later by
+        generated tokens — the cache layout stays gap-free because the
+        cursor starts AT the row's length."""
+        prompt = jnp.asarray(prompt)
+        if prompt.ndim == 3 and prompt.shape[-1] == 1:
+            prompt = prompt[..., 0]
+        B, P = prompt.shape
+        C = int(cache_len) if cache_len is not None else int(P)
+        if C < P:
+            raise ValueError(f"cache_len={C} < prompt bucket {P}")
+        if C > self.max_len:
+            raise ValueError(f"cache_len={C} > max_len={self.max_len}")
+        nh, hd = self.n_head, self.hidden // self.n_head
+        ks, vs, logits0, lengths = self._prefill_core(params, prompt,
+                                                      lengths)
+        state = {"pos": lengths, "k": [], "v": []}
+        for k, v in zip(ks, vs):
+            state["k"].append(
+                jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(k))
+            state["v"].append(
+                jnp.zeros((B, C, nh, hd), jnp.float32).at[:, :P].set(v))
+        return state, logits0
+
+    def prefill_kv(self, params, prompt, lengths=None):
+        """Paged prefill: the same prompt forward as ``init_decode`` but
+        WITHOUT allocating caches — returns ``(ks, vs, logits0)`` with
+        per-layer raw (B, P, nh, hd) K/V for the batcher's commit program
+        to quantize/scatter into pool blocks."""
+        ks, vs, logits0, _ = self._prefill_core(params, prompt, lengths)
+        return ks, vs, logits0
 
     def decode_step(self, params, state, tokens):
         """One token for every row: write K/V at the row cursor, attend
@@ -334,6 +381,154 @@ class TransformerLM(Layer):
             new_v.append(vc)
         logits = self._logits(params, self._ln(params["ln_f"], x))
         return logits, {"pos": pos + 1, "k": new_k, "v": new_v}
+
+    # -- paged KV pool (PR 18) ------------------------------------------------
+    def init_paged_pools(self, n_blocks: int, block_len: int,
+                         max_active: int, kv_quant: str = "off"):
+        """Zeroed pool pytree for the paged batcher.  ``n_blocks`` counts
+        the TRASH block (row 0) — the allocator hands out ids 1..n-1.
+        int8 mode adds per-(block, head) scale planes and per-slot f32
+        STAGING buffers holding each row's active (partial) block exactly,
+        so every append re-quantizes from exact values."""
+        if kv_quant not in ("off", "int8"):
+            raise ValueError(f"kv_quant must be off|int8, got {kv_quant!r}")
+        nh, hd = self.n_head, self.hidden // self.n_head
+        L = self.n_layers
+        kdt = np.int8 if kv_quant == "int8" else np.float32
+        pools = {
+            "k": [np.zeros((n_blocks, block_len, nh, hd), kdt)
+                  for _ in range(L)],
+            "v": [np.zeros((n_blocks, block_len, nh, hd), kdt)
+                  for _ in range(L)],
+        }
+        if kv_quant == "int8":
+            pools["ks"] = [np.zeros((n_blocks, nh), np.float32)
+                           for _ in range(L)]
+            pools["vs"] = [np.zeros((n_blocks, nh), np.float32)
+                           for _ in range(L)]
+            pools["stk"] = [np.zeros((max_active, block_len, nh, hd),
+                                     np.float32) for _ in range(L)]
+            pools["stv"] = [np.zeros((max_active, block_len, nh, hd),
+                                     np.float32) for _ in range(L)]
+        return pools
+
+    def prefill_shared(self, params, suffix, lengths, prefix_len,
+                       prefix_k, prefix_v):
+        """Suffix-only prefill for prefix-cache hits: the shared prefix's
+        K/V (``prefix_k``/``prefix_v``, per-layer (B, PL, nh, hd) f32
+        gathered from the pool by the caller) joins attention as extra
+        keys, only the ``suffix`` tokens run through the stack.  Rows'
+        true prefix lengths ``prefix_len`` (B,) mask the gather padding;
+        suffix positions embed at ``prefix_len + i``.  Returns ``(ks, vs,
+        logits0)`` — SUFFIX-only K/V for the commit program."""
+        suffix = jnp.asarray(suffix)
+        if suffix.ndim == 3 and suffix.shape[-1] == 1:
+            suffix = suffix[..., 0]
+        suffix = suffix.astype(jnp.int32)
+        B, S = suffix.shape
+        lengths = jnp.asarray(lengths, jnp.int32)        # suffix lengths
+        prefix_len = jnp.asarray(prefix_len, jnp.int32)
+        PL = prefix_k[0].shape[1]
+        nh, hd = self.n_head, self.hidden // self.n_head
+        gpos = jnp.minimum(prefix_len[:, None] + jnp.arange(S),
+                           self.max_len - 1)             # (B, S) global pos
+        x = jnp.take(params["embed"], suffix, axis=0) \
+            + jnp.take(params["pos"], gpos, axis=0)
+        qi = jnp.arange(S)
+        # keys = [prefix (PL) | suffix (S)]: prefix key j valid iff
+        # j < prefix_len[row]; suffix key js valid iff causal AND real
+        pmask = jnp.arange(PL)[None, None, :] \
+            < prefix_len[:, None, None]                  # (B, 1, PL) -> bcast
+        smask = (qi[None, :, None] >= qi[None, None, :]) \
+            & (qi[None, None, :] < lengths[:, None, None])   # (B, S, S)
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(pmask, (B, S, PL)), smask], axis=2)
+        ks, vs = [], []
+        for li, blk in enumerate(params["blocks"]):
+            h = self._ln(blk["ln1"], x)
+            q, k, v = jnp.split(self._lin(blk["qkv"], h), 3, axis=-1)
+            q, k, v = self._heads(q), self._heads(k), self._heads(v)
+            kk = jnp.concatenate(
+                [prefix_k[li].astype(jnp.float32), k], axis=1)
+            vv = jnp.concatenate(
+                [prefix_v[li].astype(jnp.float32), v], axis=1)
+            scale = 1.0 / np.sqrt(hd)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+            att = jnp.where(mask[:, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", att, vv)
+            x = x + self._lin(blk["proj"], o.reshape(B, S, self.hidden))
+            h2 = self._ln(blk["ln2"], x)
+            x = x + self._lin(blk["fc2"],
+                              jax.nn.gelu(self._lin(blk["fc1"], h2)))
+            ks.append(k)
+            vs.append(v)
+        h = self._ln(params["ln_f"], x)
+        last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+        return ks, vs, self._logits(params, last)
+
+    def decode_paged(self, params, pstate, block_tables, pos, tokens, *,
+                     block_len: int, kv_quant: str = "off", impl=None):
+        """One token per row against the block pool: ``decode_step``'s
+        math with the cache write routed through each row's block table
+        and the read through ``ops/paged_attention``.  Inactive rows point
+        their whole table at the trash block, so their writes land
+        harmlessly.  int8 mode re-packs the row's ACTIVE block from its
+        exact f32 staging copy every step (values quantize once, from
+        exact inputs) and scatters block + scale into the pool.  Returns
+        ``(logits, new_pstate)`` — the caller advances ``pos``."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        pos = jnp.asarray(pos, jnp.int32)
+        bt = jnp.asarray(block_tables, jnp.int32)
+        A = tokens.shape[0]
+        T = bt.shape[1]
+        bl = int(block_len)
+        rows = jnp.arange(A)
+        off = pos % bl
+        # clamp like decode_step's cursor: an overshooting row keeps
+        # rewriting its last table entry instead of indexing out of range
+        cur = bt[rows, jnp.minimum(pos // bl, T - 1)]     # (A,) physical id
+        x = jnp.take(params["embed"], tokens, axis=0) \
+            + jnp.take(params["pos"], jnp.minimum(pos, self.max_len - 1),
+                       axis=0)
+        quant = kv_quant == "int8"
+        new = {key: [] for key in pstate}
+        for li, blk in enumerate(params["blocks"]):
+            h = self._ln(blk["ln1"], x)
+            q, k, v = jnp.split(self._lin(blk["qkv"], h), 3, axis=-1)
+            q, k, v = self._heads(q), self._heads(k), self._heads(v)
+            if quant:
+                # staging reset on block rollover (off == 0), then append
+                keep = (off != 0)[:, None, None, None]
+                stk = jnp.where(keep, pstate["stk"][li], 0.0) \
+                    .at[rows, off].set(k)
+                stv = jnp.where(keep, pstate["stv"][li], 0.0) \
+                    .at[rows, off].set(v)
+                qk, sk = kv_pack_int8(stk)                # (A,bl,nh,hd)
+                qv, sv = kv_pack_int8(stv)
+                kp = pstate["k"][li].at[cur].set(qk)
+                vp = pstate["v"][li].at[cur].set(qv)
+                ksc = pstate["ks"][li].at[cur].set(sk)
+                vsc = pstate["vs"][li].at[cur].set(sv)
+                o = paged_attention(q, kp, vp, bt, pos + 1, ksc, vsc,
+                                    impl=impl)
+                new["ks"].append(ksc)
+                new["vs"].append(vsc)
+                new["stk"].append(stk)
+                new["stv"].append(stv)
+            else:
+                kp = pstate["k"][li].at[cur, off].set(k)
+                vp = pstate["v"][li].at[cur, off].set(v)
+                o = paged_attention(q, kp, vp, bt, pos + 1, impl=impl)
+            new["k"].append(kp)
+            new["v"].append(vp)
+            x = x + self._lin(blk["proj"], o.reshape(A, self.hidden))
+            h2 = self._ln(blk["ln2"], x)
+            x = x + self._lin(blk["fc2"],
+                              jax.nn.gelu(self._lin(blk["fc1"], h2)))
+        logits = self._logits(params, self._ln(params["ln_f"], x))
+        return logits, new
 
     # -- monolithic greedy rollout (batch-in/batch-out baseline) --------------
     def generate(self, params, prompt, max_tokens: int = 32,

@@ -1,0 +1,215 @@
+"""Paged decode attention — block-pool KV gather kernels (PR 18).
+
+The continuous batcher's monolithic per-slot KV lanes become a fixed pool
+of ``(n_blocks, block_len, heads, head_dim)`` buffers; each decode row
+owns a BLOCK TABLE mapping its logical cache blocks to physical pool
+blocks (the vLLM paged-attention layout).  This module is the read side:
+one query token per row attends over the row's table-mapped blocks.
+
+Two data paths, the `quant_matmul.py` shape:
+
+- ``paged_attention_xla`` — pure-XLA reference: gather the table's blocks,
+  dequantize (int8 mode), re-linearize to the monolithic cache layout and
+  run EXACTLY the einsum+mask+softmax ``TransformerLM.decode_step`` runs.
+  Because the gather materializes the same values at the same positions,
+  the float path is BITWISE-equal to monolithic decode — the parity
+  anchor — and it is the CPU serving fallback.
+- ``_paged_kernel`` — Pallas TPU kernel: the block table rides in as a
+  SCALAR-PREFETCH operand (``pltpu.PrefetchScalarGridSpec``) so the
+  ``k_pool``/``v_pool`` BlockSpec index maps dereference it per grid step
+  — the pool block streams HBM->VMEM by PHYSICAL id, no host gather, no
+  (A, used_len) materialization.  Online-softmax carry across the
+  page-grid axis, flash_attention style.  int8 pools dequantize IN-KERNEL
+  against their per-(block, head) scales right before the dot — the
+  PR 14 fused-dequant recipe applied to KV instead of weights.
+
+``impl="auto"`` resolves like ``quant_matmul._resolve_impl``: Pallas on a
+real TPU backend, XLA everywhere else; ``"interpret"`` runs the kernel on
+CPU for the parity tests.
+
+Quantization contract: ``inference/quantize.kv_pack_int8`` /
+``kv_unpack_int8`` (symmetric, scale = per-(block, head) absmax / 127) —
+the ONE contract shared with the decode append path and the prefill
+commit program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from analytics_zoo_tpu.inference.quantize import kv_unpack_int8
+from analytics_zoo_tpu.ops.quant_matmul import _resolve_impl
+
+NEG_INF = -1e30
+
+
+def _check(q, k_pool, v_pool, block_tables, lengths, k_scale, v_scale):
+    if q.ndim != 3:
+        raise ValueError(f"q must be (rows, heads, head_dim), got {q.shape}")
+    if k_pool.ndim != 4 or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"pools must be matching (n_blocks, block_len, heads, "
+            f"head_dim), got {k_pool.shape} / {v_pool.shape}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != q.shape[0]:
+        raise ValueError(
+            f"block_tables must be (rows, n_table), got "
+            f"{block_tables.shape} for {q.shape[0]} rows")
+    if lengths.shape != (q.shape[0],):
+        raise ValueError(
+            f"lengths must be (rows,), got {lengths.shape}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale/v_scale must be given together")
+    if k_scale is not None and k_scale.shape != k_pool.shape[:1] \
+            + k_pool.shape[2:3]:
+        raise ValueError(
+            f"scales must be (n_blocks, heads), got {k_scale.shape} "
+            f"for pool {k_pool.shape}")
+
+
+def _gather_dequant(pool, scale, block_tables):
+    """(A, n_table, block_len, heads, head_dim) f32 — the table's blocks
+    in logical order, dequantized when the pool is int8."""
+    blocks = jnp.take(pool, block_tables, axis=0)
+    if scale is not None:
+        blocks = kv_unpack_int8(blocks, jnp.take(scale, block_tables,
+                                                 axis=0))
+    return blocks.astype(jnp.float32)
+
+
+def paged_attention_xla(q, k_pool, v_pool, block_tables, lengths,
+                        k_scale=None, v_scale=None):
+    """Reference path: gather -> dequant -> the exact decode_step
+    attention (same einsums, same -1e30 mask, same softmax), so the float
+    path is bitwise-identical to attending over a monolithic cache that
+    holds the same values."""
+    kc = _gather_dequant(k_pool, k_scale, block_tables)
+    vc = _gather_dequant(v_pool, v_scale, block_tables)
+    A, T, bl, nh, hd = kc.shape
+    kc = kc.reshape(A, T * bl, nh, hd)
+    vc = vc.reshape(A, T * bl, nh, hd)
+    scale = 1.0 / np.sqrt(hd)
+    att = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), kc) * scale
+    valid = jnp.arange(T * bl)[None] < lengths[:, None]         # (A, T*bl)
+    att = jnp.where(valid[:, None], att, NEG_INF)
+    att = jax.nn.softmax(att, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", att, vc)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                  o_ref, m_ref, l_ref, acc_ref, *, block_len: int,
+                  n_table: int, scale: float):
+    """One (row, table-entry) grid step: dequantize the prefetched block,
+    fold it into the row's online-softmax carry (m/l/acc scratch persists
+    across the table axis), emit at the last entry."""
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    a = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32)                     # (nh, hd)
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0][None, :, None]
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0][None, :, None]
+    # s[h, j] = q[h] . k[j, h] — contract hd, batch over heads
+    s = jax.lax.dot_general(
+        q, jnp.swapaxes(k, 0, 1), (((1,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale      # (nh, bl)
+    idx = t * block_len + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_len), 1)
+    s = jnp.where(idx < len_ref[a], s, NEG_INF)
+    m_prev, l_prev = m_ref[...], l_ref[...]              # (nh, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)                               # (nh, bl)
+    alpha = jnp.exp(m_prev - m_new)
+    # acc[h] += p[h] @ v[:, h, :] — batch over heads again
+    pv = jax.lax.dot_general(
+        p[:, None, :], jnp.swapaxes(v, 0, 1), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)[:, 0]        # (nh, hd)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(t == n_table - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, k_scale,
+                  v_scale, interpret: bool):
+    A, nh, hd = q.shape
+    n_blocks, bl, _, _ = k_pool.shape
+    n_table = int(block_tables.shape[1])
+    if k_scale is None:
+        # one kernel for both modes: float pools ride unit scales
+        # (x * 1.0 is exact, so the float kernel numerics are unchanged)
+        k_scale = jnp.ones((n_blocks, nh), jnp.float32)
+        v_scale = k_scale
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(A, n_table),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda a, t, bt, ln: (a, 0, 0)),
+            pl.BlockSpec((1, bl, nh, hd),
+                         lambda a, t, bt, ln: (bt[a, t], 0, 0, 0)),
+            pl.BlockSpec((1, bl, nh, hd),
+                         lambda a, t, bt, ln: (bt[a, t], 0, 0, 0)),
+            pl.BlockSpec((1, nh), lambda a, t, bt, ln: (bt[a, t], 0)),
+            pl.BlockSpec((1, nh), lambda a, t, bt, ln: (bt[a, t], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda a, t, bt, ln: (a, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, hd), jnp.float32)])
+    kernel = functools.partial(_paged_kernel, block_len=bl,
+                               n_table=n_table, scale=1.0 / np.sqrt(hd))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((A, nh, hd), jnp.float32),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(lengths, jnp.int32), q, k_pool, v_pool,
+      k_scale, v_scale)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths,
+                    k_scale=None, v_scale=None,
+                    impl: Optional[str] = None):
+    """One decode token per row over a paged KV pool.
+
+    - ``q`` (rows, heads, head_dim) f32 — the current token's queries.
+    - ``k_pool``/``v_pool`` (n_blocks, block_len, heads, head_dim) — f32,
+      or int8 with ``k_scale``/``v_scale`` (n_blocks, heads) f32.
+    - ``block_tables`` (rows, n_table) int32 — logical block j of row a
+      lives in pool block ``block_tables[a, j]``.  Entries past a row's
+      allocation may point anywhere resident (conventionally block 0, the
+      batcher's trash block): their positions are masked by ``lengths``.
+    - ``lengths`` (rows,) int32 — valid cache positions per row
+      (cursor + 1 at decode time: the current token's K/V is written
+      before the read).
+
+    Returns (rows, heads, head_dim) f32.  ``impl``: auto | pallas | xla |
+    interpret (see ``quant_matmul._resolve_impl``)."""
+    q = jnp.asarray(q)
+    k_pool, v_pool = jnp.asarray(k_pool), jnp.asarray(v_pool)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    _check(q, k_pool, v_pool, block_tables, lengths, k_scale, v_scale)
+    mode = _resolve_impl(impl)
+    if mode == "xla":
+        return paged_attention_xla(q, k_pool, v_pool, block_tables,
+                                   lengths, k_scale, v_scale)
+    return _paged_pallas(q, k_pool, v_pool, block_tables, lengths,
+                         k_scale, v_scale, interpret=(mode == "interpret"))

@@ -947,6 +947,29 @@ class ClusterServing:
                            fn=slots_fn), slots_fn))
             self._last_steps = 0
             self._tps_window = (time.monotonic(), 0)   # (t0, tokens0)
+            # paged KV pool (PR 18): occupancy / free-block / prefix-hit
+            # gauges so admission stalls are visible before the typed
+            # kv_pool_exhausted flight-recorder event fires
+            pool = getattr(self._batcher, "_pool", None)
+            if pool is not None:
+                free_fn = (lambda p=pool: float(p.free_blocks))
+                self._gauge_fns.append(
+                    (reg.gauge("serving_kv_pool_free_blocks",
+                               "Free blocks in the paged KV pool",
+                               fn=free_fn), free_fn))
+                occ_fn = (lambda p=pool:
+                          float(p.used_blocks) / max(1, p.n_blocks))
+                self._gauge_fns.append(
+                    (reg.gauge("serving_kv_pool_occupancy",
+                               "Used fraction of the paged KV pool",
+                               fn=occ_fn), occ_fn))
+                prefix = getattr(self._batcher, "_prefix", None)
+                if prefix is not None:
+                    hits_fn = (lambda x=prefix: float(x.hits))
+                    self._gauge_fns.append(
+                        (reg.gauge("serving_kv_prefix_hits_total",
+                                   "Prefix-cache hits at admission",
+                                   fn=hits_fn), hits_fn))
         # resource accounting (PR 15): decompose device memory into
         # weights (PR 14 stored-dtype bytes) / kv_state (PR 12 lane
         # buffers) / executables (PR 11 AOT cache) — live gauges + the

@@ -157,6 +157,14 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
     # intentionally heterogeneous — surface version -> replica count so
     # `manager status` shows the canary/rolling split at a glance
     versions: Dict[str, int] = {}
+    # paged KV pool (PR 18): block capacity/occupancy and prefix-cache
+    # traffic SUM across replicas (each owns its own pool); exhaustion
+    # stalls sum so an under-provisioned fleet shows one number
+    gen_pool = {"blocks": 0, "free_blocks": 0, "used_blocks": 0,
+                "prefix_hits": 0, "prefix_misses": 0,
+                "prefix_evictions": 0, "exhausted": 0,
+                "active_slots": 0}
+    gen_pool_seen = False
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -216,6 +224,15 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             res["executables"] += int(exes.get("count") or 0)
             res["executable_code_bytes"] += int(exes.get("code_bytes")
                                                 or 0)
+        g = doc.get("generation") or {}
+        gp = g.get("pool") or {}
+        if isinstance(gp.get("blocks"), int):
+            gen_pool_seen = True
+            for k in ("blocks", "free_blocks", "used_blocks",
+                      "prefix_hits", "prefix_misses", "prefix_evictions",
+                      "exhausted"):
+                gen_pool[k] += int(gp.get(k) or 0)
+            gen_pool["active_slots"] += int(g.get("active_slots") or 0)
         pr = doc.get("process") or {}
         if isinstance(pr.get("rss_bytes"), (int, float)):
             proc_seen = True
@@ -259,6 +276,11 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             # unversioned (pre-registry deployments)
             "versions": versions or None,
             "resources": res if res_seen else None,
+            # paged KV (PR 18): summed pool capacity/occupancy + prefix
+            # traffic (None when no replica runs a paged batcher)
+            "kv_pool": dict(gen_pool, occupancy=round(
+                gen_pool["used_blocks"] / max(1, gen_pool["blocks"]), 4))
+            if gen_pool_seen else None,
             "process": dict(proc, cpu_seconds=round(proc["cpu_seconds"],
                                                     3))
             if proc_seen else None,

@@ -99,6 +99,19 @@ class GenerationParams:
       mid-quantum wastes at most ``decode_quantum - 1`` row-steps (its
       post-EOS tokens are discarded on host).  1 = pure per-token
       scheduling.
+    - ``paged`` — paged KV mode (PR 18): KV lives in a fixed block POOL
+      instead of per-slot monolithic lanes; each slot holds a block
+      table, admission is bounded by free blocks, and prompts sharing a
+      registered prefix share its resident pages.  Needs a model with
+      the paged decode API (``models/textmodels.TransformerLM``).
+    - ``block_len`` — tokens per pool block (pow-2).
+    - ``pool_blocks`` — usable pool blocks (default: enough for every
+      slot at full lane capacity, i.e. ``max_active_slots * bucket /
+      block_len`` — sized DOWN is how paged mode oversubscribes HBM).
+    - ``kv_quant`` — ``off`` | ``int8``: int8 pool blocks with
+      per-(block, head) scales, dequantized in-kernel at decode.
+    - ``prefix_cache`` — share resident full-block prompt prefixes
+      across requests (LRU index, evicted when the pool runs dry).
     """
 
     max_active_slots: int = 8
@@ -110,6 +123,11 @@ class GenerationParams:
     prefill_buckets: Optional[List[int]] = None
     stream_interval: int = 8
     decode_quantum: int = 4
+    paged: bool = False
+    block_len: int = 16
+    pool_blocks: Optional[int] = None
+    kv_quant: str = "off"
+    prefix_cache: bool = True
 
     def __post_init__(self):
         self.max_active_slots = max(1, int(self.max_active_slots))
@@ -118,6 +136,14 @@ class GenerationParams:
         self.max_prompt_len = max(1, int(self.max_prompt_len))
         self.stream_interval = max(0, int(self.stream_interval))
         self.decode_quantum = max(1, int(self.decode_quantum))
+        self.paged = bool(self.paged)
+        self.prefix_cache = bool(self.prefix_cache)
+        self.block_len = _pow2_ceil(self.block_len)
+        if self.kv_quant not in ("off", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'off' or 'int8', got {self.kv_quant!r}")
+        if self.pool_blocks is not None:
+            self.pool_blocks = max(1, int(self.pool_blocks))
         if self.eos_id is not None:
             self.eos_id = int(self.eos_id)
         if self.bucket_lens is None:
@@ -213,6 +239,23 @@ class _Lane:
         return self.max_active - len(self.free)
 
 
+class _PagedLane(_Lane):
+    """Paged-KV lane (PR 18): ``state`` holds the POOL pytree instead of
+    per-slot caches, and the per-slot cache geometry lives in host-side
+    block tables.  Inactive slots keep their table row zeroed (every
+    entry -> the trash block), so their in-program decode writes land
+    harmlessly."""
+
+    def __init__(self, bucket: int, max_active: int, block_len: int):
+        super().__init__(bucket, max_active)
+        self.ntab = bucket // block_len
+        self.tables = np.zeros((max_active, self.ntab), np.int32)
+        self.pos = np.zeros((max_active,), np.int32)
+        # per-slot owned block ids (shared-prefix refs + private), for
+        # release on free
+        self.blocks: List[Optional[List[int]]] = [None] * max_active
+
+
 class ContinuousBatcher:
     """Token-level decode scheduler over an ``InferenceModel`` whose inner
     layer exposes ``init_decode``/``decode_step`` (see module docstring).
@@ -245,24 +288,55 @@ class ContinuousBatcher:
         # a cache lane must fit under the model's max_len AND hold at
         # least the smallest prefill bucket (prefill allocates the cache
         # at lane capacity, so cache_len >= prompt bucket must hold)
-        self._lanes = [
-            _Lane(b, gen.max_active_slots) for b in gen.bucket_lens
+        usable = [
+            b for b in gen.bucket_lens
             if not (self._cache_model
                     and ((model_cap and b > model_cap)
                          or b < gen.prefill_buckets[0]))]
-        if not self._lanes:
+        if not usable:
             raise ValueError(
                 f"no usable decode lane: bucket_lens={gen.bucket_lens} "
                 f"all exceed the model's max_len={model_cap} or fall "
                 f"below the smallest prefill bucket "
                 f"{gen.prefill_buckets[0]}")
-        if len(self._lanes) < len(gen.bucket_lens):
+        if len(usable) < len(gen.bucket_lens):
             logger.warning(
                 "generate: dropped %d unusable decode lane(s) from "
                 "bucket_lens=%s (model max_len=%s, smallest prefill "
-                "bucket %d)", len(gen.bucket_lens) - len(self._lanes),
+                "bucket %d)", len(gen.bucket_lens) - len(usable),
                 gen.bucket_lens, model_cap or "n/a",
                 gen.prefill_buckets[0])
+        self._pool = None
+        self._prefix = None
+        self.pool_exhausted = 0
+        self._exhausted_boundary = False
+        if gen.paged:
+            missing = [m for m in ("prefill_kv", "prefill_shared",
+                                   "decode_paged", "init_paged_pools")
+                       if not hasattr(inner, m)]
+            if missing:
+                raise ValueError(
+                    "generation.paged=true needs a model with the paged "
+                    "decode API (models/textmodels.TransformerLM); "
+                    f"missing: {missing}")
+            bucket = max(usable)
+            if gen.block_len > bucket:
+                raise ValueError(
+                    f"block_len={gen.block_len} > lane capacity {bucket}")
+            # ONE paged lane at the largest capacity: block tables make
+            # per-request capacity a table-width concern, not a lane
+            # concern, so the bucket ladder collapses
+            lane = _PagedLane(bucket, gen.max_active_slots, gen.block_len)
+            self._lanes = [lane]
+            from analytics_zoo_tpu.serving.kvpool import (BlockPool,
+                                                          PrefixIndex)
+            n_pool = gen.pool_blocks if gen.pool_blocks is not None \
+                else gen.max_active_slots * lane.ntab
+            self._pool = BlockPool(n_pool, gen.block_len)
+            if gen.prefix_cache:
+                self._prefix = PrefixIndex(self._pool)
+        else:
+            self._lanes = [_Lane(b, gen.max_active_slots) for b in usable]
         self._waiting: deque = deque()
         self._waiting_lock = threading.Lock()
         # per-boundary decode accounting (PR 13 tracing): after each
@@ -346,6 +420,111 @@ class ContinuousBatcher:
             self._programs[key] = fns
         return fns
 
+    def _paged_fns(self):
+        """The three paged-mode jit functions (PR 18): ``pprefill``
+        (prompt forward + block commit in ONE program, so raw prompt K/V
+        never leaves the device), ``pshared`` (suffix-only prefill over
+        pool-resident prefix blocks + commit) and ``pdecode``
+        (decode_quantum paged decode steps under one scan)."""
+        key = ("pfns",)
+        fns = self._programs.get(key)
+        if fns is not None:
+            return fns
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.inference.quantize import (kv_pack_int8,
+                                                          kv_unpack_int8)
+        inner = self.inner
+        bl = self.gen.block_len
+        kq = self.gen.kv_quant
+        K = self.gen.decode_quantum
+
+        def commit(pools, ks, vs, lengths, dest, slots):
+            """Scatter the batch's (length-masked) K/V into pool blocks:
+            row j's block t lands at pool id ``dest[j, t]`` (0 = trash,
+            for padding rows and blocks past the row's fill).  int8 mode
+            quantizes per block and parks each row's partial TAIL block
+            in its slot's f32 staging buffer (``slots``; the sentinel
+            ``max_active`` drops padding rows), so decode appends
+            re-quantize from exact values."""
+            npb = dest.shape[1]
+            bb, pb, nh, hd = ks[0].shape
+            pad = npb * bl
+            valid = (jnp.arange(pb)[None, :]
+                     < lengths[:, None])[..., None, None]
+            out = {k2: list(v2) for k2, v2 in pools.items()}
+            tb = jnp.minimum(lengths // bl, npb - 1)
+            tsel = tb[:, None, None, None, None]
+            for li in range(len(ks)):
+                k = jnp.where(valid, ks[li], 0.0)
+                v = jnp.where(valid, vs[li], 0.0)
+                if pad > pb:
+                    z = jnp.zeros((bb, pad - pb, nh, hd), jnp.float32)
+                    k = jnp.concatenate([k, z], axis=1)
+                    v = jnp.concatenate([v, z], axis=1)
+                kb = k.reshape(bb, npb, bl, nh, hd)
+                vb = v.reshape(bb, npb, bl, nh, hd)
+                if kq == "int8":
+                    qk, sk = kv_pack_int8(kb)
+                    qv, sv = kv_pack_int8(vb)
+                    out["k"][li] = out["k"][li].at[dest].set(qk)
+                    out["v"][li] = out["v"][li].at[dest].set(qv)
+                    out["ks"][li] = out["ks"][li].at[dest].set(sk)
+                    out["vs"][li] = out["vs"][li].at[dest].set(sv)
+                    tk = jnp.take_along_axis(kb, tsel, axis=1)[:, 0]
+                    tv = jnp.take_along_axis(vb, tsel, axis=1)[:, 0]
+                    out["stk"][li] = out["stk"][li].at[slots].set(
+                        tk, mode="drop")
+                    out["stv"][li] = out["stv"][li].at[slots].set(
+                        tv, mode="drop")
+                else:
+                    out["k"][li] = out["k"][li].at[dest].set(kb)
+                    out["v"][li] = out["v"][li].at[dest].set(vb)
+            return out
+
+        def pprefill(p, prompt, lengths, pools, dest, slots):
+            ks, vs, logits0 = inner.prefill_kv(p, prompt, lengths)
+            return commit(pools, ks, vs, lengths, dest, slots), logits0
+
+        def pshared(p, suffix, slens, prefix_len, ptab, pools, dest,
+                    slots):
+            npb = ptab.shape[1]
+            bb = suffix.shape[0]
+            pk, pv = [], []
+            for li in range(len(pools["k"])):
+                k = jnp.take(pools["k"][li], ptab, axis=0)
+                v = jnp.take(pools["v"][li], ptab, axis=0)
+                if kq == "int8":
+                    k = kv_unpack_int8(
+                        k, jnp.take(pools["ks"][li], ptab, axis=0))
+                    v = kv_unpack_int8(
+                        v, jnp.take(pools["vs"][li], ptab, axis=0))
+                sh = k.shape
+                pk.append(k.astype(jnp.float32)
+                          .reshape(bb, npb * bl, *sh[3:]))
+                pv.append(v.astype(jnp.float32)
+                          .reshape(bb, npb * bl, *sh[3:]))
+            ks, vs, logits0 = inner.prefill_shared(p, suffix, slens,
+                                                   prefix_len, pk, pv)
+            return commit(pools, ks, vs, slens, dest, slots), logits0
+
+        def pdecode(p, pools, tables, pos, tokens):
+            def body(carry, _):
+                pl_, po_, tok = carry
+                logits, pl2 = inner.decode_paged(
+                    p, pl_, tables, po_, tok, block_len=bl, kv_quant=kq)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (pl2, po_ + 1, nxt), nxt
+
+            (pools2, _, _), toks = jax.lax.scan(
+                body, (pools, jnp.asarray(pos, jnp.int32), tokens), None,
+                length=K)
+            return toks, pools2           # toks: (K, max_active)
+
+        fns = (jax.jit(pprefill), jax.jit(pshared), jax.jit(pdecode))
+        self._programs[key] = fns
+        return fns
+
     def _compiled(self, key: tuple, fn, *args):
         """AOT-compiled executable for one fixed-shape program, compiled
         exactly once; ``warm()`` walks the same path, so a warmed program
@@ -367,6 +546,12 @@ class ContinuousBatcher:
             return f"insert:b{key[1]}@{key[2]}"
         if key[0] == "decode_step":
             return f"decode_step@{key[1]}"
+        if key[0] == "pprefill":
+            return f"paged_prefill:b{key[1]}xp{key[2]}"
+        if key[0] == "pshared":
+            return f"paged_shared:b{key[1]}xs{key[2]}xn{key[3]}"
+        if key[0] == "pdecode":
+            return f"paged_decode@{key[1]}"
         return ":".join(str(k) for k in key)
 
     def _count_exec(self, key: tuple) -> None:
@@ -398,6 +583,14 @@ class ContinuousBatcher:
         if lane.state is not None:
             return
         import jax
+        if isinstance(lane, _PagedLane):
+            # pool pytree: +1 block for the reserved trash row; placed
+            # whole (no slot axis to shard — the pool IS the point)
+            pools = self.inner.init_paged_pools(
+                self._pool.n_blocks + 1, self.gen.block_len,
+                lane.max_active, self.gen.kv_quant)
+            lane.state = jax.device_put(pools)
+            return
         pb = self.gen.prefill_buckets[0]
         prefill, _, _ = self._lane_fns(lane)
         A = lane.max_active
@@ -583,10 +776,242 @@ class ContinuousBatcher:
                 lane.tokens[slot] = self.gen.start_id
         return admitted
 
+    # -- paged admission (PR 18) ----------------------------------------------
+    def _reserve(self, lane: "_PagedLane", req: GenRequest):
+        """Claim pool blocks for one request: the longest registered
+        prompt prefix rides shared (referenced) pages, the rest allocates
+        private blocks — evicting LRU prefix-cache entries if the pool
+        runs dry.  Returns ``(k_shared, shared_ids, private_ids, plen)``
+        or None (pool exhausted: the caller requeues and a typed
+        ``kv_pool_exhausted`` flight-recorder event explains the stall)."""
+        prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+        plen = int(prompt.size)
+        bl = self.gen.block_len
+        need = (plen + self._budget_for(req, lane) + bl - 1) // bl
+        need = min(need, lane.ntab)
+        ksh, shared = 0, []
+        if self._prefix is not None:
+            # cap leaves >= 1 suffix token: first-token logits need at
+            # least one position to actually prefill
+            ksh, shared = self._prefix.lookup(
+                prompt, max_blocks=(plen - 1) // bl)
+        priv = self._pool.alloc(need - ksh)
+        if priv is None and self._prefix is not None:
+            self._prefix.evict_for(need - ksh)
+            priv = self._pool.alloc(need - ksh)
+        if priv is None:
+            if shared:
+                self._pool.release(shared)
+            if not self._exhausted_boundary:
+                self._exhausted_boundary = True
+                self.pool_exhausted += 1
+                from analytics_zoo_tpu.common.observability import \
+                    get_recorder
+                get_recorder().record(
+                    "kv_pool_exhausted", rid=req.rid,
+                    need_blocks=int(need - ksh),
+                    free_blocks=int(self._pool.free_blocks),
+                    active_slots=int(self.active),
+                    waiting=int(self.waiting))
+            return None
+        return ksh, shared, priv, plen
+
+    def _release_resv(self, resv) -> None:
+        ksh, shared, priv, _ = resv
+        if shared:
+            self._pool.release(shared)
+        if priv:
+            self._pool.release(priv)
+
+    def _admit_paged(self, events: List[GenEvent]) -> int:
+        """Paged admission: like ``_admit`` but gated on pool blocks as
+        well as free slots, grouped into prefix-MISS batches (full
+        prefill, one program per (batch, prompt bucket)) and prefix-HIT
+        batches (suffix-only prefill, one program per (batch, suffix
+        bucket, prefix-table bucket))."""
+        lane: _PagedLane = self._lanes[0]
+        bl = self.gen.block_len
+        grabbed: List[tuple] = []        # (req, slot, resv)
+        while True:
+            with self._waiting_lock:
+                req = self._waiting.popleft() if self._waiting else None
+            if req is None:
+                break
+            if self._expired(req.deadline_ns):
+                self.shed += 1
+                events.append(GenEvent(
+                    "shed", req.rid, trace_id=req.trace_id,
+                    t_read=req.t_read))
+                continue
+            err = self._validate(req)
+            if err is not None:
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"ValueError: {err}", t_read=req.t_read))
+                continue
+            if self._pick_lane(req) is None:
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error="ValueError: no decode lane holds prompt + "
+                          f"max_tokens (buckets {self.gen.bucket_lens})",
+                    t_read=req.t_read))
+                continue
+            if not lane.free:
+                with self._waiting_lock:
+                    self._waiting.appendleft(req)
+                break
+            resv = self._reserve(lane, req)
+            if resv is None:
+                with self._waiting_lock:
+                    self._waiting.appendleft(req)
+                break
+            grabbed.append((req, lane.free.popleft(), resv))
+        if not grabbed:
+            return 0
+        miss: Dict[int, list] = {}
+        hit: Dict[tuple, list] = {}
+        for req, slot, resv in grabbed:
+            ksh, _, _, plen = resv
+            pb = self._prefill_bucket(plen - ksh * bl)
+            if pb is None:               # defensive, as in _admit
+                self._release_resv(resv)
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"ValueError: no prefill bucket holds prompt "
+                          f"length {plen} (buckets "
+                          f"{self.gen.prefill_buckets})",
+                    t_read=req.t_read))
+                lane.free.append(slot)
+                continue
+            if ksh:
+                hit.setdefault((pb, _pow2_ceil(ksh)), []).append(
+                    (req, slot, resv))
+            else:
+                miss.setdefault(pb, []).append((req, slot, resv))
+        return sum(self._admit_paged_batch(lane, pb, members, events)
+                   for pb, members in miss.items()) \
+            + sum(self._admit_paged_batch(lane, sb, members, events,
+                                          shared=npb)
+                  for (sb, npb), members in hit.items())
+
+    def _admit_paged_batch(self, lane: "_PagedLane", pb: int, members,
+                           events, shared: Optional[int] = None) -> int:
+        """Prefill + commit one same-bucket paged admission group in ONE
+        device call.  ``shared`` = prefix-table bucket for prefix-HIT
+        groups (None = full prefill).  Mirrors ``_admit_batch``'s
+        singleton fallback so a poisoned request quarantines alone."""
+        import jax
+        bl = self.gen.block_len
+        A = lane.max_active
+        n = len(members)
+        bb = self._batch_bucket(n)
+        npb_dest = (pb + bl - 1) // bl
+        padded = np.zeros((bb, pb), np.int32)
+        lengths = np.ones((bb,), np.int32)
+        dest = np.zeros((bb, npb_dest), np.int32)
+        slots_arr = np.full((bb,), A, np.int32)     # A = drop sentinel
+        if shared is not None:
+            ptab = np.zeros((bb, shared), np.int32)
+            plens = np.zeros((bb,), np.int32)
+        for j, (req, slot, resv) in enumerate(members):
+            ksh, shared_ids, priv, plen = resv
+            prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+            table = list(shared_ids) + list(priv)
+            if shared is not None:
+                suffix = prompt[ksh * bl:]
+                padded[j, :suffix.size] = suffix
+                lengths[j] = suffix.size
+                ptab[j, :ksh] = shared_ids
+                plens[j] = ksh * bl
+                nfill = (suffix.size + bl - 1) // bl
+                dest[j, :nfill] = table[ksh:ksh + nfill]
+            else:
+                padded[j, :plen] = prompt
+                lengths[j] = plen
+                nfill = (plen + bl - 1) // bl
+                dest[j, :nfill] = table[:nfill]
+            slots_arr[j] = slot
+        for j in range(n, bb):
+            # padding rows replicate row 0's prompt; their dest stays at
+            # the trash block and their slot at the drop sentinel, so
+            # nothing they compute is ever committed
+            padded[j] = padded[0]
+            lengths[j] = lengths[0]
+            if shared is not None:
+                ptab[j] = ptab[0]
+                plens[j] = plens[0]
+        pprefill, pshared, _ = self._paged_fns()
+        try:
+            self._ensure_lane_state(lane)
+            if shared is None:
+                key = ("pprefill", bb, pb)
+                exe = self._compiled(key, pprefill, self._params(),
+                                     padded, lengths, lane.state, dest,
+                                     slots_arr)
+                lane.state, logits0 = exe(self._params(), padded, lengths,
+                                          lane.state, dest, slots_arr)
+            else:
+                key = ("pshared", bb, pb, shared)
+                exe = self._compiled(key, pshared, self._params(),
+                                     padded, lengths, plens, ptab,
+                                     lane.state, dest, slots_arr)
+                lane.state, logits0 = exe(self._params(), padded, lengths,
+                                          plens, ptab, lane.state, dest,
+                                          slots_arr)
+            self._count_exec(key)
+            toks0 = np.asarray(logits0).argmax(axis=-1)
+        except Exception as e:  # noqa: BLE001 — batch-level failure
+            if n == 1:
+                req, slot, resv = members[0]
+                self._release_resv(resv)
+                self.quarantined += 1
+                events.append(GenEvent(
+                    "quarantine", req.rid, trace_id=req.trace_id,
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                lane.free.append(slot)
+                return 0
+            return sum(self._admit_paged_batch(lane, pb, [m], events,
+                                               shared=shared)
+                       for m in members)
+        admitted = 0
+        for j, (req, slot, resv) in enumerate(members):
+            ksh, shared_ids, priv, plen = resv
+            table = list(shared_ids) + list(priv)
+            lane.tables[slot, :] = 0
+            lane.tables[slot, :len(table)] = table
+            lane.pos[slot] = plen
+            lane.blocks[slot] = table
+            info = _Slot(req, budget=self._budget_for(req, lane))
+            lane.slots[slot] = info
+            self.admitted += 1
+            admitted += 1
+            if self._prefix is not None and ksh == 0:
+                # park the prompt's FULL blocks for future sharers (the
+                # partial tail block keeps being written by decode, so
+                # it can never be shared)
+                full = plen // bl
+                if full:
+                    prompt = np.asarray(req.prompt).astype(np.int32) \
+                        .reshape(-1)
+                    self._prefix.register(prompt[:full * bl],
+                                          table[:full])
+            info.t_first = time.monotonic()
+            events.append(GenEvent(
+                "first_token", req.rid, trace_id=req.trace_id,
+                ttft_s=info.t_first - req.t_submit, t_read=req.t_read))
+            lane.tokens[slot] = int(toks0[j])
+            self._account_token(lane, slot, info, int(toks0[j]), events)
+        return admitted
+
     def _admit(self, events: List[GenEvent]) -> int:
         """Claim free slots for waiting requests and admit them in
         batched prefill groups.  Stops at the first head-of-line request
         whose lane is full (FIFO; retried next boundary)."""
+        if self._pool is not None:
+            return self._admit_paged(events)
         grabbed: List[tuple] = []        # (req, lane, slot)
         while True:
             with self._waiting_lock:
@@ -659,6 +1084,14 @@ class ContinuousBatcher:
             return False      # gateway/engine validated upstream
 
     def _free(self, lane: _Lane, slot: int) -> None:
+        if isinstance(lane, _PagedLane):
+            if lane.blocks[slot]:
+                self._pool.release(lane.blocks[slot])
+                lane.blocks[slot] = None
+            # zero the table row: the freed slot's in-program writes
+            # land in the trash block until the next admission
+            lane.tables[slot, :] = 0
+            lane.pos[slot] = 0
         lane.slots[slot] = None
         lane.free.append(slot)
 
@@ -713,18 +1146,39 @@ class ContinuousBatcher:
         returns [] without touching the device."""
         events: List[GenEvent] = []
         self.last_boundary = []
+        self._exhausted_boundary = False
         self._shed_active(events)
         self.last_admitted = self._admit(events)
         for lane in self._lanes:
             if lane.active == 0:
                 continue
-            _, step, _ = self._lane_fns(lane)
             tokens = lane.tokens
-            exe = self._compiled(("decode_step", lane.bucket), step,
-                                 self._params(), lane.state, tokens)
-            block, lane.state = exe(self._params(), lane.state, tokens)
-            self._count_exec(("decode_step", lane.bucket))
-            block = np.asarray(block)          # (decode_quantum, A)
+            if isinstance(lane, _PagedLane):
+                _, _, pdecode = self._paged_fns()
+                key = ("pdecode", lane.bucket)
+                exe = self._compiled(key, pdecode, self._params(),
+                                     lane.state, lane.tables, lane.pos,
+                                     tokens)
+                block, lane.state = exe(self._params(), lane.state,
+                                        lane.tables, lane.pos, tokens)
+                self._count_exec(key)
+                block = np.asarray(block)
+                # host cursors advance with the in-scan carry; idle rows
+                # clamp at lane capacity (their writes target the trash
+                # block regardless).  MUST run before the token fold —
+                # _free zeroes a finishing row's cursor.
+                lane.pos = np.minimum(
+                    lane.pos + np.int32(block.shape[0]),
+                    np.int32(lane.bucket)).astype(np.int32)
+            else:
+                _, step, _ = self._lane_fns(lane)
+                key = ("decode_step", lane.bucket)
+                exe = self._compiled(key, step,
+                                     self._params(), lane.state, tokens)
+                block, lane.state = exe(self._params(), lane.state,
+                                        tokens)
+                self._count_exec(key)
+                block = np.asarray(block)      # (decode_quantum, A)
             self.decode_steps += int(block.shape[0])   # token-level steps
             now = time.monotonic()
             for slot, info in enumerate(lane.slots):
@@ -764,11 +1218,18 @@ class ContinuousBatcher:
         deployment — delegated to ``aot.generation_manifest`` so the
         serving warm-up and ``manager warmup`` derive the same set."""
         from analytics_zoo_tpu.inference import aot
+        prefix_blocks: Sequence[int] = ()
+        if self._prefix is not None:
+            max_sh = (self.gen.max_prompt_len - 1) // self.gen.block_len
+            if max_sh >= 1:
+                prefix_blocks = _pow2_ladder(1, max_sh)
         return aot.generation_manifest(
             self.gen.prefill_buckets,
             [lane.bucket for lane in self._lanes],
             prefill_batches=_pow2_ladder(1, self.gen.max_active_slots),
-            cache_model=self._cache_model)
+            cache_model=self._cache_model,
+            paged=self._pool is not None,
+            prefix_blocks=prefix_blocks)
 
     def warm(self, manifest=None, progress: Optional[Callable] = None,
              stop: Optional[Callable[[], bool]] = None) -> Dict:
@@ -810,6 +1271,41 @@ class ContinuousBatcher:
         if lane is None:
             raise ValueError(f"no lane with bucket {entry.lane_bucket}")
         self._ensure_lane_state(lane)
+        if entry.kind.startswith("paged_"):
+            # compile-only (lower().compile() never executes), so the
+            # dummy operands only fix shapes — pools stay untouched
+            bl = self.gen.block_len
+            A = lane.max_active
+            pprefill, pshared, pdecode = self._paged_fns()
+            bb = int(entry.prefill_batch or 1)
+            if entry.kind == "paged_decode":
+                key = ("pdecode", lane.bucket)
+                fresh = key not in self._programs
+                self._compiled(key, pdecode, self._params(), lane.state,
+                               lane.tables, lane.pos, lane.tokens)
+                return fresh
+            pb = int(entry.prefill_bucket)
+            npb_dest = (pb + bl - 1) // bl
+            dummy = (np.zeros((bb, pb), np.int32),
+                     np.ones((bb,), np.int32))
+            dest = np.zeros((bb, npb_dest), np.int32)
+            slots = np.full((bb,), A, np.int32)
+            if entry.kind == "paged_prefill":
+                key = ("pprefill", bb, pb)
+                fresh = key not in self._programs
+                self._compiled(key, pprefill, self._params(), *dummy,
+                               lane.state, dest, slots)
+                return fresh
+            if entry.kind == "paged_shared":
+                npb = int(entry.prefix_blocks or 1)
+                key = ("pshared", bb, pb, npb)
+                fresh = key not in self._programs
+                self._compiled(key, pshared, self._params(), *dummy,
+                               np.zeros((bb,), np.int32),
+                               np.zeros((bb, npb), np.int32),
+                               lane.state, dest, slots)
+                return fresh
+            raise ValueError(f"unknown warm-up entry kind {entry.kind!r}")
         prefill, step, insert = self._lane_fns(lane)
         if entry.kind == "prefill":
             pb = int(entry.prefill_bucket)
@@ -846,25 +1342,56 @@ class ContinuousBatcher:
         raise ValueError(f"unknown warm-up entry kind {entry.kind!r}")
 
     # -- observability --------------------------------------------------------
-    def state_bytes(self) -> int:
-        """Bytes pinned by the committed lane state buffers — the
-        ``kv_state`` component of the resource ledger (PR 15).  Derived
-        from the leaf shapes/dtypes of each lane's fixed
-        ``(max_active, bucket)`` pytree, so the number is exact for the
-        bucket geometry in force regardless of where jax placed it."""
-        import jax
+    @staticmethod
+    def _leaf_bytes(leaves) -> int:
         total = 0
+        for leaf in leaves:
+            try:
+                total += int(np.prod(leaf.shape)) \
+                    * int(np.dtype(leaf.dtype).itemsize)
+            except (TypeError, ValueError):
+                continue
+        return total
+
+    def state_bytes_doc(self) -> Dict:
+        """The ``kv_state`` ledger component, decomposed (PR 18):
+        ``lanes`` (monolithic per-slot caches + int8 staging buffers —
+        everything slot-shaped), ``paged_pool`` (the shared KV block
+        pool), ``scales`` (int8 per-block scale planes) and ``aux``
+        (per-slot host-side scheduler state: token cursors, block
+        tables, position cursors — the PR 18 bugfix: these were never
+        counted for unallocated lanes, so the gauge could under-report).
+        Derived from leaf shapes/dtypes, so exact wherever jax placed
+        the buffers."""
+        import jax
+        lanes_b = pool_b = scales_b = aux_b = 0
         for lane in self._lanes:
+            aux_b += int(lane.tokens.nbytes)
+            if isinstance(lane, _PagedLane):
+                aux_b += int(lane.tables.nbytes) + int(lane.pos.nbytes)
             if lane.state is None:
                 continue
-            for leaf in jax.tree_util.tree_leaves(lane.state):
-                try:
-                    total += int(np.prod(leaf.shape)) \
-                        * int(np.dtype(leaf.dtype).itemsize)
-                except (TypeError, ValueError):
-                    continue
-            total += int(lane.tokens.nbytes)
-        return total
+            if isinstance(lane, _PagedLane):
+                for part, leaves in lane.state.items():
+                    nb = self._leaf_bytes(leaves)
+                    if part in ("k", "v"):
+                        pool_b += nb
+                    elif part in ("ks", "vs"):
+                        scales_b += nb
+                    else:                # stk/stv: per-slot staging
+                        lanes_b += nb
+            else:
+                lanes_b += self._leaf_bytes(
+                    jax.tree_util.tree_leaves(lane.state))
+        return {"lanes": lanes_b, "paged_pool": pool_b,
+                "scales": scales_b, "aux": aux_b,
+                "total": lanes_b + pool_b + scales_b + aux_b}
+
+    def state_bytes(self) -> int:
+        """Bytes pinned by decode state — the ``kv_state`` component of
+        the resource ledger (PR 15): lane/pool device buffers plus the
+        per-slot host-side scheduler state (see ``state_bytes_doc``)."""
+        return int(self.state_bytes_doc()["total"])
 
     def program_stats(self) -> Dict:
         """Compiled scheduler programs + per-program execution counts
@@ -872,22 +1399,40 @@ class ContinuousBatcher:
         keyed like the ``aot.generation_manifest`` entries
         (``prefill:b<batch>xp<bucket>@<lane>`` etc.)."""
         progs = {k: v for k, v in self._programs.items()
-                 if k and k[0] != "fns"}
+                 if k and k[0] not in ("fns", "pfns")}
         return {"count": len(progs),
                 "programs": dict(self._exec_counts)}
 
     def stats(self) -> Dict:
-        return {"slots_total": self.slots_total,
-                "active_slots": self.active,
-                "waiting": self.waiting,
-                "decode_steps": self.decode_steps,
-                "generated_tokens": self.generated_tokens,
-                "admitted": self.admitted,
-                "finished": self.finished,
-                "quarantined": self.quarantined,
-                "shed": self.shed,
-                "compiles": self.compiles,
-                "lanes": [{"bucket": lane.bucket,
-                           "max_active": lane.max_active,
-                           "active": lane.active}
-                          for lane in self._lanes]}
+        d = {"slots_total": self.slots_total,
+             "active_slots": self.active,
+             "waiting": self.waiting,
+             "decode_steps": self.decode_steps,
+             "generated_tokens": self.generated_tokens,
+             "admitted": self.admitted,
+             "finished": self.finished,
+             "quarantined": self.quarantined,
+             "shed": self.shed,
+             "compiles": self.compiles,
+             "lanes": [{"bucket": lane.bucket,
+                        "max_active": lane.max_active,
+                        "active": lane.active}
+                       for lane in self._lanes]}
+        if self._pool is not None:
+            pool = {"blocks": self._pool.n_blocks,
+                    "block_len": self._pool.block_len,
+                    "free_blocks": self._pool.free_blocks,
+                    "used_blocks": self._pool.used_blocks,
+                    "occupancy": round(
+                        self._pool.used_blocks
+                        / max(1, self._pool.n_blocks), 4),
+                    "kv_quant": self.gen.kv_quant,
+                    "exhausted": self.pool_exhausted}
+            if self._prefix is not None:
+                ps = self._prefix.stats()
+                pool.update({"prefix_entries": ps["entries"],
+                             "prefix_hits": ps["hits"],
+                             "prefix_misses": ps["misses"],
+                             "prefix_evictions": ps["evictions"]})
+            d["pool"] = pool
+        return d

@@ -103,6 +103,12 @@ def pytest_configure(config):
         "priority shedding, brownout ladder, retry budget); the "
         "acceptance test floods a live mixed-priority fleet through the "
         "gateway, so they carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "kvcache: paged-KV tests (PR 18: block pool, prefix sharing, "
+        "int8 KV lanes, paged attention kernel parity); they compile "
+        "paged prefill/decode programs and run the kernel in interpret "
+        "mode on CPU, so they carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -121,6 +127,7 @@ QUANT_DEFAULT_TIMEOUT_S = 120.0
 FORENSICS_DEFAULT_TIMEOUT_S = 300.0
 ROLLOUT_DEFAULT_TIMEOUT_S = 300.0
 OVERLOAD_DEFAULT_TIMEOUT_S = 300.0
+KVCACHE_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -158,6 +165,8 @@ def pytest_runtest_call(item):
             seconds = ROLLOUT_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("overload") is not None:
             seconds = OVERLOAD_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("kvcache") is not None:
+            seconds = KVCACHE_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

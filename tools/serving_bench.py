@@ -852,6 +852,225 @@ def _run_generate(args):
     return out
 
 
+# -- paged-KV generation A/B (PR 18) ------------------------------------------
+
+def _paged_gen_requests(args, block_len):
+    """Shared-prompt generation mix for the paged A/B: half the requests
+    carry one common system prefix (>= one full pool block, so the paged
+    arm's prefix index has resident pages to share), the rest are unique
+    prompts; budgets cycle through the usual short-dominant mixture."""
+    g = np.random.default_rng(7)
+    budgets = [int(b) for b in args.gen_budgets.split(",") if b.strip()]
+    pmax = args.gen_prompt_max
+    sys_len = min(max(block_len * 2, 4), pmax - 1)
+    system = g.integers(1, args.gen_vocab, sys_len).astype(np.int32)
+    reqs = []
+    for i in range(args.gen_requests):
+        if i % 2 == 0:
+            tail = g.integers(1, args.gen_vocab,
+                              int(g.integers(1, pmax - sys_len + 1)))
+            prompt = np.concatenate([system, tail.astype(np.int32)])
+        else:
+            prompt = g.integers(1, args.gen_vocab,
+                                int(g.integers(2, pmax + 1))).astype(np.int32)
+        reqs.append((f"pg-{i}", prompt, budgets[i % len(budgets)]))
+    return reqs, budgets
+
+
+def _run_generate_paged(args):
+    """Paged-vs-monolithic KV A/B (`--generate --paged on`, PR 18).
+
+    Both arms run the SAME ContinuousBatcher scheduler over the same
+    TransformerLM weights and the same shared-prompt workload; the only
+    difference is the KV residency model — per-slot monolithic lanes vs
+    the fixed block pool with prefix sharing (and, with `--kv-quant
+    int8`, int8 pool blocks dequantized in-kernel at decode).  Laps are
+    interleaved (the PR 3/7 methodology: container cpu throttling
+    drifts, so back-to-back phases compare different machines) and both
+    arms must run the measured laps with ZERO XLA compiles.
+
+    Parity contract (the PR 18 acceptance): in float mode the paged arm
+    reproduces the monolithic token stream EXACTLY, request by request.
+    In int8 mode first tokens still match (prefill is float in both
+    arms) but decode reads quantized KV, so sequences may diverge after
+    some prefix; the report carries `first_token_match` (asserted) and
+    `matched_prefix_fraction` (documented tolerance, not asserted —
+    argmax chains amplify one flipped token into total divergence).
+
+    HBM evidence comes from the resource ledger (`state_bytes_doc`),
+    not a model: with int8+paged the per-resident-slot KV footprint
+    must be >= 2x smaller than the monolithic float arm's."""
+    import jax
+    from analytics_zoo_tpu.inference import aot
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.textmodels import TransformerLM
+    from analytics_zoo_tpu.serving.generate import (ContinuousBatcher,
+                                                    GenerationParams,
+                                                    GenRequest)
+
+    block_len = args.gen_block_len
+    reqs, budgets = _paged_gen_requests(args, block_len)
+    max_budget = max(budgets)
+    slots = args.gen_slots
+    cap = 1
+    while cap < args.gen_prompt_max + max_budget:
+        cap *= 2
+
+    model = TransformerLM(vocab_size=args.gen_vocab, hidden=args.gen_hidden,
+                          n_head=4 if args.gen_hidden % 4 == 0 else 2,
+                          n_layers=2, max_len=cap)
+    params = model.build(jax.random.PRNGKey(0))
+    im = InferenceModel().do_load_model(model, params, {})
+    gen_kw = dict(max_active_slots=slots, max_tokens=max_budget,
+                  max_prompt_len=args.gen_prompt_max,
+                  stream_interval=0, decode_quantum=args.gen_quantum)
+    paged = ContinuousBatcher(im, GenerationParams(
+        paged=True, kv_quant=args.kv_quant, block_len=block_len,
+        prefix_cache=True, **gen_kw))
+    mono = ContinuousBatcher(im, GenerationParams(**gen_kw))
+    warm_p = paged.warm()
+    warm_m = mono.warm()
+
+    def run_lap(batcher, lap, tag):
+        t0 = time.perf_counter()
+        for rid, prompt, budget in reqs:
+            assert batcher.submit(GenRequest(f"{tag}{lap}-{rid}", prompt,
+                                             max_tokens=budget)), \
+                f"submit rejected {rid}"
+        done, ttfts, peak = {}, [], 0
+        while len(done) < len(reqs):
+            events = batcher.step()
+            # finished rows free INSIDE step(): last_boundary (rows that
+            # decoded this boundary) is the real residency high-water
+            peak = max(peak, len(batcher.last_boundary), batcher.active)
+            for ev in events:
+                if ev.kind == "first_token":
+                    ttfts.append(ev.ttft_s)
+                elif ev.kind == "finish":
+                    done[ev.rid] = list(ev.tokens)
+                elif ev.kind in ("shed", "quarantine"):
+                    raise AssertionError(
+                        f"{ev.kind} on {ev.rid}: {ev.error}")
+        wall = time.perf_counter() - t0
+        toks = {rid: done[f"{tag}{lap}-{rid}"] for rid, _, _ in reqs}
+        for rid, _, budget in reqs:
+            assert len(toks[rid]) == budget, \
+                f"{rid}: {len(toks[rid])} != budget {budget}"
+        return toks, sum(len(t) for t in toks.values()), wall, ttfts, peak
+
+    # warm lap each arm (absorbs the admission-batch program mix), then
+    # the zero-compile clock starts
+    run_lap(paged, 0, "WP")
+    run_lap(mono, 0, "WM")
+    c0 = aot.COMPILE_STATS.snapshot()
+    p_laps, m_laps, p_ttfts, m_ttfts = [], [], [], []
+    p_peak = m_peak = 0
+    p_toks = m_toks = None
+    for lap in range(1, max(1, args.gen_laps) + 1):
+        p_toks, p_n, p_wall, pt, pk = run_lap(paged, lap, "P")
+        p_laps.append(p_n / p_wall)
+        p_ttfts += pt
+        p_peak = max(p_peak, pk)
+        m_toks, m_n, m_wall, mt, mk = run_lap(mono, lap, "M")
+        m_laps.append(m_n / m_wall)
+        m_ttfts += mt
+        m_peak = max(m_peak, mk)
+        assert p_n == m_n, "A/B token counts diverged"
+    c1 = aot.COMPILE_STATS.snapshot()
+    steady = int(c1["compile_requests"] - c0["compile_requests"])
+    assert steady == 0, \
+        f"steady-state laps performed {steady} XLA compile(s)"
+
+    # -- token parity ----------------------------------------------------
+    first_match = matched = total = 0
+    exact_rows = 0
+    for rid, _, _ in reqs:
+        a, b = p_toks[rid], m_toks[rid]
+        first_match += int(a[0] == b[0])
+        n = 0
+        while n < len(a) and a[n] == b[n]:
+            n += 1
+        matched += n
+        total += len(a)
+        exact_rows += int(n == len(a))
+    first_frac = first_match / len(reqs)
+    parity = {"exact_rows": exact_rows, "rows": len(reqs),
+              "first_token_match": round(first_frac, 4),
+              "matched_prefix_fraction": round(matched / total, 4)}
+    if args.kv_quant == "off":
+        assert exact_rows == len(reqs), \
+            f"float paged mode must match monolithic exactly: {parity}"
+    else:
+        assert first_frac >= 0.9, \
+            f"int8 first-token agreement below tolerance: {parity}"
+
+    # -- ledger HBM ------------------------------------------------------
+    kv_p = paged.state_bytes_doc()
+    kv_m = mono.state_bytes_doc()
+    hbm_ratio = kv_m["total"] / max(1, kv_p["total"])
+    if args.kv_quant == "int8":
+        assert hbm_ratio >= 2.0, \
+            f"int8+paged must halve KV bytes per resident slot: " \
+            f"mono={kv_m['total']} paged={kv_p['total']}"
+
+    pool = paged.stats()["pool"]
+    lookups = pool["prefix_hits"] + pool["prefix_misses"]
+    hit_rate = pool["prefix_hits"] / max(1, lookups)
+    assert pool["prefix_hits"] > 0, \
+        f"shared-prompt mix produced no prefix-cache hits: {pool}"
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    def pcts(ttfts):
+        ttfts = sorted(ttfts)
+        if not ttfts:
+            return None, None
+        p = lambda q: round(1e3 * ttfts[min(len(ttfts) - 1,  # noqa: E731
+                                            int(q * len(ttfts)))], 2)
+        return p(0.50), p(0.99)
+
+    p50_p, p99_p = pcts(p_ttfts)
+    p50_m, p99_m = pcts(m_ttfts)
+    paged_doc = {
+        "tokens_per_sec": round(median(p_laps), 2),
+        "laps_tokens_per_sec": [round(x, 2) for x in p_laps],
+        "ttft_p50_ms": p50_p, "ttft_p99_ms": p99_p,
+        "peak_active_slots": p_peak,
+        "kv_state": kv_p,
+        "pool": pool,
+        "prefix_hit_rate": round(hit_rate, 4),
+        "warm_programs": warm_p["programs"],
+        "steady_compile_requests": steady,
+    }
+    mono_doc = {
+        "tokens_per_sec": round(median(m_laps), 2),
+        "laps_tokens_per_sec": [round(x, 2) for x in m_laps],
+        "ttft_p50_ms": p50_m, "ttft_p99_ms": p99_m,
+        "peak_active_slots": m_peak,
+        "kv_state": kv_m,
+        "warm_programs": warm_m["programs"],
+        "steady_compile_requests": steady,
+    }
+    return {
+        "mode": "generate-paged",
+        "kv_quant": args.kv_quant,
+        "block_len": block_len,
+        "requests": len(reqs),
+        "budgets": budgets,
+        "slots": slots,
+        "decode_quantum": args.gen_quantum,
+        "paged": paged_doc,
+        "monolithic": mono_doc,
+        "token_parity": parity,
+        "hbm_ratio": round(hbm_ratio, 2),
+        "speedup_tokens_per_sec": round(
+            paged_doc["tokens_per_sec"]
+            / max(mono_doc["tokens_per_sec"], 1e-9), 2),
+    }
+
+
 # -- elastic-serving load-swing A/B (PR 10) -----------------------------------
 
 def _swing_model(max_batch):
@@ -1945,6 +2164,24 @@ def main(argv=None):
                          "lap pairs (medians reported) — this container's "
                          "cpu throttling drifts, so back-to-back phases "
                          "would compare different machines")
+    ap.add_argument("--paged", choices=("on", "off"), default="off",
+                    help="PR 18 paged-KV A/B (with --generate): paged "
+                         "block-pool arm (prefix sharing on) vs the "
+                         "monolithic per-slot-lane arm, same scheduler "
+                         "and TransformerLM weights, interleaved laps.  "
+                         "Reports tokens_per_sec, TTFT p50/p99, resident "
+                         "slots, prefix-cache hit rate and ledger-"
+                         "measured KV HBM bytes per arm; asserts zero "
+                         "steady-state compiles both sides and exact "
+                         "token parity in float mode")
+    ap.add_argument("--kv-quant", choices=("off", "int8"), default="off",
+                    help="paged A/B: KV pool precision.  int8 stores "
+                         "pool blocks quantized with per-(block, head) "
+                         "scales (dequantized in-kernel at decode) and "
+                         "asserts the ledger KV ratio vs the float "
+                         "monolithic arm is >= 2x")
+    ap.add_argument("--gen-block-len", type=int, default=16,
+                    help="paged A/B: tokens per KV pool block (pow-2)")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -2050,6 +2287,35 @@ def main(argv=None):
         out = _run_cold_start(args)
         print(json.dumps({k: v for k, v in out.items()
                           if k not in ("cold", "warm")}))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
+    if args.generate and args.paged == "on":
+        # PR 18 paged-KV A/B: builds its own TransformerLM (the paged
+        # decode API lives there), so --model is ignored
+        if args.smoke:
+            # tier-1 smoke: tiny model + short shared-prompt workload —
+            # checks parity/sharing/ledger, not this container's speed.
+            # One longer budget keeps the lane capacity realistic (the
+            # int8 staging buffers are O(slots * block_len) FIXED cost,
+            # so a toy-short lane would understate the pool ratio)
+            args.gen_requests = min(args.gen_requests, 10)
+            args.gen_budgets = "2,3,6,33"
+            args.gen_vocab, args.gen_hidden = 64, 32
+            args.gen_prompt_max = min(args.gen_prompt_max, 24)
+            args.gen_block_len = min(args.gen_block_len, 8)
+            args.gen_slots = min(args.gen_slots, 4)
+            args.gen_laps = 1
+        out = _run_generate_paged(args)
+        print(json.dumps(out))
         if args.json_path:
             doc = {"bench": "serving_bench", "ts": time.time(),
                    "config": {k: v for k, v in vars(args).items()
