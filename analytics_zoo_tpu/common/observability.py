@@ -165,6 +165,20 @@ class _Metric:
         with self._lock:
             self._children.pop(key, None)
 
+    def bare(self):
+        """The unlabeled ``()`` child of a LABELED metric.  It renders
+        without braces — legal in the text exposition, where a family may
+        carry an aggregate sample next to its labeled series — so a metric
+        can keep its historical unlabeled sample while growing labeled
+        dimensions (PR 19: ``serving_slo_burn_rate`` stays the fleet-global
+        bare sample, ``serving_slo_burn_rate{tenant=...}`` are the
+        per-tenant views)."""
+        with self._lock:
+            child = self._children.get(())
+            if child is None:
+                child = self._children[()] = self._make_child()
+            return child
+
     def _default(self):
         if self.labelnames:
             raise ValueError(
@@ -327,6 +341,33 @@ class _HistogramChild:
             self._sum += v * n
             self._count += n
             self._samples.extend([v] * n)
+
+    def observe_many(self, values: Sequence[float]) -> None:
+        """Record a batch of distinct values under ONE lock acquisition.
+        The per-tenant request-latency hop sits on the engine write
+        worker's critical path; charging a flush's records one
+        observe() at a time pays the lock and reservoir churn per
+        record instead of per flush."""
+        if not values:
+            return
+        nb = len(self._buckets)
+        idxs, vals = [], []
+        for v in values:
+            v = float(v)
+            i = 0
+            for i, ub in enumerate(self._buckets):
+                if v <= ub:
+                    break
+            else:
+                i = nb
+            idxs.append(i)
+            vals.append(v)
+        with self._lock:
+            for i in idxs:
+                self._counts[i] += 1
+            self._sum += sum(vals)
+            self._count += len(vals)
+            self._samples.extend(vals)
 
     # StageStats-compatible alias: the engine's stage timers call record()
     record = observe
@@ -960,28 +1001,42 @@ class SloTracker:
     ``serving/fleet.py``)."""
 
     def __init__(self, registry: MetricsRegistry, latency_ms: float,
-                 window_s: float = 60.0, target: float = 0.99):
+                 window_s: float = 60.0, target: float = 0.99,
+                 tenant: Optional[str] = None):
         self.latency_ms = float(latency_ms)
         self.window_s = max(1.0, float(window_s))
         self.target = min(max(float(target), 0.0), 0.999999)
-        self._m_violations = registry.counter(
-            "serving_slo_violations_total",
-            "Latency-SLO violations, attributed to the dominant stage",
-            labels=("stage",))
-        # materialized at zero for the stages every deployment has, so the
-        # series are scrapeable before the first violation
-        for stage in ("queue_wait", "predict", "write", "pipeline",
-                      "decode"):
-            self._m_violations.labels(stage=stage).inc(0)
-        self._g_burn = registry.gauge(
+        self.tenant = tenant
+        # The burn-rate family is registered labeled; the fleet-global
+        # tracker publishes through the BARE child (exposition unchanged:
+        # ``serving_slo_burn_rate 2.0``), per-tenant trackers (PR 19)
+        # through ``{tenant=...}`` children of the same family.
+        g = registry.gauge(
             "serving_slo_burn_rate",
             "Error-budget burn rate over the SLO window "
-            "(1.0 = spending the budget exactly as it accrues)")
+            "(1.0 = spending the budget exactly as it accrues)",
+            labels=("tenant",))
+        self._g_burn = g.labels(tenant=tenant) if tenant else g.bare()
         self._g_burn.set(0.0)
-        self._g_objective = registry.gauge(
-            "serving_slo_latency_objective_ms",
-            "Configured latency objective")
-        self._g_objective.set(self.latency_ms)
+        if tenant is None:
+            self._m_violations = registry.counter(
+                "serving_slo_violations_total",
+                "Latency-SLO violations, attributed to the dominant stage",
+                labels=("stage",))
+            # materialized at zero for the stages every deployment has, so
+            # the series are scrapeable before the first violation
+            for stage in ("queue_wait", "predict", "write", "pipeline",
+                          "decode"):
+                self._m_violations.labels(stage=stage).inc(0)
+            self._g_objective = registry.gauge(
+                "serving_slo_latency_objective_ms",
+                "Configured latency objective")
+            self._g_objective.set(self.latency_ms)
+        else:
+            # per-tenant views share the fleet-global stage attribution;
+            # registering a second {stage=} counter here would double-count
+            self._m_violations = None
+            self._g_objective = None
         self._window: deque = deque()      # (monotonic ts, violated: bool)
         self._lock = threading.Lock()
 
@@ -1017,7 +1072,8 @@ class SloTracker:
             valid = {k: float(v) for k, v in (stages or {}).items()
                      if isinstance(v, (int, float)) and v == v and v >= 0}
             charged = max(valid, key=valid.get) if valid else "unattributed"
-            self._m_violations.labels(stage=charged).inc()
+            if self._m_violations is not None:
+                self._m_violations.labels(stage=charged).inc()
         with self._lock:
             self._window.append((now, violated))
             cutoff = now - self.window_s

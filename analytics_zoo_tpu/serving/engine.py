@@ -269,6 +269,8 @@ class _InFlight(NamedTuple):
     traces: Optional[List]
     t_read: Optional[float]
     t_dispatch: float
+    tenants: Optional[List] = None  # per-row tenant (PR 19 attribution);
+    #                                 None entries = legacy/unattributed
 
 
 class _ResultHandle:
@@ -411,7 +413,8 @@ class ServingParams:
                  model_version: Optional[str] = None,
                  faults=None,
                  admission=None,
-                 brownout=None):
+                 brownout=None,
+                 metering=None):
         self.batch_size = batch_size
         self.top_n = top_n
         self.poll_timeout_s = poll_timeout_s
@@ -566,6 +569,19 @@ class ServingParams:
         # batch_max_tokens); needs `serving_slo` for its input signal.
         self.admission = admission if isinstance(admission, dict) else None
         self.brownout = brownout if isinstance(brownout, dict) else None
+        # usage metering & attribution (PR 19).  `metering`: None/True =
+        # on with defaults ({tenant=,model=} labelled series, per-interval
+        # usage journal deltas drained by the manager, per-tenant SLO
+        # views); a dict configures it ({"enabled": bool, "max_tenants":
+        # N, "slo_objectives": {tenant: {latency_ms, ...}}}); False turns
+        # the labelled surface off (the pre-PR-19 unlabelled series — the
+        # metering-off arm of `serving_bench --metering-overhead`).
+        if isinstance(metering, dict):
+            self.metering = metering
+        elif metering is None:
+            self.metering = {}
+        else:
+            self.metering = {} if metering else {"enabled": False}
 
     @classmethod
     def from_dict(cls, p: Dict) -> "ServingParams":
@@ -624,7 +640,8 @@ class ServingParams:
             model_version=p.get("model_version"),
             faults=p.get("faults"),
             admission=p.get("admission"),
-            brownout=p.get("brownout"))
+            brownout=p.get("brownout"),
+            metering=p.get("metering"))
 
     @staticmethod
     def from_yaml(path: str) -> "ServingParams":
@@ -836,8 +853,25 @@ class ClusterServing:
         self._e2e = reg.histogram(
             "serving_e2e_seconds",
             "Per-record latency from read_batch return to result written")
-        self._m_records = reg.counter(
-            "serving_records_total", "Records served (results written)")
+        # usage metering & attribution (PR 19): the meter owns the
+        # {tenant=,model=} labelled series (serving_records_total,
+        # serving_generated_tokens_total, serving_sheds_total,
+        # serving_device_seconds_total, serving_request_seconds), the
+        # per-interval usage-journal deltas the manager drains next to
+        # spans/events, and the per-tenant SLO burn views.  With
+        # metering {"enabled": False} it registers the pre-PR-19
+        # unlabelled records/tokens series instead (the off arm of
+        # `serving_bench --metering-overhead`).
+        from analytics_zoo_tpu.serving.metering import UsageMeter
+        _adm_tenants = ()
+        if isinstance(self.params.admission, dict) and \
+                isinstance(self.params.admission.get("tenants"), dict):
+            _adm_tenants = tuple(self.params.admission["tenants"])
+        self.meter = UsageMeter(
+            reg, model=self.model_version,
+            cfg=self.params.metering,
+            tenants_configured=_adm_tenants,
+            slo_defaults=self.params.serving_slo)
         self._m_quarantined = reg.counter(
             "serving_quarantined_total", "Records dead-lettered, by stage",
             labels=("stage",))
@@ -929,10 +963,6 @@ class ClusterServing:
                 "serving_decode_steps_total",
                 "Decode-step boundaries executed by the token scheduler")
             self._m_decode_steps.inc(0)
-            self._m_gen_tokens = reg.counter(
-                "serving_generated_tokens_total",
-                "Tokens generated across all requests")
-            self._m_gen_tokens.inc(0)
             self._m_ttft = reg.histogram(
                 "serving_time_to_first_token_seconds",
                 "Request admission to first generated token")
@@ -1228,20 +1258,28 @@ class ClusterServing:
                        trace_id=tid, uri=rid)
 
     def _slo_observe(self, rid, e2e_s: float,
-                     stages: Optional[Dict] = None) -> None:
+                     stages: Optional[Dict] = None,
+                     tenant: Optional[str] = None) -> float:
         """Feed one completed record to the SLO tracker (no-op when no
-        ``serving_slo`` block is configured).  Queue-wait measured at
-        claim is folded in both as a stage and into the judged latency,
-        so "we missed the SLO queueing" is attributable."""
-        if self._slo is None:
-            self._qwait.pop(rid, None)
-            return
+        ``serving_slo`` block is configured) and the per-tenant burn
+        view.  Queue-wait measured at claim is folded in both as a
+        stage and into the judged latency, so "we missed the SLO
+        queueing" is attributable.  Returns the folded e2e so the
+        caller can charge ``serving_request_seconds`` batched per
+        (tenant, flush) — the histogram hop is the only per-record
+        metering cost left on the write worker, so it's amortized."""
         qwait = self._qwait.pop(rid, None)
         stages = dict(stages or {})
         if qwait is not None:
             stages["queue_wait"] = qwait
             e2e_s = float(e2e_s) + qwait
-        self._slo.observe(e2e_s, stages)
+        # per-tenant burn views share the fleet objective unless the
+        # metering block names per-tenant objectives (no objective
+        # anywhere = no view; the meter no-ops)
+        self.meter.slo_observe(tenant, e2e_s, stages)
+        if self._slo is not None:
+            self._slo.observe(e2e_s, stages)
+        return float(e2e_s)
 
     # -- lease lifecycle (PR 5 horizontal replicas) --------------------------
     def _ack(self, rids: List[str]) -> None:
@@ -1384,21 +1422,36 @@ class ClusterServing:
                            self.queue.put_result, rid, value)
 
     def _flush_results(self, pairs: List[Tuple[str, Dict]],
-                       tmap: Optional[Dict] = None) -> int:
+                       tmap: Optional[Dict] = None,
+                       tenmap: Optional[Dict] = None) -> int:
         """Write one micro-batch of results in a single backend round-trip
         (`queue.put_results`), behind the same RetryPolicy + CircuitBreaker
         as single writes.  When the batch write fails (mid-way or wholesale),
         fall back to per-record writes: `put_result` is idempotent per key,
         so re-writing an already-committed pair cannot duplicate a result,
-        and only the records that individually fail are quarantined."""
+        and only the records that individually fail are quarantined.
+
+        Records-served attribution (PR 19) is charged HERE — the one
+        choke point both planes flush through — so exactly the records
+        whose results were committed are billed, per tenant, on both the
+        batched and the degraded per-record path."""
         if not pairs:
             return 0
+        tenmap = tenmap or {}
         try:
             self._breaker.call(self._write_retry.call,
                                self.queue.put_results, pairs)
             # results durable: release the claims (at-least-once becomes
             # exactly-one-result here)
             self._ack([rid for rid, _ in pairs])
+            # one charge per tenant per flush, not per record: the meter
+            # hop is on the write worker's critical path
+            by_tenant: Dict[Optional[str], int] = {}
+            for rid, _ in pairs:
+                ten = tenmap.get(rid)
+                by_tenant[ten] = by_tenant.get(ten, 0) + 1
+            for ten, n in by_tenant.items():
+                self.meter.records(ten, n)
             return len(pairs)
         except Exception as e:  # noqa: BLE001 — batch path down: degrade
             if not isinstance(e, CircuitBreakerOpen):
@@ -1413,6 +1466,7 @@ class ClusterServing:
                     self._put_result(rid, value)
                     written.append(rid)
                     n += 1
+                    self.meter.records(tenmap.get(rid))
                 except Exception as rec_exc:  # noqa: BLE001 — record down
                     # deliberate shed-don't-block tradeoff: when the result
                     # store is down past the retry budget the computed value
@@ -1420,13 +1474,15 @@ class ClusterServing:
                     # re-enqueue) instead of stalling the write worker
                     # behind an unbounded blocking retry
                     self._quarantine(rid, "put_result", rec_exc,
-                                     trace_id=(tmap or {}).get(rid))
+                                     trace_id=(tmap or {}).get(rid),
+                                     tenant=tenmap.get(rid))
             self._ack(written)
             return n
 
     def _quarantine(self, rid, stage: str, exc: BaseException,
                     record: Optional[Dict] = None,
-                    trace_id: Optional[str] = None):
+                    trace_id: Optional[str] = None,
+                    tenant: Optional[str] = None):
         """Per-record fault isolation: the poisoned record gets an error
         RESULT (client unblocks and sees the failure) plus a dead-letter
         entry; the rest of its micro-batch proceeds untouched.  The span
@@ -1434,15 +1490,19 @@ class ClusterServing:
         quarantine is diagnosable from the trace alone."""
         self.dead_lettered += 1
         self._m_quarantined.labels(stage=stage).inc()
+        if tenant is None and record is not None:
+            tenant = record.get("tenant")
+        self.meter.sheds(tenant)       # attribution (PR 19): who lost it
         msg = f"{stage}: {type(exc).__name__}: {exc}"
         if trace_id is None and record is not None:
             trace_id = record.get("trace_id")
         now = time.monotonic()
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
-                         error=msg)
+                         error=msg,
+                         attrs=({"tenant": tenant} if tenant else None))
         logger.warning("serving: quarantining record %r (%s)", rid, msg)
         self._event("quarantine", rid=str(rid), stage=stage,
-                    error=msg[:200], trace_id=trace_id)
+                    error=msg[:200], trace_id=trace_id, tenant=tenant)
         handled = False
         try:
             self._dead_breaker.call(self.queue.put_error, rid, msg,
@@ -1472,7 +1532,8 @@ class ClusterServing:
     def _shed_expired(self, rid, rec: Optional[Dict],
                       deadline_ns: Optional[int] = None,
                       stage: str = "read",
-                      trace_id: Optional[str] = None) -> bool:
+                      trace_id: Optional[str] = None,
+                      tenant: Optional[str] = None) -> bool:
         """True when the record's enqueue-stamped `deadline_ns` has passed:
         the client gets a `deadline-exceeded` error result and the record
         never occupies a predict slot.  The shed is recorded as a zero-width
@@ -1495,16 +1556,21 @@ class ClusterServing:
             return True
         if not expired:
             return False
-        if trace_id is None and rec is not None:
-            trace_id = rec.get("trace_id")
-        self._shed_terminal(rid, stage=stage, trace_id=trace_id)
+        if rec is not None:
+            if trace_id is None:
+                trace_id = rec.get("trace_id")
+            if tenant is None and isinstance(rec.get("tenant"), str):
+                tenant = rec.get("tenant")
+        self._shed_terminal(rid, stage=stage, trace_id=trace_id,
+                            tenant=tenant)
         return True
 
     def _shed_terminal(self, rid, stage: str = "read",
                        trace_id: Optional[str] = None,
                        error: str = "deadline-exceeded: budget elapsed "
                                     "before predict",
-                       extra: Optional[Dict] = None) -> None:
+                       extra: Optional[Dict] = None,
+                       tenant: Optional[str] = None) -> None:
         """Terminal shed bookkeeping: error marker written (best-effort),
         claim released, counters/span recorded.  Shared by the deadline
         gates and the generation scheduler's step-boundary sheds;
@@ -1512,11 +1578,14 @@ class ClusterServing:
         tokens must survive the overwrite of the streamed partial)."""
         self.shed += 1
         self._m_shed.inc()
+        self.meter.sheds(tenant)       # attribution (PR 19): who lost it
         now = time.monotonic()
         self._span(stage, now, now, trace_id=trace_id, uri=rid,
-                         error=error)
+                         error=error,
+                         attrs=({"tenant": tenant} if tenant else None))
         logger.info("serving: shedding expired record %r", rid)
-        self._event("shed", rid=str(rid), stage=stage, trace_id=trace_id)
+        self._event("shed", rid=str(rid), stage=stage, trace_id=trace_id,
+                    tenant=tenant)
         result = {"error": error}
         if extra:
             result.update(extra)
@@ -1543,13 +1612,15 @@ class ClusterServing:
         if not isinstance(rec, dict):
             return False
         trace_id = rec.get("trace_id")
+        tenant = rec.get("tenant") \
+            if isinstance(rec.get("tenant"), str) else None
         if to_shed:
             prio = normalize_priority(rec.get("priority"))
             if prio in to_shed:
                 self._shed_terminal(
                     rid, stage="claim", trace_id=trace_id,
                     error=f"shed: {prio} class dropped under overload "
-                          f"pressure")
+                          f"pressure", tenant=tenant)
                 return True
         dl = rec.get("deadline_ns")
         if dl is not None and self._predict_ewma_s:
@@ -1567,7 +1638,7 @@ class ClusterServing:
                 self._shed_terminal(
                     rid, stage="claim", trace_id=trace_id,
                     error="deadline-unmeetable: estimated queue wait "
-                          "exceeds the remaining budget")
+                          "exceeds the remaining budget", tenant=tenant)
                 return True
         return False
 
@@ -1761,6 +1832,7 @@ class ClusterServing:
         if not batch:
             return None       # stream empty (drain may exit on this)
         self._stages["read"].record(t_read - t0)
+        bytes_by_tenant: Dict[Optional[str], int] = {}
         for rid, rec in batch:
             # claim registry for the self-reclaim guard: while a record is
             # in OUR pipeline the reclaim sweep must not mistake it for a
@@ -1787,17 +1859,25 @@ class ClusterServing:
                     if isinstance(raw, (str, bytes, bytearray)) else 0
             self._m_wire_bytes.labels(
                 format=_wire_fmt_label(rec)).inc(nbytes)
+            # usage attribution (PR 19): ingress bytes charged to the
+            # tenant the gateway stamped (legacy records -> "unknown"),
+            # accumulated locally and charged once per read batch
+            ten = rec.get("tenant")
+            ten = ten if isinstance(ten, str) else None
+            bytes_by_tenant[ten] = bytes_by_tenant.get(ten, 0) + nbytes
             self._span("read", t0, t_read,
                              trace_id=rec["trace_id"], uri=rid)
+        for ten, nb in bytes_by_tenant.items():
+            self.meter.wire_bytes(ten, nb)
         # priority-ordered claim and shed (PR 17): interactive records
         # stage first; under pressure the lowest classes are shed before
         # they spend a predict slot, and a record that can no longer make
         # its deadline through the current backlog is dropped at claim
         # instead of timing out mid-pipeline.  Opt-in (self._armor) — an
         # unarmored deployment keeps the exact legacy claim path.
+        from analytics_zoo_tpu.serving.admission import (
+            PRIORITIES, normalize_priority, normalize_tenant, shed_classes)
         if self._armor:
-            from analytics_zoo_tpu.serving.admission import (
-                PRIORITIES, normalize_priority, shed_classes)
             rank = {p: i for i, p in enumerate(PRIORITIES)}
             batch = sorted(
                 batch, key=lambda kv: rank[normalize_priority(
@@ -1840,12 +1920,18 @@ class ClusterServing:
                 # record untyped — the scheduler validates/clamps values
                 meta = rec.get("gen")
                 meta = meta if isinstance(meta, dict) else None
+                # identity hoist (PR 19): tenant must outlive the record
+                # dict — batch formation, result docs, device-second
+                # apportioning and generation-token charging all read it
+                # off the meta.  None (not "unknown") for legacy records,
+                # so the meter owns the fold in exactly one place.
+                ten = rec.get("tenant")
+                meta = dict(meta or {})
+                meta["_tenant"] = normalize_tenant(ten) \
+                    if isinstance(ten, str) and ten else None
                 if self._armor:
                     # the brownout clamp (_submit_group) needs the class
                     # after the record dict is gone: ride it on the meta
-                    from analytics_zoo_tpu.serving.admission import (
-                        normalize_priority)
-                    meta = dict(meta or {})
                     meta["_priority"] = normalize_priority(
                         rec.get("priority"))
                 items.append((rid, item, rec.get("deadline_ns"),
@@ -1937,6 +2023,10 @@ class ClusterServing:
                        t_ready=None, metas=None) -> Optional[_InFlight]:
         """Deadline gate 2 + async dispatch.  Returns the in-flight handle
         for the write stage, or None when every record was shed."""
+        # per-row tenant identity hoisted at preprocess rides the metas;
+        # it must survive the gate-2 filter aligned with ids
+        tenants = [m.get("_tenant") if isinstance(m, dict) else None
+                   for m in (metas or [None] * len(ids))]
         # second deadline gate: a record can expire while staged behind a
         # slow predict — shed it here so the batch never wastes device time
         # on rows nobody is waiting for
@@ -1944,7 +2034,8 @@ class ClusterServing:
             keep = [i for i, (rid, dl) in enumerate(zip(ids, deadlines))
                     if not self._shed_expired(
                         rid, None, deadline_ns=dl, stage="stage_wait",
-                        trace_id=traces[i] if traces else None)]
+                        trace_id=traces[i] if traces else None,
+                        tenant=tenants[i])]
             if not keep:
                 return None
             if len(keep) < len(ids):
@@ -1954,6 +2045,7 @@ class ClusterServing:
                     scales = scales[keep]
                 if traces is not None:
                     traces = [traces[i] for i in keep]
+                tenants = [tenants[i] for i in keep]
         t0 = time.monotonic()
         if t_ready is not None:
             self._stages["stage_wait"].record(t0 - t_ready)
@@ -1961,7 +2053,8 @@ class ClusterServing:
                 self._span("stage_wait", t_ready, t0,
                                  trace_id=tid, uri=rid)
         handle = self._dispatch_batch(tensors, scales)
-        return _InFlight(ids, tensors, scales, handle, traces, t_read, t0)
+        return _InFlight(ids, tensors, scales, handle, traces, t_read, t0,
+                         tenants)
 
     def _write_stage(self, inflight: _InFlight) -> int:
         """Block on the dispatched batch's host readback, postprocess per
@@ -1971,20 +2064,38 @@ class ClusterServing:
         the log2(n) poison-isolation cost."""
         ids, tensors, scales = inflight.ids, inflight.tensors, inflight.scales
         tmap = dict(zip(ids, inflight.traces or []))
+        tenmap = dict(zip(ids, inflight.tenants or []))
         try:
             chunks = [(ids, inflight.handle.result())]
         except Exception as e:  # noqa: BLE001 — device/input failure
             chunks = self._bisect_halves(ids, tensors, scales, e, tmap=tmap)
         t_done = time.monotonic()
-        self._stages["predict"].record(t_done - inflight.t_dispatch)
-        self._note_predict_time(t_done - inflight.t_dispatch)
+        predict_wall = t_done - inflight.t_dispatch
+        self._stages["predict"].record(predict_wall)
+        self._note_predict_time(predict_wall)
+        # device-second attribution (PR 19): the batch's measured dispatch
+        # wall time is apportioned by row count over the rows that were
+        # ACTUALLY dispatched — quarantined rows still burned the device,
+        # so their tenant is still charged (conservation: Σ == wall)
+        rows_by_tenant: Dict[Optional[str], int] = {}
+        for rid in ids:
+            ten = tenmap.get(rid)
+            rows_by_tenant[ten] = rows_by_tenant.get(ten, 0) + 1
+        self.meter.device_seconds(rows_by_tenant, predict_wall)
         pairs: List[Tuple[str, Dict]] = []
         for chunk_ids, probs in chunks:
             for rid, row in zip(chunk_ids, probs):
+                ten = tenmap.get(rid)
                 self._span("predict", inflight.t_dispatch, t_done,
-                                 trace_id=tmap.get(rid), uri=rid)
+                                 trace_id=tmap.get(rid), uri=rid,
+                                 attrs=({"tenant": ten} if ten else None))
                 try:
                     value = {"value": self.postprocess(np.asarray(row))}
+                    if ten is not None:
+                        # attribution rides the result doc so the gateway's
+                        # result_poll span can tag the tenant without a
+                        # side-channel lookup
+                        value["tenant"] = ten
                     if self.model_version is not None:
                         # version identity (PR 16): clients can tell WHICH
                         # published version answered — mid-rollout, a
@@ -2006,8 +2117,8 @@ class ClusterServing:
                     pairs.append((rid, value))
                 except Exception as e:  # noqa: BLE001 — per-record isolation
                     self._quarantine(rid, "postprocess", e,
-                                     trace_id=tmap.get(rid))
-        n = self._flush_results(pairs, tmap=tmap)
+                                     trace_id=tmap.get(rid), tenant=ten)
+        n = self._flush_results(pairs, tmap=tmap, tenmap=tenmap)
         now = time.monotonic()
         if pairs:
             self._stages["write"].record(now - t_done)
@@ -2020,11 +2131,17 @@ class ClusterServing:
             # queue_wait (folded in by _slo_observe), host pipeline
             # (preprocess + stage wait), device predict, result write
             t_read = inflight.t_read
+            e2e_by_tenant: Dict[Optional[str], List[float]] = {}
             for rid, _ in pairs:
-                self._slo_observe(rid, now - t_read, {
+                ten = tenmap.get(rid)
+                e2e = self._slo_observe(rid, now - t_read, {
                     "pipeline": max(inflight.t_dispatch - t_read, 0.0),
                     "predict": max(t_done - inflight.t_dispatch, 0.0),
-                    "write": max(now - t_done, 0.0)})
+                    "write": max(now - t_done, 0.0)},
+                    tenant=ten)
+                e2e_by_tenant.setdefault(ten, []).append(e2e)
+            for ten, vals in e2e_by_tenant.items():
+                self.meter.request_seconds_many(ten, vals)
         if n and self._cold_start_s is None:
             # construction-to-serving-capable, the number the autoscaler's
             # actuation lag is made of.  Stamped by whichever comes first:
@@ -2034,7 +2151,6 @@ class ClusterServing:
             self._cold_start_s = now - self._t_construct
             self._g_cold.set(self._cold_start_s)
         self.total_records += n
-        self._m_records.inc(n)
         dt = max(now - inflight.t_dispatch, 1e-9)
         if self._tb is not None:
             self._tb.add_scalar("Serving Throughput", n / dt,
@@ -2064,7 +2180,8 @@ class ClusterServing:
         stages on separate workers."""
         inflight = self._predict_stage(ids, tensors, scales=scales,
                                        deadlines=deadlines, traces=traces,
-                                       t_read=t_read, t_ready=t_ready)
+                                       t_read=t_read, t_ready=t_ready,
+                                       metas=metas)
         if inflight is None:
             return 0
         return self._write_stage(inflight)
@@ -2391,11 +2508,14 @@ class ClusterServing:
             req = GenRequest(rid, np.asarray(tensors[i]),
                              deadline_ns=deadlines[i],
                              trace_id=traces[i], t_read=group.t_read,
-                             max_tokens=mt)
+                             max_tokens=mt, tenant=meta.get("_tenant"))
             while not self._batcher.submit(req):
                 if self._stop.is_set():
                     return
-                self._handle_gen_events(self._batcher.step())
+                # full boundary bookkeeping (not a bare step): tokens
+                # emitted while the waiting room blocks are still charged
+                # to their tenants at the step boundary
+                self._gen_tick()
 
     def _gen_tick(self) -> None:
         """One decode-step boundary + its bookkeeping (stage timer,
@@ -2412,9 +2532,21 @@ class ClusterServing:
         # into (prefill -> first boundary -> ...).  This is the per-token
         # span volume trace_sample exists to govern; the span wrapper
         # applies the same head-sampling verdict fleet-wide.
-        for rid, tid, emitted in b.last_boundary:
+        rows_by_tenant: Dict[Optional[str], int] = {}
+        for rid, tid, emitted, ten in b.last_boundary:
+            attrs = {"tokens": emitted}
+            if ten is not None:
+                attrs["tenant"] = ten
             self._span("decode", t0, now, trace_id=tid, uri=rid,
-                       attrs={"tokens": emitted})
+                       attrs=attrs)
+            # generation tokens are charged per tenant at each step
+            # boundary (PR 19) — not at finish, so a long generation
+            # bills as it burns and a mid-flight shed stays charged
+            self.meter.tokens(ten, emitted)
+            rows_by_tenant[ten] = rows_by_tenant.get(ten, 0) + 1
+        # step wall time apportioned by slot occupancy at this boundary —
+        # the generation plane's device-seconds attribution
+        self.meter.device_seconds(rows_by_tenant, now - t0)
         steps = b.decode_steps
         if steps > self._last_steps:
             self._m_decode_steps.inc(steps - self._last_steps)
@@ -2487,12 +2619,15 @@ class ClusterServing:
                                    "finish_reason": ev.finish_reason}}
                 if ev.trace_id is not None:
                     value["trace_id"] = ev.trace_id
+                if ev.tenant is not None:
+                    value["tenant"] = ev.tenant
                 deliveries = self._redelivered.pop(ev.rid, None)
                 if deliveries:
                     value["deliveries"] = deliveries
                 pairs.append((ev.rid, value))
                 finals.append(ev)
-                self._m_gen_tokens.inc(len(ev.tokens))
+                # tokens were already charged per tenant at each step
+                # boundary (_gen_tick); nothing to double-count here
             elif ev.kind == "shed":
                 # an ACTIVE request's shed event carries its progress:
                 # say so ("before predict" would point triage at queueing
@@ -2509,16 +2644,17 @@ class ClusterServing:
                     extra = None
                 self._shed_terminal(ev.rid, stage="generate",
                                     trace_id=ev.trace_id, error=err,
-                                    extra=extra)
+                                    extra=extra, tenant=ev.tenant)
             elif ev.kind == "quarantine":
                 self._quarantine(ev.rid, "generate",
                                  RuntimeError(ev.error or "generation "
                                                           "failed"),
-                                 trace_id=ev.trace_id)
+                                 trace_id=ev.trace_id, tenant=ev.tenant)
         if not pairs:
             return
         tmap = {ev.rid: ev.trace_id for ev in finals}
-        n = self._flush_results(pairs, tmap=tmap)
+        tenmap = {ev.rid: ev.tenant for ev in finals}
+        n = self._flush_results(pairs, tmap=tmap, tenmap=tenmap)
         now = time.monotonic()
         for ev in finals:
             self._span("write", now, now, trace_id=ev.trace_id, uri=ev.rid)
@@ -2529,12 +2665,13 @@ class ClusterServing:
                 stages = {}
                 if ev.wall_s is not None:
                     stages["decode"] = max(float(ev.wall_s), 0.0)
-                self._slo_observe(ev.rid, now - ev.t_read, stages)
+                e2e = self._slo_observe(ev.rid, now - ev.t_read, stages,
+                                        tenant=ev.tenant)
+                self.meter.request_seconds(ev.tenant, e2e)
         if n and self._cold_start_s is None:
             self._cold_start_s = now - self._t_construct
             self._g_cold.set(self._cold_start_s)
         self.total_records += n
-        self._m_records.inc(n)
         self._maybe_trim()
 
     def _generate_loop(self):
@@ -2614,6 +2751,12 @@ class ClusterServing:
             return process_stats()
         except Exception:  # noqa: BLE001
             return {}
+
+    def drain_usage(self) -> List[Dict]:
+        """Per-interval usage deltas since the last drain (PR 19) — the
+        manager's 1 s loop appends them to the per-replica usage journal
+        next to the span/event spools."""
+        return self.meter.drain()
 
     def health(self) -> Dict:
         """Serving health surface (manager `status` / ops, `/healthz`):
@@ -2697,6 +2840,9 @@ class ClusterServing:
             # the health doc so fleet aggregation / FleetSignals can
             # consume them without a separate scrape
             h["slo"] = self._slo.snapshot()
+        # usage attribution (PR 19): cumulative per-tenant totals — fleet
+        # aggregation sums these across replicas for `manager metrics`
+        h["usage"] = self.meter.snapshot()
         if self._admission is not None:
             # overload armor (PR 17): admitted/rejected tallies the fleet
             # aggregation sums, and the per-reason split for triage

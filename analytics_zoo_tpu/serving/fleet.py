@@ -165,6 +165,11 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
                 "prefix_evictions": 0, "exhausted": 0,
                 "active_slots": 0}
     gen_pool_seen = False
+    # usage attribution (PR 19): per-tenant cumulative totals SUM across
+    # replicas (each meters its own traffic; the LB spreads one tenant
+    # over many replicas)
+    usage_tenants: Dict[str, Dict[str, float]] = {}
+    usage_seen = False
     for i, doc in sorted(docs.items()):
         served += int(doc.get("total_records", 0))
         shed += int(doc.get("shed", 0))
@@ -233,6 +238,15 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
                       "exhausted"):
                 gen_pool[k] += int(gp.get(k) or 0)
             gen_pool["active_slots"] += int(g.get("active_slots") or 0)
+        u = doc.get("usage") or {}
+        if isinstance(u.get("tenants"), dict):
+            usage_seen = True
+            for tenant, vals in u["tenants"].items():
+                dst = usage_tenants.setdefault(str(tenant), {})
+                if isinstance(vals, dict):
+                    for k, v in vals.items():
+                        if isinstance(v, (int, float)):
+                            dst[k] = dst.get(k, 0) + v
         pr = doc.get("process") or {}
         if isinstance(pr.get("rss_bytes"), (int, float)):
             proc_seen = True
@@ -284,6 +298,13 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             "process": dict(proc, cpu_seconds=round(proc["cpu_seconds"],
                                                     3))
             if proc_seen else None,
+            # usage attribution (PR 19): summed per-tenant totals (None
+            # when no replica reports a usage block — pre-PR-19 snapshots)
+            "usage": {t: {k: (round(v, 6) if isinstance(v, float)
+                              and v != int(v) else int(v))
+                          for k, v in sorted(vals.items())}
+                      for t, vals in sorted(usage_tenants.items())}
+            if usage_seen else None,
             "knobs": knobs}
 
 
@@ -363,6 +384,10 @@ def fleet_metrics(docs: Dict[int, Dict], lb: Optional[Dict] = None) -> Dict:
         out["resources"] = agg["resources"]
     if agg.get("process") is not None:
         out["process"] = agg["process"]
+    # usage attribution (PR 19): the fleet-summed per-tenant block —
+    # `manager metrics --all-replicas` shows who used what
+    if agg.get("usage") is not None:
+        out["usage"] = agg["usage"]
     summary = lb_summary(lb)
     if summary is not None:
         out["lb"] = summary

@@ -175,19 +175,21 @@ class GenRequest:
     """One admitted generation request (engine-internal)."""
 
     __slots__ = ("rid", "prompt", "deadline_ns", "trace_id", "t_read",
-                 "max_tokens", "t_submit")
+                 "max_tokens", "t_submit", "tenant")
 
     def __init__(self, rid: str, prompt: np.ndarray,
                  deadline_ns: Optional[int] = None,
                  trace_id: Optional[str] = None,
                  t_read: Optional[float] = None,
-                 max_tokens: Optional[int] = None):
+                 max_tokens: Optional[int] = None,
+                 tenant: Optional[str] = None):
         self.rid = rid
         self.prompt = prompt
         self.deadline_ns = deadline_ns
         self.trace_id = trace_id
         self.t_read = t_read
         self.max_tokens = max_tokens
+        self.tenant = tenant
         self.t_submit = time.monotonic()
 
 
@@ -209,6 +211,7 @@ class GenEvent:
     ttft_s: Optional[float] = None
     t_read: Optional[float] = None
     wall_s: Optional[float] = None
+    tenant: Optional[str] = None       # attribution (PR 19)
 
 
 class _Slot:
@@ -737,7 +740,8 @@ class ContinuousBatcher:
                 self.quarantined += 1
                 events.append(GenEvent(
                     "quarantine", req.rid, trace_id=req.trace_id,
-                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read,
+                    tenant=req.tenant))
                 lane.free.append(slot)
                 return 0
             # isolate the poison: singleton admissions, per-slot blast
@@ -754,7 +758,8 @@ class ContinuousBatcher:
                 self.quarantined += 1
                 events.append(GenEvent(
                     "quarantine", req.rid, trace_id=req.trace_id,
-                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read,
+                    tenant=req.tenant))
                 lane.free.append(slot)
                 continue
             info = _Slot(req, budget=self._budget_for(req, lane))
@@ -768,7 +773,7 @@ class ContinuousBatcher:
                 events.append(GenEvent(
                     "first_token", req.rid, trace_id=req.trace_id,
                     ttft_s=info.t_first - req.t_submit,
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 lane.tokens[slot] = int(toks0[j])
                 self._account_token(lane, slot, info, int(toks0[j]),
                                     events)
@@ -841,14 +846,15 @@ class ContinuousBatcher:
                 self.shed += 1
                 events.append(GenEvent(
                     "shed", req.rid, trace_id=req.trace_id,
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 continue
             err = self._validate(req)
             if err is not None:
                 self.quarantined += 1
                 events.append(GenEvent(
                     "quarantine", req.rid, trace_id=req.trace_id,
-                    error=f"ValueError: {err}", t_read=req.t_read))
+                    error=f"ValueError: {err}", t_read=req.t_read,
+                    tenant=req.tenant))
                 continue
             if self._pick_lane(req) is None:
                 self.quarantined += 1
@@ -856,7 +862,7 @@ class ContinuousBatcher:
                     "quarantine", req.rid, trace_id=req.trace_id,
                     error="ValueError: no decode lane holds prompt + "
                           f"max_tokens (buckets {self.gen.bucket_lens})",
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 continue
             if not lane.free:
                 with self._waiting_lock:
@@ -883,7 +889,7 @@ class ContinuousBatcher:
                     error=f"ValueError: no prefill bucket holds prompt "
                           f"length {plen} (buckets "
                           f"{self.gen.prefill_buckets})",
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 lane.free.append(slot)
                 continue
             if ksh:
@@ -970,7 +976,8 @@ class ContinuousBatcher:
                 self.quarantined += 1
                 events.append(GenEvent(
                     "quarantine", req.rid, trace_id=req.trace_id,
-                    error=f"{type(e).__name__}: {e}", t_read=req.t_read))
+                    error=f"{type(e).__name__}: {e}", t_read=req.t_read,
+                    tenant=req.tenant))
                 lane.free.append(slot)
                 return 0
             return sum(self._admit_paged_batch(lane, pb, [m], events,
@@ -1001,7 +1008,8 @@ class ContinuousBatcher:
             info.t_first = time.monotonic()
             events.append(GenEvent(
                 "first_token", req.rid, trace_id=req.trace_id,
-                ttft_s=info.t_first - req.t_submit, t_read=req.t_read))
+                ttft_s=info.t_first - req.t_submit, t_read=req.t_read,
+                tenant=req.tenant))
             lane.tokens[slot] = int(toks0[j])
             self._account_token(lane, slot, info, int(toks0[j]), events)
         return admitted
@@ -1022,14 +1030,15 @@ class ContinuousBatcher:
                 self.shed += 1
                 events.append(GenEvent(
                     "shed", req.rid, trace_id=req.trace_id,
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 continue
             err = self._validate(req)
             if err is not None:
                 self.quarantined += 1
                 events.append(GenEvent(
                     "quarantine", req.rid, trace_id=req.trace_id,
-                    error=f"ValueError: {err}", t_read=req.t_read))
+                    error=f"ValueError: {err}", t_read=req.t_read,
+                    tenant=req.tenant))
                 continue
             lane = self._pick_lane(req)
             if lane is None:
@@ -1038,7 +1047,7 @@ class ContinuousBatcher:
                     "quarantine", req.rid, trace_id=req.trace_id,
                     error="ValueError: no decode lane holds prompt + "
                           f"max_tokens (buckets {self.gen.bucket_lens})",
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 continue
             if not lane.free:
                 # every slot of the right lane busy: the request stays at
@@ -1065,7 +1074,7 @@ class ContinuousBatcher:
                     error=f"ValueError: no prefill bucket holds prompt "
                           f"length {prompt_len} (buckets "
                           f"{self.gen.prefill_buckets})",
-                    t_read=req.t_read))
+                    t_read=req.t_read, tenant=req.tenant))
                 lane.free.append(slot)
                 continue
             groups.setdefault((lane.bucket, pb), (lane, pb, []))[2] \
@@ -1104,7 +1113,8 @@ class ContinuousBatcher:
             tokens=list(info.generated), finish_reason=reason,
             ttft_s=(info.t_first - info.req.t_submit
                     if info.t_first is not None else None),
-            t_read=info.req.t_read, wall_s=now - info.req.t_submit))
+            t_read=info.req.t_read, wall_s=now - info.req.t_submit,
+            tenant=info.req.tenant))
         self._free(lane, slot)
 
     def _account_token(self, lane: _Lane, slot: int, info: _Slot,
@@ -1126,7 +1136,8 @@ class ContinuousBatcher:
             info.last_stream = len(info.generated)
             events.append(GenEvent(
                 "partial", info.req.rid, trace_id=info.req.trace_id,
-                tokens=list(info.generated), t_read=info.req.t_read))
+                tokens=list(info.generated), t_read=info.req.t_read,
+                tenant=info.req.tenant))
 
     def _shed_active(self, events: List[GenEvent]) -> None:
         for lane in self._lanes:
@@ -1136,7 +1147,8 @@ class ContinuousBatcher:
                 self.shed += 1
                 events.append(GenEvent(
                     "shed", info.req.rid, trace_id=info.req.trace_id,
-                    tokens=list(info.generated), t_read=info.req.t_read))
+                    tokens=list(info.generated), t_read=info.req.t_read,
+                    tenant=info.req.tenant))
                 self._free(lane, slot)
 
     def step(self) -> List[GenEvent]:
@@ -1190,7 +1202,7 @@ class ContinuousBatcher:
                         "first_token", info.req.rid,
                         trace_id=info.req.trace_id,
                         ttft_s=info.t_first - info.req.t_submit,
-                        t_read=info.req.t_read))
+                        t_read=info.req.t_read, tenant=info.req.tenant))
                 n0 = len(info.generated)
                 for k in range(block.shape[0]):
                     self._account_token(lane, slot, info,
@@ -1198,11 +1210,12 @@ class ContinuousBatcher:
                     if lane.slots[slot] is not info:
                         break      # finished mid-quantum: discard the rest
                 # boundary accounting for the per-boundary decode spans
-                # (valid whether the request finished this boundary or
-                # not — `info` outlives the slot free)
+                # and per-tenant token charging (valid whether the
+                # request finished this boundary or not — `info` outlives
+                # the slot free)
                 self.last_boundary.append(
                     (info.req.rid, info.req.trace_id,
-                     len(info.generated) - n0))
+                     len(info.generated) - n0, info.req.tenant))
             # copy: the device block is read-only, and the next boundary's
             # admission writes freshly-claimed slots into this row
             lane.tokens = np.array(block[-1])

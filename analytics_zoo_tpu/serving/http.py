@@ -312,8 +312,16 @@ class HealthServer:
                             return
                     elif not trace_sampled(tid, rate):
                         return
+                # tenant attribution (PR 19): the engine stamps the
+                # record's tenant into the result doc, so "whose poll"
+                # is answerable from the trace alone
+                attrs = {}
+                if isinstance(res.get("tenant"), str):
+                    attrs["tenant"] = res["tenant"]
+                if isinstance(res.get("priority"), str):
+                    attrs["priority"] = res["priority"]
                 tracer.span("result_poll", t0, time.monotonic(),
-                            trace_id=tid, uri=uri)
+                            trace_id=tid, uri=uri, attrs=attrs or None)
 
             def _get_result(self, parts) -> None:
                 """GET /v1/result/<uri>[?timeout_s=S] — long-poll the
@@ -491,6 +499,19 @@ class HealthServer:
                     admit_fn = getattr(serving, "admit_record", None)
                     decision = admit_fn(tenant, prio_hdr) \
                         if callable(admit_fn) else None
+                    # identity is stamped on EVERY record (PR 19), not
+                    # just when the admission armor is on: with no
+                    # controller the gateway normalizes the headers
+                    # itself, so downstream attribution (metrics, spans,
+                    # usage journal) never depends on admission config
+                    if decision is not None:
+                        rec_tenant = decision.tenant
+                        rec_priority = decision.priority
+                    else:
+                        from analytics_zoo_tpu.serving.admission import (
+                            normalize_priority, normalize_tenant)
+                        rec_tenant = normalize_tenant(tenant)
+                        rec_priority = normalize_priority(prio_hdr)
                     if decision is not None and not decision.admitted:
                         self._reply(
                             429,
@@ -569,11 +590,9 @@ class HealthServer:
                                     deadline_ns=deadline_ns,
                                     trace_ctx_fn=_mk_ctx,
                                     overwrite_trace_ctx=True,
-                                    set_fields=(
-                                        {"tenant": decision.tenant,
-                                         "priority": decision.priority}
-                                        if decision is not None
-                                        else None))
+                                    set_fields={
+                                        "tenant": rec_tenant,
+                                        "priority": rec_priority})
                         except _wire.FrameError as e:
                             self._reply(400, {"error": f"malformed "
                                                        f"frame: {e}"})
@@ -693,11 +712,10 @@ class HealthServer:
                         # sent (a junk ts would skew queue-wait; a forged
                         # parent would mis-thread the timeline)
                         record["trace_ctx"] = _mk_ctx(record)
-                        if decision is not None:
-                            # trust edge for identity (PR 17): the header
-                            # verdict overwrites any body-carried fields
-                            record["tenant"] = decision.tenant
-                            record["priority"] = decision.priority
+                        # trust edge for identity (PR 17): the header
+                        # verdict overwrites any body-carried fields
+                        record["tenant"] = rec_tenant
+                        record["priority"] = rec_priority
                         if deadline_ns is not None:
                             record.setdefault("deadline_ns", deadline_ns)
                         uri, deadline_ns = record["uri"], \
@@ -738,7 +756,9 @@ class HealthServer:
                                 span_id=gw_span,
                                 parent_id=(inbound.span_id
                                            if inbound is not None
-                                           else None))
+                                           else None),
+                                attrs={"tenant": rec_tenant,
+                                       "priority": rec_priority})
                 finally:
                     gateway._observe("enqueue", t0, length)
 

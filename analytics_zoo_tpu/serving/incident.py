@@ -44,6 +44,9 @@ from analytics_zoo_tpu.serving import tracecollect
 _CAPTURE_GLOBS = (
     "*.spans.jsonl", "*.spans.jsonl.1",
     "*.events.jsonl", "*.events.jsonl.1",
+    # usage metering (PR 19): the per-tenant usage journal — an incident
+    # bundle shows WHO was being served when things went wrong
+    "*.usage.jsonl", "*.usage.jsonl.1",
     "*.health.json",
     ".autoscaler.json", ".lb.json", ".knobs.json", ".replicas",
     # rollout (PR 16): the phase / target / per-replica version

@@ -388,7 +388,8 @@ class LoadBalancer:
 
     def _record_root_span(self, stage: str, t0: float, ctx: SpanContext,
                           result, uri=None, inbound: bool = False,
-                          parent_id=None) -> None:
+                          parent_id=None, tenant=None,
+                          priority=None) -> None:
         """The front door's span, IF this trace is sampled.  The verdict:
         an inbound traceparent's flag is authoritative (the upstream
         already decided — recording an explicitly-unsampled trace would
@@ -422,6 +423,13 @@ class LoadBalancer:
             elif not trace_sampled(trace_id, self.trace_sample):
                 return
         attrs = {"code": int(status), "attempts": int(attempts)}
+        # tenant attribution (PR 19): the client-declared identity rides
+        # the LB root span as-declared (the gateway's admission normalizes
+        # it downstream — the LB is outside the trust edge)
+        if isinstance(tenant, str) and tenant:
+            attrs["tenant"] = tenant
+        if isinstance(priority, str) and priority:
+            attrs["priority"] = priority
         if attempts > 1:
             # the retry made visible: a re-routed request's root span says
             # so, next to the reclaim span the serving replica records
@@ -563,7 +571,9 @@ class LoadBalancer:
                                 inbound=inbound is not None,
                                 parent_id=(inbound.span_id
                                            if inbound is not None
-                                           else None))
+                                           else None),
+                                tenant=self.headers.get("X-Tenant"),
+                                priority=self.headers.get("X-Priority"))
                     else:
                         self._reply_json(
                             404, {"error": f"no route {parts.path}"})
@@ -630,7 +640,9 @@ class LoadBalancer:
                             inbound=inbound is not None,
                             parent_id=(inbound.span_id
                                        if inbound is not None
-                                       else None))
+                                       else None),
+                            tenant=self.headers.get("X-Tenant"),
+                            priority=self.headers.get("X-Priority"))
                 except Exception as e:  # noqa: BLE001
                     self._reply_json(500,
                                      {"error": f"{type(e).__name__}: {e}"})

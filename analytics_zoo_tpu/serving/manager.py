@@ -525,6 +525,21 @@ def _drain_events(pidfile: str, source=None) -> None:
         pass
 
 
+def _drain_usage(serving, pidfile: str) -> None:
+    """Usage journal hop (PR 19): drain this replica's per-interval
+    usage deltas into ``<pidfile>.usage.jsonl`` — same rotation/clock
+    contract as the span/event spools, rolled up by `manager usage`.
+    Best-effort: metering must never be load-bearing."""
+    try:
+        from analytics_zoo_tpu.serving import tracecollect
+        records = serving.drain_usage()
+        if records:
+            tracecollect.append_usage(tracecollect.usage_path(pidfile),
+                                      records, source=serving.replica_id)
+    except Exception:  # noqa: BLE001 — metering is never load-bearing
+        pass
+
+
 def _run_foreground(config_path: str, pidfile: str,
                     replica_id: Optional[str] = None,
                     http_port_offset: int = 0,
@@ -571,6 +586,9 @@ def _run_foreground(config_path: str, pidfile: str,
         serving.shutdown(drain_s=serving.params.drain_s)
         _drain_spans(serving, pidfile)
         _drain_events(pidfile, source=serving.replica_id)
+        # the journal survives `manager stop`: the final interval's usage
+        # (results flushed during the drain) must not be lost to billing
+        _drain_usage(serving, pidfile)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -589,6 +607,7 @@ def _run_foreground(config_path: str, pidfile: str,
                          close_admission=False)
         _drain_spans(serving, pidfile)
         _drain_events(pidfile, source=serving.replica_id)
+        _drain_usage(serving, pidfile)
         for p in (pidfile, health_path):
             try:
                 os.unlink(p)
@@ -609,6 +628,8 @@ def _run_foreground(config_path: str, pidfile: str,
         _drain_spans(serving, pidfile)
         # flight recorder (PR 15): same hop for the event ring
         _drain_events(pidfile, source=serving.replica_id)
+        # usage metering (PR 19): same hop for the usage journal
+        _drain_usage(serving, pidfile)
         # live knob nudges (PR 10 autoscaler fast tier): the supervisor's
         # autoscaler writes <base pidfile>.knobs.json; every replica polls
         # it once a second and applies via retune() — validated, and taken
@@ -1272,7 +1293,7 @@ def main(argv=None):
                     choices=["start", "stop", "status", "restart", "health",
                              "replay", "metrics", "scale", "warmup",
                              "trace", "incident", "profile", "publish",
-                             "versions", "rollout"])
+                             "versions", "rollout", "usage"])
     ap.add_argument("value", nargs="?", default=None,
                     help="scale: target replica count; trace: the "
                          "trace_id to reconstruct; incident --show: the "
@@ -1335,6 +1356,13 @@ def main(argv=None):
                          "V (no re-export) — the rollout's pre-warm pass "
                          "runs this so replaced replicas boot with zero "
                          "compiles")
+    ap.add_argument("--since", type=float, default=None, metavar="EPOCH",
+                    help="usage: only count journal deltas drained after "
+                         "this wall time (epoch seconds)")
+    ap.add_argument("--by", default="tenant", choices=["tenant", "model"],
+                    help="usage: rollup dimension (default tenant)")
+    ap.add_argument("--json", action="store_true", dest="json_",
+                    help="usage: print the rollup as JSON")
     args = ap.parse_args(argv)
 
     def read_pid():
@@ -1678,6 +1706,39 @@ def main(argv=None):
         doc = tracecollect.reconstruct(spans, args.value)
         print(json.dumps(doc))
         return 0 if doc.get("found") else 1
+    if args.action == "usage":
+        # usage metering rollup (PR 19): load every replica's usage
+        # journal (rotated generations included), normalize the drain
+        # clocks, and sum the per-interval deltas by tenant or model.
+        # Works on a STOPPED deployment — the journal survives `manager
+        # stop` precisely so billing can run after the fact.
+        from analytics_zoo_tpu.serving import tracecollect
+        paths = tracecollect.find_usage_spools(args.pidfile)
+        if not paths:
+            print(json.dumps(
+                {"error": "no usage journals found (nothing matching "
+                          f"{args.pidfile}*.usage.jsonl — is metering "
+                          "on and the deployment running/ran?)"}),
+                file=sys.stderr)
+            return 1
+        records = tracecollect.load_usage(paths)
+        doc = tracecollect.aggregate_usage(records, by=args.by,
+                                           since=args.since)
+        doc["journals"] = len(paths)
+        if args.json_:
+            print(json.dumps(doc))
+            return 0
+        hdr = (f"{args.by:<24} {'records':>10} {'tokens':>10} "
+               f"{'device_s':>12} {'bytes':>12} {'sheds':>8}")
+        print(hdr)
+        print("-" * len(hdr))
+        for key, vals in doc["usage"].items():
+            print(f"{key:<24} {vals['records']:>10} {vals['tokens']:>10} "
+                  f"{vals['device_s']:>12} {vals['bytes']:>12} "
+                  f"{vals['sheds']:>8}")
+        print(f"({doc['intervals']} journal interval(s) across "
+              f"{doc['journals']} journal(s))")
+        return 0
     if args.action == "metrics":
         # live metrics snapshot (PR 4).  Preferred source: the daemon's own
         # /metrics endpoint (exactly what a scraper sees, including

@@ -75,6 +75,20 @@ def find_event_spools(pidfile: str) -> List[str]:
     return out
 
 
+def usage_path(pidfile: str) -> str:
+    """The usage journal (PR 19) next to the span/event spools: one jsonl
+    file of per-interval per-(tenant, model) usage deltas per replica."""
+    return pidfile + ".usage.jsonl"
+
+
+def find_usage_spools(pidfile: str) -> List[str]:
+    """Every usage journal of a deployment (rotated generations
+    included)."""
+    out = sorted(set(glob.glob(pidfile + "*.usage.jsonl")
+                     + glob.glob(pidfile + "*.usage.jsonl.1")))
+    return out
+
+
 def _append_records(path: str, records: List[Dict], kind: str,
                     source: Optional[str], max_bytes: int) -> int:
     """The one spool writer (spans AND events): a clock record
@@ -128,6 +142,82 @@ def append_events(path: str, events: Iterable[Dict],
     ``merge_spools`` normalizes both onto one wall timeline and `manager
     trace` / `incident_view` agree about when everything happened."""
     return _append_records(path, list(events), "event", source, max_bytes)
+
+
+def append_usage(path: str, records: Iterable[Dict],
+                 source: Optional[str] = None,
+                 max_bytes: int = SPOOL_MAX_BYTES) -> int:
+    """Append one ``UsageMeter.drain()`` batch (PR 19) — the SAME
+    rotation + drain-time clock contract as span/event spools, so the
+    journal's monotonic ``ts`` stamps normalize onto the wall clock the
+    same way spans do."""
+    return _append_records(path, list(records), "usage", source, max_bytes)
+
+
+def load_usage(paths: Iterable[str]) -> List[Dict]:
+    """Every usage delta of the given journals, each stamped with
+    ``ts_wall`` via the nearest preceding clock record of its file
+    (mirroring ``merge_spools``; a record with no clock keeps its raw
+    ``ts`` and gains ``clock_skewed: true``)."""
+    out: List[Dict] = []
+    for path in paths:
+        offset: Optional[float] = None
+        for rec in load_spool(path):
+            kind = rec.get("kind")
+            if kind == "clock":
+                try:
+                    offset = float(rec["wall"]) - float(rec["mono"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+                continue
+            if kind != "usage":
+                continue
+            rec = {k: v for k, v in rec.items() if k != "kind"}
+            try:
+                ts = float(rec.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if offset is not None:
+                rec["ts_wall"] = ts + offset
+            else:
+                rec["ts_wall"] = ts
+                rec["clock_skewed"] = True
+            out.append(rec)
+    out.sort(key=lambda r: r.get("ts_wall", 0.0))
+    return out
+
+
+_USAGE_SUM_FIELDS = ("records", "tokens", "device_s", "bytes", "sheds")
+
+
+def aggregate_usage(records: Iterable[Dict], by: str = "tenant",
+                    since: Optional[float] = None) -> Dict:
+    """The ``manager usage`` rollup: sum the journal's per-interval
+    deltas grouped ``by`` tenant (default) or model, optionally limited
+    to deltas drained after wall time ``since`` (epoch seconds).
+    Replaying the journal reproduces the counters, so the rollup is the
+    billing-grade view of the same numbers the labelled series carry."""
+    if by not in ("tenant", "model"):
+        raise ValueError(f"usage rollup: by must be tenant|model, "
+                         f"got {by!r}")
+    groups: Dict[str, Dict[str, float]] = {}
+    n_intervals = 0
+    for rec in records:
+        if since is not None and rec.get("ts_wall", 0.0) < since:
+            continue
+        key = str(rec.get(by) or "unknown")
+        g = groups.setdefault(key, dict.fromkeys(_USAGE_SUM_FIELDS, 0.0))
+        for f in _USAGE_SUM_FIELDS:
+            try:
+                g[f] += float(rec.get(f, 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        n_intervals += 1
+    for g in groups.values():
+        for f in _USAGE_SUM_FIELDS:
+            g[f] = round(g[f], 6) if g[f] != int(g[f]) else int(g[f])
+    return {"by": by, "since": since, "intervals": n_intervals,
+            "usage": {k: groups[k] for k in sorted(groups)}}
 
 
 def load_spool(path: str) -> List[Dict]:
@@ -261,7 +351,8 @@ def reconstruct(spans: Iterable[Dict], trace_id: str) -> Dict:
                  "process": _span_source(s),
                  "uri": s.get("uri")}
         for key in ("span_id", "parent_id", "error", "tokens",
-                    "attempts", "rerouted", "code", "clock_skewed"):
+                    "attempts", "rerouted", "code", "clock_skewed",
+                    "tenant", "priority"):
             if s.get(key) is not None:
                 entry[key] = s[key]
         timeline.append(entry)

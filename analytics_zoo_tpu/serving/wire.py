@@ -74,10 +74,13 @@ PREFIX_LEN = _PREFIX.size                  # plen — 12 bytes
 # gateway's traceparent + ingest timestamp ({"tp": str, "ts": ns}) — and is
 # VERSION-COMPATIBLE both ways: old frames simply lack the key, and an old
 # decoder passes the unexpanded "tc" through untouched (the engine only
-# acts on "trace_ctx")
+# acts on "trace_ctx").  "tn"/"pr" (tenant/priority, PR 19) are trust-edge
+# fields the gateway overwrites on every frame, with the same
+# compatibility contract: old frames lack them (the engine attributes to
+# tenant="unknown"), old decoders pass them through unexpanded.
 _SHORT = {"uri": "u", "trace_id": "t", "deadline_ns": "d", "dtype": "dt",
           "shape": "s", "scale": "sc", "shm": "sm", "meta": "m",
-          "trace_ctx": "tc"}
+          "trace_ctx": "tc", "tenant": "tn", "priority": "pr"}
 _LONG = {v: k for k, v in _SHORT.items()}
 
 # wire-format tags used for metrics labels and bench A/Bs

@@ -109,6 +109,12 @@ def pytest_configure(config):
         "int8 KV lanes, paged attention kernel parity); they compile "
         "paged prefill/decode programs and run the kernel in interpret "
         "mode on CPU, so they carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "metering: usage-metering / attribution tests (PR 19: "
+        "tenant/model-labelled series, usage journal, per-tenant SLO "
+        "views); the acceptance test forks a real 2-replica deployment "
+        "behind the LB, so they carry a default 300 s SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -128,6 +134,7 @@ FORENSICS_DEFAULT_TIMEOUT_S = 300.0
 ROLLOUT_DEFAULT_TIMEOUT_S = 300.0
 OVERLOAD_DEFAULT_TIMEOUT_S = 300.0
 KVCACHE_DEFAULT_TIMEOUT_S = 300.0
+METERING_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -167,6 +174,8 @@ def pytest_runtest_call(item):
             seconds = OVERLOAD_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("kvcache") is not None:
             seconds = KVCACHE_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("metering") is not None:
+            seconds = METERING_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

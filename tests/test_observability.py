@@ -284,7 +284,11 @@ def test_engine_registry_counters_and_prom_text(ctx):
     finally:
         serving.shutdown()
     reg = serving.registry
-    assert reg.counter("serving_records_total").value == 8
+    # PR 19: records are tenant/model-labelled; enqueue_tensor stamps no
+    # tenant, so legacy traffic lands on tenant="unknown"
+    assert reg.counter("serving_records_total",
+                       labels=("tenant", "model")) \
+        .labels(tenant="unknown", model="default").value == 8
     assert reg.counter("serving_quarantined_total", labels=("stage",)) \
         .labels(stage="preprocess").value == 1
     stage_hist = reg.histogram("serving_stage_seconds", labels=("stage",))
@@ -293,7 +297,8 @@ def test_engine_registry_counters_and_prom_text(ctx):
     text = serving.prom_metrics()
     assert "# TYPE serving_stage_seconds histogram" in text
     assert 'serving_stage_seconds_bucket{stage="predict",le="+Inf"}' in text
-    assert "serving_records_total 8" in text
+    assert 'serving_records_total{tenant="unknown",model="default"} 8' \
+        in text
     assert "serving_queue_depth 0" in text
     # inference-model histograms ride the same engine registry
     assert reg.get("inference_predict_seconds") is not None
@@ -362,7 +367,9 @@ def test_tracing_off_keeps_metrics_hot_path_silent(ctx):
     finally:
         serving.shutdown()
     assert serving.tracer.spans() == []
-    assert serving.registry.counter("serving_records_total").value == 4
+    assert serving.registry.counter(
+        "serving_records_total", labels=("tenant", "model")) \
+        .labels(tenant="unknown", model="default").value == 4
     stage_hist = serving.registry.histogram("serving_stage_seconds",
                                             labels=("stage",))
     assert stage_hist.labels(stage="predict").count > 0

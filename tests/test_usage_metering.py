@@ -1,0 +1,639 @@
+"""Usage metering & attribution (PR 19).
+
+Covers the metering tentpole end to end: the UsageMeter's tenant folding
+(absent -> "unknown", junk -> normalized, cardinality cap -> "other"),
+device-second conservation (Σ tenant shares == measured dispatch wall),
+delta-drain journal semantics, the engine's attribution of records /
+sheds / device seconds / per-tenant SLO burn views through real served
+traffic (all three queue backends for the legacy-record path), the
+durable usage journal (tracecollect rotation + clock contract + `manager
+usage` rollup), fleet aggregation of per-tenant usage, and the hostile
+label-escaping hardening for merge_prometheus.  The real-process
+acceptance test (2 replicas behind the LB, two tenants, `manager usage`
+rollup matching the client's own counts exactly, journal surviving
+`manager stop`) is `slow`-marked like the PR 10/15/16 chaos tests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.observability import MetricsRegistry
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.nn import Sequential
+from analytics_zoo_tpu.nn.layers import Dense
+from analytics_zoo_tpu.serving import fleet, tracecollect
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.metering import UNKNOWN_TENANT, UsageMeter
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue, RedisQueue
+
+from test_serving_availability import FakeRedis
+
+pytestmark = pytest.mark.metering
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIM = 16
+NCLS = 8
+
+
+def _model():
+    m = Sequential()
+    m.add(Dense(NCLS, activation="softmax", input_shape=(DIM,)))
+    m.init_weights()
+    return InferenceModel().do_load_model(m, m._params, m._state)
+
+
+def _serving(q, **params):
+    return ClusterServing(_model(), q,
+                          params=ServingParams(batch_size=4, **params))
+
+
+def _records_counter(reg):
+    return reg.counter("serving_records_total", labels=("tenant", "model"))
+
+
+def _serve_all(serving):
+    while serving.serve_once():
+        pass
+
+
+# -- meter unit behavior -------------------------------------------------------
+
+def test_meter_resolve_folds_absent_junk_and_overflow():
+    """Absent identity -> "unknown"; junk ids normalize at the same edge
+    admission uses; past max_tenants DISTINCT ids everything folds into
+    "other" so a tenant-id sweep cannot grow the exposition."""
+    meter = UsageMeter(MetricsRegistry(), cfg={"max_tenants": 3})
+    assert meter.resolve(None) == UNKNOWN_TENANT
+    assert meter.resolve("") == UNKNOWN_TENANT
+    assert meter.resolve("Acme-1") == "Acme-1"      # well-formed: kept
+    assert meter.resolve("!!!") == "other"          # junk -> other lane
+    assert meter.resolve("t2") == "t2"
+    assert meter.resolve("t3") == "t3"              # hits the cap (3rd id)
+    assert meter.resolve("t4") == "other"           # over the cap
+    assert meter.resolve("Acme-1") == "Acme-1"      # seen ids stay stable
+    # the sentinel lanes never count against the cap
+    assert meter.resolve("default") == "default"
+    assert meter.resolve("other") == "other"
+
+
+def test_meter_device_seconds_conserves_wall():
+    """Σ per-tenant shares == the batch's measured wall time exactly —
+    the invariant that makes per-tenant device seconds sum to engine busy
+    time by construction."""
+    reg = MetricsRegistry()
+    meter = UsageMeter(reg, model="v7")
+    meter.device_seconds({"a": 3, "b": 1, None: 4}, 0.8)
+    dev = reg.counter("serving_device_seconds_total",
+                      labels=("tenant", "model"))
+    a = dev.labels(tenant="a", model="v7").value
+    b = dev.labels(tenant="b", model="v7").value
+    u = dev.labels(tenant=UNKNOWN_TENANT, model="v7").value
+    assert a == pytest.approx(0.3)
+    assert b == pytest.approx(0.1)
+    assert u == pytest.approx(0.4)
+    assert a + b + u == pytest.approx(0.8, abs=1e-12)
+    # zero-row and zero-wall batches charge nothing
+    meter.device_seconds({}, 0.5)
+    meter.device_seconds({"a": 4}, 0.0)
+    assert dev.labels(tenant="a", model="v7").value == pytest.approx(0.3)
+
+
+def test_meter_drain_is_per_interval_delta():
+    """drain() hands back per-(tenant, model) deltas since the LAST drain
+    and resets them — replaying the journal reproduces the counters —
+    while snapshot() keeps the cumulative totals."""
+    meter = UsageMeter(MetricsRegistry(), model="m1")
+    meter.records("acme", 3)
+    meter.tokens("acme", 10)
+    meter.sheds(None)
+    first = meter.drain()
+    by_tenant = {r["tenant"]: r for r in first}
+    assert by_tenant["acme"]["records"] == 3
+    assert by_tenant["acme"]["tokens"] == 10
+    assert by_tenant["acme"]["model"] == "m1"
+    assert by_tenant[UNKNOWN_TENANT]["sheds"] == 1
+    assert all("ts" in r for r in first)
+    assert meter.drain() == []                    # nothing new: empty
+    meter.records("acme", 2)
+    second = meter.drain()
+    assert [r["records"] for r in second] == [2]  # the DELTA, not 5
+    snap = meter.snapshot()
+    assert snap["tenants"]["acme"]["records"] == 5   # cumulative
+    assert snap["tenants"][UNKNOWN_TENANT]["sheds"] == 1
+    assert snap["enabled"] is True and snap["model"] == "m1"
+
+
+def test_meter_disabled_registers_pre_pr19_series():
+    """metering {"enabled": false}: the historical UNLABELLED records /
+    tokens counters come back and the attribution/journal hop is a no-op
+    — the off arm of `serving_bench --metering-overhead`."""
+    reg = MetricsRegistry()
+    meter = UsageMeter(reg, cfg={"enabled": False})
+    meter.records("acme", 4)
+    meter.tokens("acme", 9)
+    meter.sheds("acme")
+    meter.device_seconds({"acme": 2}, 0.5)
+    meter.request_seconds("acme", 0.1)
+    meter.slo_observe("acme", 0.1)
+    assert reg.counter("serving_records_total").value == 4
+    assert reg.counter("serving_generated_tokens_total").value == 9
+    assert reg.get("serving_sheds_total") is None
+    assert reg.get("serving_device_seconds_total") is None
+    assert meter.drain() == []
+    assert meter.snapshot()["enabled"] is False
+
+
+def test_meter_materializes_configured_tenants_at_zero():
+    """Satellite: tenants listed in the admission table exist as labelled
+    series from construction — dashboards and the fleet merge never gap
+    on first traffic."""
+    reg = MetricsRegistry()
+    UsageMeter(reg, tenants_configured=("gold", "Bronze-2"))
+    text = reg.to_prometheus()
+    assert 'serving_records_total{tenant="gold",model="default"} 0' in text
+    assert 'serving_records_total{tenant="Bronze-2",model="default"} 0' \
+        in text
+    assert 'serving_sheds_total{tenant="gold",model="default"} 0' in text
+    assert 'serving_device_seconds_total{tenant="gold",model="default"} 0' \
+        in text
+
+
+# -- label-escaping hardening (satellite 1) ------------------------------------
+
+def test_hostile_tenant_label_round_trips_merge_prometheus():
+    """A tenant value carrying every escape-worthy byte (quote, backslash,
+    newline) renders as valid exposition AND round-trips merge_prometheus
+    — the merged fleet text sums the series instead of corrupting it."""
+    hostile = 'evil"t\\en\nant'
+    reg = MetricsRegistry()
+    c = reg.counter("serving_records_total", "Records served",
+                    labels=("tenant", "model"))
+    c.labels(tenant=hostile, model="default").inc(3)
+    text = reg.to_prometheus()
+    escaped = 'evil\\"t\\\\en\\nant'
+    line = ('serving_records_total{tenant="' + escaped
+            + '",model="default"} 3')
+    # the whole series renders as ONE exposition line: the raw newline
+    # never leaks into the text
+    assert line in text.splitlines()
+    merged = fleet.merge_prometheus([text, text])
+    assert ('serving_records_total{tenant="' + escaped
+            + '",model="default"} 6') in merged
+    # and the merged text is still parseable exposition (merge of the
+    # merge keeps summing, which only works if labels survived intact)
+    assert ('serving_records_total{tenant="' + escaped
+            + '",model="default"} 12') in fleet.merge_prometheus(
+                [merged, merged])
+
+
+# -- engine attribution (served traffic) ---------------------------------------
+
+def test_engine_attributes_two_tenants_and_legacy(ctx):
+    """Tenant-stamped records bill their tenant, legacy records bill
+    "unknown", results carry the attribution, and health()["usage"]
+    reports the same cumulative totals."""
+    q = InProcQueue()
+    serving = _serving(q)
+    for i in range(5):
+        q.xadd({"uri": f"a{i}", "data": [0.1] * DIM, "tenant": "acme"})
+    for i in range(3):
+        q.xadd({"uri": f"z{i}", "data": [0.2] * DIM, "tenant": "zeta"})
+    q.xadd({"uri": "legacy", "data": [0.3] * DIM})
+    _serve_all(serving)
+    c = _records_counter(serving.registry)
+    assert c.labels(tenant="acme", model="default").value == 5
+    assert c.labels(tenant="zeta", model="default").value == 3
+    assert c.labels(tenant=UNKNOWN_TENANT, model="default").value == 1
+    assert q.get_result("a0").get("tenant") == "acme"
+    assert q.get_result("z0").get("tenant") == "zeta"
+    assert "tenant" not in q.get_result("legacy")
+    usage = serving.health()["usage"]
+    assert usage["tenants"]["acme"]["records"] == 5
+    assert usage["tenants"]["zeta"]["records"] == 3
+    assert usage["tenants"][UNKNOWN_TENANT]["records"] == 1
+    # per-tenant request-latency histogram materialized for both tenants
+    h = serving.registry.histogram("serving_request_seconds",
+                                   labels=("tenant", "model"))
+    assert h.labels(tenant="acme", model="default").count == 5
+    assert h.labels(tenant="zeta", model="default").count == 3
+
+
+def test_legacy_records_unknown_across_all_backends(ctx, tmp_path):
+    """Acceptance: records without a tenant key serve attributed to
+    tenant="unknown" on ALL three queue backends — old producers keep
+    working against a metered fleet."""
+    for q in (InProcQueue(), FileQueue(str(tmp_path / "q")),
+              RedisQueue(client=FakeRedis())):
+        serving = _serving(q)
+        cin = InputQueue(q)
+        rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+                for i in range(4)]
+        _serve_all(serving)
+        got = OutputQueue(q).query_many(rids, timeout_s=30)
+        assert all(r is not None and not OutputQueue.is_error(r)
+                   for r in got.values()), type(q).__name__
+        c = _records_counter(serving.registry)
+        assert c.labels(tenant=UNKNOWN_TENANT,
+                        model="default").value == 4, type(q).__name__
+
+
+def test_engine_attributes_sheds_to_their_tenant(ctx):
+    """An expired record is shed AGAINST its tenant: the loss shows up in
+    serving_sheds_total{tenant=} and in the usage totals, not just the
+    fleet-scalar shed counter."""
+    q = InProcQueue()
+    serving = _serving(q)
+    q.xadd({"uri": "doomed", "data": [0.1] * DIM, "tenant": "acme",
+            "deadline_ns": 1})                      # expired at birth
+    q.xadd({"uri": "fine", "data": [0.1] * DIM, "tenant": "acme"})
+    _serve_all(serving)
+    sheds = serving.registry.counter("serving_sheds_total",
+                                     labels=("tenant", "model"))
+    assert sheds.labels(tenant="acme", model="default").value == 1
+    assert serving.health()["usage"]["tenants"]["acme"]["sheds"] == 1
+    assert serving.health()["usage"]["tenants"]["acme"]["records"] == 1
+    assert OutputQueue.is_deadline_exceeded(q.get_result("doomed"))
+
+
+def test_engine_quarantine_bills_shed_to_tenant(ctx):
+    """A poisoned record dead-letters against its tenant — billing sees
+    WHO lost a record, not only that one was lost."""
+    q = InProcQueue()
+    serving = _serving(q)
+    q.xadd({"uri": "bad", "b64": "!!!not-base64!!!", "dtype": "<f4",
+            "tenant": "zeta"})
+    _serve_all(serving)
+    sheds = serving.registry.counter("serving_sheds_total",
+                                     labels=("tenant", "model"))
+    assert sheds.labels(tenant="zeta", model="default").value == 1
+    assert OutputQueue.is_error(q.get_result("bad"))
+
+
+def test_device_seconds_conservation_against_busy_time(ctx):
+    """ISSUE invariant: Σ tenant device seconds matches the engine's
+    measured predict busy time within 5% (here: exactly, both sides are
+    the same measured walls)."""
+    q = InProcQueue()
+    serving = _serving(q)
+    for i in range(20):
+        q.xadd({"uri": f"a{i}", "data": [0.1] * DIM, "tenant": "acme"})
+        q.xadd({"uri": f"z{i}", "data": [0.2] * DIM, "tenant": "zeta"})
+    _serve_all(serving)
+    usage = serving.health()["usage"]["tenants"]
+    dev_total = sum(v["device_s"] for v in usage.values())
+    busy = serving.registry.histogram(
+        "serving_stage_seconds", labels=("stage",)) \
+        .labels(stage="predict").sum
+    assert busy > 0
+    assert dev_total == pytest.approx(busy, rel=0.05)
+    # and both tenants were actually charged device time
+    assert usage["acme"]["device_s"] > 0
+    assert usage["zeta"]["device_s"] > 0
+
+
+def test_per_tenant_burn_gauge_next_to_global(ctx):
+    """serving_slo_burn_rate keeps its bare fleet-global sample AND gains
+    {tenant=} children for metered tenants — the same metric name, the
+    PR 13 consumers unbroken."""
+    q = InProcQueue()
+    serving = _serving(q, serving_slo={"latency_ms": 500, "window_s": 60,
+                                       "target": 0.99})
+    q.xadd({"uri": "a0", "data": [0.1] * DIM, "tenant": "acme"})
+    q.xadd({"uri": "l0", "data": [0.2] * DIM})
+    _serve_all(serving)
+    text = serving.registry.to_prometheus()
+    lines = [l for l in text.splitlines()
+             if l.startswith("serving_slo_burn_rate")]
+    assert any(l.startswith("serving_slo_burn_rate ") for l in lines), lines
+    assert any(l.startswith('serving_slo_burn_rate{tenant="acme"}')
+               for l in lines), lines
+    assert any(l.startswith(f'serving_slo_burn_rate{{tenant="'
+                            f'{UNKNOWN_TENANT}"}}') for l in lines), lines
+
+
+def test_metering_disabled_engine_serves_unlabelled(ctx):
+    """The off switch restores the pre-PR-19 surface on a REAL engine:
+    unlabelled serving_records_total, no usage block content, drain_usage
+    empty."""
+    q = InProcQueue()
+    serving = _serving(q, metering={"enabled": False})
+    cin = InputQueue(q)
+    rids = [cin.enqueue_tensor(f"r{i}", np.ones(DIM, np.float32))
+            for i in range(6)]
+    _serve_all(serving)
+    got = OutputQueue(q).query_many(rids, timeout_s=30)
+    assert all(r is not None for r in got.values())
+    assert serving.registry.counter("serving_records_total").value == 6
+    assert serving.drain_usage() == []
+    assert serving.health()["usage"]["enabled"] is False
+
+
+# -- generation tokens ---------------------------------------------------------
+
+@pytest.mark.generation
+def test_generation_tokens_charged_per_tenant(ctx):
+    """The continuous batcher charges generation tokens to each slot's
+    tenant at every step boundary: two tenants' labelled token counters
+    sum to exactly the tokens the clients got back."""
+    import base64
+
+    from test_serving_generate import _echo_im
+
+    q = InProcQueue()
+    serving = ClusterServing(
+        _echo_im(128), q,
+        ServingParams(max_batch=8, max_wait_ms=2.0,
+                      generation={"max_active_slots": 4, "max_tokens": 16,
+                                  "eos_id": 100, "max_prompt_len": 8}))
+
+    def enq(rid, tokens, tenant, max_tokens):
+        arr = np.ascontiguousarray(np.asarray(tokens, "<f4"))
+        q.xadd({"uri": rid, "b64": base64.b64encode(arr).decode("ascii"),
+                "dtype": "<f4", "shape": list(arr.shape),
+                "gen": {"max_tokens": max_tokens}, "tenant": tenant})
+
+    enq("ga", [40], "acme", 6)
+    enq("gz", [50], "zeta", 4)
+    _serve_all(serving)
+    ra, rz = q.get_result("ga"), q.get_result("gz")
+    assert ra["value"]["length"] == 6 and ra["tenant"] == "acme"
+    assert rz["value"]["length"] == 4 and rz["tenant"] == "zeta"
+    tok = serving.registry.counter("serving_generated_tokens_total",
+                                   labels=("tenant", "model"))
+    assert tok.labels(tenant="acme", model="default").value == 6
+    assert tok.labels(tenant="zeta", model="default").value == 4
+    usage = serving.health()["usage"]["tenants"]
+    assert usage["acme"]["tokens"] == 6 and usage["zeta"]["tokens"] == 4
+    # generation device time is attributed too (boundary slot rows)
+    assert usage["acme"]["device_s"] > 0
+
+
+# -- durable usage journal -----------------------------------------------------
+
+def test_journal_round_trip_rotation_and_rollup(ctx, tmp_path):
+    """engine.drain_usage -> append_usage -> load_usage -> aggregate_usage
+    reproduces the counters; the spool rotates once past max_bytes; the
+    clock record wall-stamps every delta for --since filtering."""
+    q = InProcQueue()
+    serving = _serving(q)
+    for i in range(4):
+        q.xadd({"uri": f"a{i}", "data": [0.1] * DIM, "tenant": "acme"})
+    _serve_all(serving)
+    pidfile = str(tmp_path / "cs.pid")
+    path = tracecollect.usage_path(pidfile)
+    assert path.endswith(".usage.jsonl")
+    n = tracecollect.append_usage(path, serving.drain_usage(), source="r0")
+    assert n >= 1
+    # a second interval from more traffic
+    for i in range(2):
+        q.xadd({"uri": f"b{i}", "data": [0.2] * DIM, "tenant": "acme"})
+    _serve_all(serving)
+    tracecollect.append_usage(path, serving.drain_usage(), source="r0")
+    recs = tracecollect.load_usage([path])
+    assert all("ts_wall" in r and "clock_skewed" not in r for r in recs)
+    assert all(r.get("replica_id") == "r0" for r in recs)
+    agg = tracecollect.aggregate_usage(recs)
+    assert agg["by"] == "tenant"
+    assert agg["usage"]["acme"]["records"] == 6    # replay == the counter
+    # --since: only deltas drained after the cutoff count
+    cut = sorted(r["ts_wall"] for r in recs)[-1]
+    agg2 = tracecollect.aggregate_usage(recs, since=cut)
+    assert 0 < agg2["usage"]["acme"]["records"] < 6
+    # by=model groups the same totals under the model axis
+    aggm = tracecollect.aggregate_usage(recs, by="model")
+    assert aggm["usage"]["default"]["records"] == 6
+    with pytest.raises(ValueError):
+        tracecollect.aggregate_usage(recs, by="priority")
+    # rotation: a tiny max_bytes rolls the file to .1 and keeps BOTH
+    # generations discoverable + loadable
+    tracecollect.append_usage(path, [{"ts": 1.0, "tenant": "acme",
+                                      "model": "default", "records": 1}],
+                              max_bytes=1)
+    assert os.path.exists(path + ".1")
+    spools = tracecollect.find_usage_spools(pidfile)
+    assert set(spools) == {path, path + ".1"}
+    total = tracecollect.aggregate_usage(tracecollect.load_usage(spools))
+    assert total["usage"]["acme"]["records"] == 7
+
+
+def test_manager_usage_cli_rollup(ctx, tmp_path, capsys):
+    """`manager usage` rolls every replica journal up by tenant or model,
+    prints JSON with --json, and fails loudly when no journal exists —
+    it must work on a STOPPED deployment."""
+    from analytics_zoo_tpu.serving import manager
+
+    pidfile = str(tmp_path / "cs.pid")
+    rc = manager.main(["usage", "--pidfile", pidfile, "--json"])
+    assert rc == 1
+    assert "no usage journals" in capsys.readouterr().err
+    # two replica journals, overlapping tenants
+    tracecollect.append_usage(
+        tracecollect.usage_path(pidfile + ".r0"),
+        [{"ts": 1.0, "tenant": "acme", "model": "default",
+          "records": 3, "tokens": 5}], source="r0")
+    tracecollect.append_usage(
+        tracecollect.usage_path(pidfile + ".r1"),
+        [{"ts": 2.0, "tenant": "acme", "model": "default", "records": 2},
+         {"ts": 2.0, "tenant": "zeta", "model": "default", "records": 4}],
+        source="r1")
+    rc = manager.main(["usage", "--pidfile", pidfile, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["journals"] == 2 and doc["intervals"] == 3
+    assert doc["usage"]["acme"]["records"] == 5
+    assert doc["usage"]["acme"]["tokens"] == 5
+    assert doc["usage"]["zeta"]["records"] == 4
+    rc = manager.main(["usage", "--pidfile", pidfile, "--by", "model",
+                       "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["usage"]["default"]["records"] == 9
+    # the human table mentions every tenant and the journal count
+    rc = manager.main(["usage", "--pidfile", pidfile])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "zeta" in out and "2 journal(s)" in out
+
+
+def test_incident_bundles_capture_usage_journals(ctx, tmp_path):
+    """The usage journal rides incident bundles like span/event spools —
+    the forensic snapshot can answer 'who was burning the fleet'."""
+    from analytics_zoo_tpu.serving import incident
+
+    pidfile = str(tmp_path / "cs.pid")
+    tracecollect.append_usage(
+        tracecollect.usage_path(pidfile),
+        [{"ts": 1.0, "tenant": "acme", "model": "default", "records": 1}])
+    bundle = incident.capture(pidfile, reason="test")
+    assert bundle is not None
+    names = os.listdir(bundle)
+    assert any(n.endswith(".usage.jsonl") for n in names), names
+
+
+def test_fleet_aggregation_sums_usage(ctx):
+    """aggregate_health sums per-tenant usage across replica health docs;
+    docs without a usage block (pre-PR-19 replicas) leave it None."""
+    base = {"served": 1, "queue_depth": 0}
+    doc0 = dict(base, usage={"enabled": True, "tenants": {
+        "acme": {"records": 3, "tokens": 0, "device_s": 0.25,
+                 "bytes": 10, "sheds": 0}}})
+    doc1 = dict(base, usage={"enabled": True, "tenants": {
+        "acme": {"records": 2, "tokens": 4, "device_s": 0.5,
+                 "bytes": 0, "sheds": 1},
+        "zeta": {"records": 7, "tokens": 0, "device_s": 0.0,
+                 "bytes": 0, "sheds": 0}}})
+    agg = fleet.aggregate_health({0: doc0, 1: doc1})
+    assert agg["usage"]["acme"]["records"] == 5
+    assert agg["usage"]["acme"]["device_s"] == pytest.approx(0.75)
+    assert agg["usage"]["acme"]["sheds"] == 1
+    assert agg["usage"]["zeta"]["records"] == 7
+    assert fleet.aggregate_health({0: base})["usage"] is None
+    # fleet_metrics surfaces the same block for `manager metrics`
+    fm = fleet.fleet_metrics({0: doc0, 1: doc1})
+    assert fm["usage"]["zeta"]["records"] == 7
+
+
+def test_merged_prometheus_max_merges_per_tenant_burn(ctx):
+    """Fleet prometheus merge: labelled counters SUM per (tenant, model)
+    series; serving_slo_burn_rate MAX-merges PER TENANT — the fleet's
+    view of a tenant is its worst replica."""
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for reg, n, burn in ((r1, 3, 0.5), (r2, 4, 2.5)):
+        _records_counter(reg).labels(tenant="acme", model="default").inc(n)
+        reg.gauge("serving_slo_burn_rate", labels=("tenant",)) \
+            .labels(tenant="acme").set(burn)
+    merged = fleet.merge_prometheus([r1.to_prometheus(),
+                                     r2.to_prometheus()])
+    assert ('serving_records_total{tenant="acme",model="default"} 7'
+            in merged)
+    assert 'serving_slo_burn_rate{tenant="acme"} 2.5' in merged
+
+
+# -- real-process acceptance ---------------------------------------------------
+
+def _http_json(url, data=None, headers=None, timeout=10):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_two_replica_lb_usage_rollup_survives_stop(tmp_path):
+    """ISSUE 19 acceptance: 2 real replicas behind the LB, two tenants
+    pushing through the front door with X-Tenant headers -> the labelled
+    attribution crosses LB -> gateway -> engine -> journal, `manager
+    usage` matches the client's own counts EXACTLY, and the journal (plus
+    the rollup) survives `manager stop`."""
+    import socket
+
+    from test_serving_lifecycle import _write_zoo_model
+
+    weights, topo = _write_zoo_model(tmp_path)
+    qdir = tmp_path / "queue"
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    port, lb_port = ports
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"model:\n  path: {weights}\n  type: zoo\n  topology: {topo}\n"
+        f"data:\n  src: file:{qdir}\n"
+        "params:\n"
+        "  batch_size: 4\n"
+        f"  http_port: {port}\n"
+        "  drain_s: 2\n"
+        "  compile_cache_dir: off\n")
+    pidfile = str(tmp_path / "cs.pid")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    mgr = [sys.executable, "-m", "analytics_zoo_tpu.serving.manager"]
+    log = str(tmp_path / "supervisor.log")
+    log_f = open(log, "w")
+    proc = subprocess.Popen(
+        mgr + ["start", "-c", str(cfg), "--pidfile", pidfile,
+               "--replicas", "2", "--lb-port", str(lb_port),
+               "--foreground", "--no-prewarm"],
+        cwd=str(tmp_path), env=env, stdout=log_f, stderr=subprocess.STDOUT)
+    counts = {"acme": 12, "zeta": 8}
+    try:
+        deadline = time.monotonic() + 180
+        ready = set()
+        while len(ready) < 2 and time.monotonic() < deadline:
+            assert proc.poll() is None, open(log).read()[-4000:]
+            for i in range(2):
+                try:
+                    code, _ = _http_json(
+                        f"http://127.0.0.1:{port + i}/readyz", timeout=2)
+                    if code == 200:
+                        ready.add(i)
+                except Exception:  # noqa: BLE001 — still booting
+                    pass
+            time.sleep(0.3)
+        assert ready == {0, 1}, open(log).read()[-4000:]
+
+        def push(tenant, n, failures):
+            for i in range(n):
+                uri = f"{tenant}-{i}"
+                body = json.dumps({"uri": uri, "data": [0.1] * 4}).encode()
+                code, ack = _http_json(
+                    f"http://127.0.0.1:{lb_port}/v1/enqueue", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Tenant": tenant,
+                             "X-Priority": "interactive"})
+                if code != 200:
+                    failures.append((uri, code, ack))
+                    continue
+                code, res = _http_json(
+                    f"http://127.0.0.1:{lb_port}/v1/result/{uri}"
+                    "?timeout_s=30", timeout=40)
+                if code != 200 or "value" not in res:
+                    failures.append((uri, code, res))
+                elif res.get("tenant") != tenant:
+                    failures.append((uri, "tenant", res.get("tenant")))
+
+        failures = []
+        threads = [threading.Thread(target=push, args=(t, n, failures))
+                   for t, n in counts.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert failures == [], failures[:5]
+        time.sleep(1.5)        # one journal drain interval past the last ack
+    finally:
+        subprocess.run(mgr + ["stop", "--pidfile", pidfile],
+                       cwd=str(tmp_path), env=env, capture_output=True)
+        try:
+            proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log_f.close()
+    # the deployment is DOWN; the journal is not
+    spools = tracecollect.find_usage_spools(pidfile)
+    assert spools, os.listdir(str(tmp_path))
+    r = subprocess.run(mgr + ["usage", "--pidfile", pidfile, "--json"],
+                       cwd=str(tmp_path), env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    # billing-grade: the rollup matches the client's own counts EXACTLY
+    for tenant, n in counts.items():
+        assert doc["usage"][tenant]["records"] == n, doc["usage"]
+        assert doc["usage"][tenant]["device_s"] > 0, doc["usage"]
+    assert UNKNOWN_TENANT not in doc["usage"], doc["usage"]

@@ -95,7 +95,8 @@ def spans_to_events(spans):
     for s in spans:
         args = {"trace_id": s.get("trace_id"), "uri": s.get("uri")}
         for key in ("error", "replica_id", "span_id", "parent_id",
-                    "tokens", "attempts", "rerouted"):
+                    "tokens", "attempts", "rerouted",
+                    "tenant", "priority"):
             if s.get(key) is not None:
                 args[key] = s[key]
         events.append({
@@ -203,6 +204,13 @@ def summarize(events, top: int = 5):
             "stages": _stage_sums(spans),
             "error": next((e["args"].get("error") for e in spans
                            if (e.get("args") or {}).get("error")), None)}
+        # tenant attribution (PR 19): any span of the trace carrying the
+        # gateway-stamped identity names the trace's tenant/priority
+        for key in ("tenant", "priority"):
+            v = next((e["args"].get(key) for e in spans
+                      if (e.get("args") or {}).get(key)), None)
+            if v is not None:
+                entry[key] = v
         procs = {_proc(e) for e in spans}
         if procs != {"unknown"}:
             entry["processes"] = sorted(procs)
@@ -244,8 +252,10 @@ def _print_human(doc):
         err = f"  ERROR: {t['error']}" if t["error"] else ""
         procs = f" procs={','.join(t['processes'])}" \
             if t.get("processes") else ""
+        who = "".join(f" {k}={t[k]}" for k in ("tenant", "priority")
+                      if t.get(k))
         print(f"  {t['e2e_ms']:>9.3f}ms  uri={t['uri']} "
-              f"trace={t['trace_id']}{procs}  [{stages}]{err}")
+              f"trace={t['trace_id']}{procs}{who}  [{stages}]{err}")
     if doc["gaps"]:
         g = doc["gaps"]
         print(f"\nuntracked gaps (queue residency between stages): "
