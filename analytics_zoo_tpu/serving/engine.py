@@ -977,6 +977,22 @@ class ClusterServing:
                            fn=slots_fn), slots_fn))
             self._last_steps = 0
             self._tps_window = (time.monotonic(), 0)   # (t0, tokens0)
+            # generation continuity (PR 20): where checkpoints spool
+            # (set post-construction by the manager, like profile_dir —
+            # None disables checkpointing even with an interval set) and
+            # the resume counters, materialized at zero so the chaos
+            # acceptance can assert exact deltas
+            self.snapshot_path = None
+            self._last_resumed = 0
+            self._m_resumed = reg.counter(
+                "serving_generations_resumed_total",
+                "Generations resumed from a dead owner's checkpoint")
+            self._m_resumed.inc(0)
+            self._m_resume_wasted = reg.counter(
+                "serving_resume_wasted_tokens_total",
+                "Generated tokens re-computed because a generation "
+                "restarted without (or beyond) a usable checkpoint")
+            self._m_resume_wasted.inc(0)
             # paged KV pool (PR 18): occupancy / free-block / prefix-hit
             # gauges so admission stalls are visible before the typed
             # kv_pool_exhausted flight-recorder event fires
@@ -1357,11 +1373,18 @@ class ClusterServing:
             tid = rec.get("trace_id") if isinstance(rec, dict) else None
             self._span("reclaim", t, t, trace_id=tid, uri=rid)
             prior = existing.get(rid)
+            partial_n = 0
             if isinstance(prior, dict) and prior.get("partial"):
                 # a PARTIAL streaming result (PR 12) is not a terminal
                 # state: the previous owner died mid-generation, so the
                 # record must be re-served, not suppressed — the fresh
-                # terminal result overwrites the stale partial
+                # terminal result overwrites the stale partial.  Its
+                # token count survives as the wasted-work floor the
+                # resume path (PR 20) tries to recover.
+                try:
+                    partial_n = int(prior.get("n") or 0)
+                except (TypeError, ValueError):
+                    partial_n = 0
                 prior = None
             if prior is not None:
                 self.duplicates += 1
@@ -1391,6 +1414,12 @@ class ClusterServing:
                     trace_id=tid)
                 continue
             self._redelivered[rid] = deliveries
+            if self._batcher is not None and isinstance(rec, dict):
+                # generation continuity (PR 20): attach the dead owner's
+                # checkpointed resume state, or meter the restart cost
+                resume = self._load_resume(rid, rec, partial_n)
+                if resume is not None:
+                    rec["_resume"] = resume
             out.append((rid, rec))
         if len(self._redelivered) > 4096:
             # fire-and-forget bound: entries are popped at write/quarantine/
@@ -1412,6 +1441,77 @@ class ClusterServing:
             self._event("reclaim", count=len(out),
                         suppressed=len(entries) - len(out))
         return out
+
+    # -- generation continuity (PR 20) ---------------------------------------
+    def _load_resume(self, rid: str, rec: Dict,
+                     partial_n: int) -> Optional[Dict]:
+        """Recover the dead owner's checkpointed decode state for one
+        reclaimed generation record: follow the lease annotation to its
+        snapshot spool, pick the deepest checkpoint of the matching
+        epoch, and verify its integrity stamp.  Any failure falls back
+        LOUDLY to restart-from-0 (`gen_resume_failed` event) and meters
+        the streamed progress the restart throws away; a success emits
+        `gen_resume` and meters only the partial tail past the last
+        checkpoint."""
+        gp = self._gen_params
+        if gp is None or not gp.resume:
+            # resume disabled: the restart re-computes every token the
+            # dead owner already streamed — metered so the chaos bench's
+            # restart arm measures its true waste
+            if partial_n > 0:
+                self._m_resume_wasted.inc(partial_n)
+            return None
+        try:
+            ann = self.queue.annotation(rid)
+        except Exception:  # noqa: BLE001 — backend hiccup: restart
+            ann = None
+        if not isinstance(ann, dict) or not ann.get("spool"):
+            if partial_n > 0:
+                self._m_resume_wasted.inc(partial_n)
+                self._event("gen_resume_failed", rid=rid,
+                            reason="no-annotation", wasted=partial_n)
+            return None
+        from analytics_zoo_tpu.serving import tracecollect
+        spool = str(ann["spool"])
+        epoch = int(ann.get("epoch") or 0)
+        best = None
+        try:
+            paths = [path for path in (spool, spool + ".1")
+                     if os.path.exists(path)]
+            for snap in tracecollect.load_snapshots(paths):
+                if snap.get("rid") != rid \
+                        or int(snap.get("epoch") or 0) != epoch:
+                    continue
+                if best is None \
+                        or int(snap.get("n") or 0) > int(best["n"] or 0):
+                    best = snap
+        except Exception:  # noqa: BLE001 — unreadable spool: restart
+            best = None
+        reason = None
+        if best is None:
+            reason = "no-snapshot"
+        else:
+            try:
+                crc = int(best.get("crc"))
+            except (TypeError, ValueError):
+                crc = None
+            if crc != tracecollect.snapshot_checksum(best):
+                reason = "checksum-mismatch"
+        tid = rec.get("trace_id")
+        if reason is not None:
+            self._m_resume_wasted.inc(partial_n)
+            self._event("gen_resume_failed", rid=rid, trace_id=tid,
+                        reason=reason, wasted=partial_n)
+            return None
+        n = int(best.get("n") or 0)
+        wasted = max(0, partial_n - n)
+        if wasted:
+            self._m_resume_wasted.inc(wasted)
+        self._event("gen_resume", rid=rid, trace_id=tid, epoch=epoch,
+                    resumed_tokens=n, wasted=wasted,
+                    from_replica=ann.get("replica"))
+        return {"tokens": [int(t) for t in best.get("tokens") or []],
+                "epoch": epoch + 1}
 
     # -- result write with backpressure (ClusterServing.scala:276-307) -------
     def _put_result(self, rid, value):
@@ -1934,6 +2034,10 @@ class ClusterServing:
                     # after the record dict is gone: ride it on the meta
                     meta["_priority"] = normalize_priority(
                         rec.get("priority"))
+                if isinstance(rec.get("_resume"), dict):
+                    # resume state stapled on by _maybe_reclaim (PR 20)
+                    # must survive to _submit_group, like the identity
+                    meta["_resume"] = rec["_resume"]
                 items.append((rid, item, rec.get("deadline_ns"),
                               rec.get("trace_id"), meta))
             except Exception as e:  # noqa: BLE001 — malformed record
@@ -2505,10 +2609,31 @@ class ClusterServing:
                     meta.get("_priority", "batch"))
                 if clamp is not None:
                     mt = clamp if mt is None else min(mt, clamp)
+            resume = meta.get("_resume")
+            rtoks, epoch = None, 0
+            if isinstance(resume, dict):
+                rtoks = resume.get("tokens") or None
+                try:
+                    epoch = int(resume.get("epoch") or 0)
+                except (TypeError, ValueError):
+                    epoch = 0
             req = GenRequest(rid, np.asarray(tensors[i]),
                              deadline_ns=deadlines[i],
                              trace_id=traces[i], t_read=group.t_read,
-                             max_tokens=mt, tenant=meta.get("_tenant"))
+                             max_tokens=mt, tenant=meta.get("_tenant"),
+                             resume_tokens=rtoks, epoch=epoch)
+            if self.snapshot_path is not None \
+                    and (self._gen_params.checkpoint_interval or 0) > 0:
+                # ownership + resume state travel together (PR 20): the
+                # lease annotation points the NEXT owner at this
+                # replica's snapshot spool under this epoch
+                try:
+                    self.queue.annotate(rid, {
+                        "spool": self.snapshot_path,
+                        "epoch": epoch,
+                        "replica": self.replica_id})
+                except Exception:  # noqa: BLE001 — best-effort: a lost
+                    pass           # annotation degrades to restart-from-0
             while not self._batcher.submit(req):
                 if self._stop.is_set():
                     return
@@ -2552,6 +2677,20 @@ class ClusterServing:
             self._m_decode_steps.inc(steps - self._last_steps)
             self._last_steps = steps
         self._update_tps(now)
+        # generation continuity (PR 20): spool this boundary's
+        # checkpoints BEFORE the crash fault below, so an injected
+        # mid-decode kill dies with its resume state already durable —
+        # the same ordering a real preemption depends on
+        self._maybe_checkpoint()
+        if b.resumed > self._last_resumed:
+            self._m_resumed.inc(b.resumed - self._last_resumed)
+            self._last_resumed = b.resumed
+        if self._faults.decode_crash_active \
+                and self._faults.take_decode_crash(b.generated_tokens):
+            logger.error(
+                "faults: injected decode_crash_after_n_tokens (%d "
+                "generated) — exiting", b.generated_tokens)
+            os._exit(3)
         kinds = [ev.kind for ev in events]
         if any(k in ("finish", "shed", "quarantine") for k in kinds) or \
                 b.last_admitted:
@@ -2565,6 +2704,47 @@ class ClusterServing:
                         shed=kinds.count("shed"),
                         quarantined=kinds.count("quarantine"))
         self._handle_gen_events(events)
+
+    def _maybe_checkpoint(self) -> None:
+        """Drain the scheduler's queued resume snapshots into the
+        per-replica gensnap spool (the tracecollect rotation/clock
+        contract), stamping each with its integrity checksum — which the
+        armed ``snapshot_corrupt`` fault deliberately breaks, so the
+        resume path's verification is provable.  Engines without a wired
+        ``snapshot_path`` (the manager sets it next to the pidfile)
+        discard the drained batch: checkpointing is durable-or-off,
+        never silently buffered."""
+        b = self._batcher
+        if not b.pending_checkpoints:
+            return
+        snaps = b.drain_checkpoints()
+        if self.snapshot_path is None:
+            return
+        from analytics_zoo_tpu.serving import tracecollect
+        corrupt = self._faults.snapshot_corrupt_active
+        for rec in snaps:
+            crc = tracecollect.snapshot_checksum(rec)
+            if corrupt:
+                crc ^= 0x5A5A5A5A
+            rec["crc"] = crc
+        try:
+            tracecollect.append_snapshots(self.snapshot_path, snaps,
+                                          source=self.replica_id)
+            size = 0
+            for path in (self.snapshot_path, self.snapshot_path + ".1"):
+                try:
+                    size += os.path.getsize(path)
+                except OSError:
+                    pass
+            b.snapshot_bytes = size
+            self._event("gen_checkpoint", count=len(snaps),
+                        tokens=sum(int(r.get("n") or 0) for r in snaps),
+                        spool_bytes=size)
+        except Exception as e:  # noqa: BLE001 — a full/readonly disk
+            # must not take decode down; resume degrades to older
+            # snapshots (or restart-from-0), both loud on the other side
+            logger.warning("serving: checkpoint spool write failed "
+                           "(%s: %s)", type(e).__name__, e)
 
     def _update_tps(self, now: float) -> None:
         """Roll the tokens/sec rate window.  Called from every generate
@@ -2609,8 +2789,13 @@ class ClusterServing:
                 try:
                     # streaming is best-effort: a failed partial write
                     # must not retry-storm or quarantine a LIVE request —
-                    # the next interval (or the terminal write) overwrites
-                    self.queue.put_result(ev.rid, value)
+                    # the next interval (or the terminal write)
+                    # overwrites.  put_partial (PR 20) refuses to shadow
+                    # a terminal: after a resume, the DEAD owner's last
+                    # partial may still be in flight from its dying
+                    # process, and one lineage must converge on the
+                    # resumed terminal.
+                    self.queue.put_partial(ev.rid, value)
                 except Exception:  # noqa: BLE001
                     pass
             elif ev.kind == "finish":
@@ -2650,6 +2835,17 @@ class ClusterServing:
                                  RuntimeError(ev.error or "generation "
                                                           "failed"),
                                  trace_id=ev.trace_id, tenant=ev.tenant)
+            elif ev.kind == "resume_failed":
+                # scheduler-level downgrade (PR 20): the resume prefix
+                # could not be replayed (bare-state model, malformed
+                # prefix, capacity) — the request restarts from 0; its
+                # prefix is recomputed work, metered as wasted
+                wasted = len(ev.tokens or ())
+                if wasted:
+                    self._m_resume_wasted.inc(wasted)
+                self._event("gen_resume_failed", rid=ev.rid,
+                            trace_id=ev.trace_id, reason=ev.error,
+                            wasted=wasted)
         if not pairs:
             return
         tmap = {ev.rid: ev.trace_id for ev in finals}

@@ -30,6 +30,17 @@ Config shape (``params.faults`` in config.yaml)::
         version: v2             # requests with reason "fault"
         count: 5
         priority: best_effort   # optional: only this class is rejected
+      decode_crash_after_n_tokens:   # process exits (os._exit) once the
+        version: "*"                 # generation plane has produced n
+        n: 12                        # tokens (PR 20 resume chaos)
+        once: /tmp/crash.marker      # optional marker file: created at
+                                     # fire, and any process that SEES it
+                                     # skips the fault — exactly one crash
+                                     # per deployment even under
+                                     # supervisor respawn
+      snapshot_corrupt:         # generation checkpoints are written with
+        version: "*"            # a broken integrity checksum, so resume
+                                # must detect + fall back loudly (PR 20)
 
 Every knob is deterministic: no randomness, no time-of-day dependence —
 the same config and record sequence produce the same failures, so the
@@ -91,6 +102,10 @@ class FaultInjector:
                                   model_version)
         self._admission_reject = _gate(faults.get("admission_reject"),
                                        model_version)
+        self._decode_crash = _gate(
+            faults.get("decode_crash_after_n_tokens"), model_version)
+        self._snapshot_corrupt = _gate(faults.get("snapshot_corrupt"),
+                                       model_version)
         self._predict_calls = 0
         self._claim_stalls_left = int(
             (self._claim_stall or {}).get("count", 1))
@@ -116,10 +131,20 @@ class FaultInjector:
         return self._admission_reject is not None
 
     @property
+    def decode_crash_active(self) -> bool:
+        return self._decode_crash is not None
+
+    @property
+    def snapshot_corrupt_active(self) -> bool:
+        return self._snapshot_corrupt is not None
+
+    @property
     def any_active(self) -> bool:
         return (self.predict_active or self.readyz_active
                 or self.claim_active or self.admission_active
-                or self._warmup_crash is not None)
+                or self._warmup_crash is not None
+                or self.decode_crash_active
+                or self.snapshot_corrupt_active)
 
     def describe(self) -> list:
         """Armed fault-point names (rides the health doc so an armed
@@ -137,6 +162,10 @@ class FaultInjector:
             out.append("claim_stall")
         if self._admission_reject is not None:
             out.append("admission_reject")
+        if self._decode_crash is not None:
+            out.append("decode_crash_after_n_tokens")
+        if self._snapshot_corrupt is not None:
+            out.append("snapshot_corrupt")
         return out
 
     # -- fault points ---------------------------------------------------------
@@ -197,6 +226,35 @@ class FaultInjector:
         if want and priority is not None and str(want) != str(priority):
             return False
         self._admission_rejects_left -= 1
+        return True
+
+    def take_decode_crash(self, generated_tokens: int) -> bool:
+        """``decode_crash_after_n_tokens`` (PR 20): True when the process
+        must die NOW — the generation plane has produced at least ``n``
+        tokens and the optional ``once`` marker has not been claimed.
+        Creating the marker BEFORE returning makes the crash
+        exactly-once per deployment: the supervisor's respawn (and every
+        sibling replica) sees the marker and skips the fault, so the
+        chaos test gets ONE mid-decode kill instead of a crash loop.
+        The ENGINE exits (``os._exit``, the ``warmup_crash`` pattern) so
+        tests can call this without dying."""
+        spec = self._decode_crash
+        if spec is None:
+            return False
+        if generated_tokens < int(spec.get("n", 1)):
+            return False
+        marker = spec.get("once")
+        if marker:
+            try:
+                # O_CREAT|O_EXCL: atomic claim — two replicas crossing
+                # the threshold in the same tick still crash only once
+                fd = os.open(str(marker),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+            except FileExistsError:
+                return False
+            except OSError:
+                return False               # unwritable marker: stay safe
         return True
 
     def readyz_block_reason(self, uptime_s: float) -> Optional[str]:

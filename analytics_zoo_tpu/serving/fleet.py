@@ -165,6 +165,12 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
                 "prefix_evictions": 0, "exhausted": 0,
                 "active_slots": 0}
     gen_pool_seen = False
+    # generation continuity (PR 20): resume traffic SUMS across replicas
+    # (a crash on one replica surfaces as a resume on another — fleet
+    # totals are the only view where both sides of the handoff meet)
+    continuity = {"resumed": 0, "resume_failed": 0, "checkpoints": 0,
+                  "snapshot_bytes": 0}
+    continuity_seen = False
     # usage attribution (PR 19): per-tenant cumulative totals SUM across
     # replicas (each meters its own traffic; the LB spreads one tenant
     # over many replicas)
@@ -238,6 +244,10 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
                       "exhausted"):
                 gen_pool[k] += int(gp.get(k) or 0)
             gen_pool["active_slots"] += int(g.get("active_slots") or 0)
+        if isinstance(g.get("resumed"), int):
+            continuity_seen = True
+            for k in continuity:
+                continuity[k] += int(g.get(k) or 0)
         u = doc.get("usage") or {}
         if isinstance(u.get("tenants"), dict):
             usage_seen = True
@@ -295,6 +305,9 @@ def aggregate_health(docs: Dict[int, Dict]) -> Dict:
             "kv_pool": dict(gen_pool, occupancy=round(
                 gen_pool["used_blocks"] / max(1, gen_pool["blocks"]), 4))
             if gen_pool_seen else None,
+            # generation continuity (PR 20): summed resume/checkpoint
+            # traffic (None when no replica runs a generation plane)
+            "continuity": dict(continuity) if continuity_seen else None,
             "process": dict(proc, cpu_seconds=round(proc["cpu_seconds"],
                                                     3))
             if proc_seen else None,

@@ -112,6 +112,16 @@ class GenerationParams:
       per-(block, head) scales, dequantized in-kernel at decode.
     - ``prefix_cache`` — share resident full-block prompt prefixes
       across requests (LRU index, evicted when the pool runs dry).
+    - ``checkpoint_interval`` — generation continuity (PR 20): snapshot
+      every active slot's resume state each time it accrues this many
+      new tokens (0 = off).  Snapshots are collected at step boundaries
+      and spooled by the engine off the hot path.
+    - ``resume`` — admit reclaimed records carrying a valid snapshot as
+      RESUMES: prefill over prompt + generated-so-far, continue decoding
+      at the exact token position (greedy decode makes the continuation
+      token-exact — streamed partials are always a prefix of the
+      terminal).  Needs a cache model; bare-state models downgrade
+      loudly to restart-from-0.
     """
 
     max_active_slots: int = 8
@@ -128,6 +138,11 @@ class GenerationParams:
     pool_blocks: Optional[int] = None
     kv_quant: str = "off"
     prefix_cache: bool = True
+    # generation continuity (PR 20): checkpoint active slots' resume
+    # state every `checkpoint_interval` generated tokens (0 = off) and
+    # admit reclaimed records with a valid snapshot as resumes
+    checkpoint_interval: int = 0
+    resume: bool = False
 
     def __post_init__(self):
         self.max_active_slots = max(1, int(self.max_active_slots))
@@ -162,6 +177,19 @@ class GenerationParams:
         cap = _pow2_ceil(self.max_prompt_len)
         if self.prefill_buckets[-1] < cap:
             self.prefill_buckets.append(cap)
+        self.checkpoint_interval = max(0, int(self.checkpoint_interval))
+        self.resume = bool(self.resume)
+        if self.resume:
+            # a resume re-prefills over prompt + generated_so_far, which
+            # can reach max_prompt_len + max_tokens — extend the ladder so
+            # the resume prefill is a warmed program, never a steady-state
+            # compile (warmup_manifest walks prefill_buckets; the AOT
+            # manifest filters pb > lane automatically)
+            rcap = _pow2_ceil(self.max_prompt_len + self.max_tokens)
+            last = self.prefill_buckets[-1]
+            while last < rcap:
+                last *= 2
+                self.prefill_buckets.append(last)
 
     @classmethod
     def from_dict(cls, d: Optional[Dict]) -> "GenerationParams":
@@ -175,21 +203,29 @@ class GenRequest:
     """One admitted generation request (engine-internal)."""
 
     __slots__ = ("rid", "prompt", "deadline_ns", "trace_id", "t_read",
-                 "max_tokens", "t_submit", "tenant")
+                 "max_tokens", "t_submit", "tenant", "resume_tokens",
+                 "epoch")
 
     def __init__(self, rid: str, prompt: np.ndarray,
                  deadline_ns: Optional[int] = None,
                  trace_id: Optional[str] = None,
                  t_read: Optional[float] = None,
                  max_tokens: Optional[int] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 resume_tokens: Optional[List[int]] = None,
+                 epoch: int = 0):
         self.rid = rid
-        self.prompt = prompt
+        self.prompt = prompt            # ORIGINAL prompt, resume or not
         self.deadline_ns = deadline_ns
         self.trace_id = trace_id
         self.t_read = t_read
         self.max_tokens = max_tokens
         self.tenant = tenant
+        # generation continuity (PR 20): tokens a dead owner already
+        # produced — admission pre-seeds the slot with them and prefills
+        # over prompt + resume_tokens; epoch counts ownership handoffs
+        self.resume_tokens = resume_tokens
+        self.epoch = int(epoch)
         self.t_submit = time.monotonic()
 
 
@@ -200,7 +236,9 @@ class GenEvent:
     ``kind``: ``first_token`` (TTFT stamp), ``partial`` (stream
     tokens-so-far), ``finish`` (terminal result), ``shed``
     (deadline-exceeded at a step boundary), ``quarantine`` (poisoned
-    request isolated)."""
+    request isolated), ``resume_failed`` (PR 20: a resume prefix could
+    not be replayed — the request restarts from token 0, loudly;
+    ``tokens`` carries the wasted prefix, ``error`` the reason)."""
 
     kind: str
     rid: str
@@ -215,7 +253,8 @@ class GenEvent:
 
 
 class _Slot:
-    __slots__ = ("req", "generated", "t_first", "last_stream", "budget")
+    __slots__ = ("req", "generated", "t_first", "last_stream", "budget",
+                 "ckpt_mark")
 
     def __init__(self, req: GenRequest, budget: int):
         self.req = req
@@ -223,6 +262,8 @@ class _Slot:
         self.t_first: Optional[float] = None
         self.last_stream = 0
         self.budget = budget
+        # tokens-generated count at the last checkpoint (PR 20)
+        self.ckpt_mark = 0
 
 
 class _Lane:
@@ -361,6 +402,15 @@ class ContinuousBatcher:
         self.finished = 0
         self.quarantined = 0
         self.shed = 0
+        # generation continuity (PR 20): resume admissions, loud
+        # downgrades to restart-from-0, and checkpoints collected at step
+        # boundaries (the engine drains + spools them off the hot path);
+        # snapshot_bytes mirrors the spool size for the ResourceLedger
+        self.resumed = 0
+        self.resume_failed = 0
+        self.checkpoints = 0
+        self.snapshot_bytes = 0
+        self.pending_checkpoints: List[Dict] = []
         # COMPILE_STATS listeners: steady-state zero-compile evidence
         from analytics_zoo_tpu.inference import aot
         aot.install_compile_listeners()
@@ -658,17 +708,97 @@ class ContinuousBatcher:
         """Smallest lane whose capacity holds prompt + budget AND the
         prompt's padded prefill bucket (prefill allocates the cache at
         the lane capacity, so ``cache_len >= prefill bucket`` must hold);
-        bare-state models (no length axis) use the first lane."""
+        bare-state models (no length axis) use the first lane.  A resume
+        (PR 20) prefills over prompt + resume prefix, so its prefill
+        bucket is computed from the CONCAT length; total cache occupancy
+        is still prompt + budget (the prefix counts against the budget)."""
         if not self._cache_model:
             return self._lanes[0]
         want = len(req.prompt) + self._req_budget(req)
-        pb = self._prefill_bucket(len(req.prompt))
+        pb = self._prefill_bucket(len(req.prompt)
+                                  + len(req.resume_tokens or ()))
         if pb is not None:
             want = max(want, pb)
         for lane in self._lanes:
             if lane.bucket >= want:
                 return lane
         return None
+
+    # -- resume admission (PR 20) ---------------------------------------------
+    def _concat_prompt(self, req: GenRequest) -> np.ndarray:
+        """Prefill input: the original prompt, plus — for a resume — the
+        tokens the dead owner already produced (replaying them through
+        prefill rebuilds the exact cache a continuous decode would hold,
+        and greedy decode over it continues token-exactly)."""
+        p = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+        if not req.resume_tokens:
+            return p
+        return np.concatenate([p, np.asarray(req.resume_tokens,
+                                             np.int32)])
+
+    def _downgrade_resume(self, req: GenRequest, reason: str,
+                          events: List[GenEvent]) -> None:
+        """Fall back LOUDLY to restart-from-0: the wasted prefix rides
+        the event so the engine can meter it."""
+        toks = [int(t) for t in req.resume_tokens or ()
+                if isinstance(t, (int, float, np.integer))]
+        req.resume_tokens = None
+        self.resume_failed += 1
+        events.append(GenEvent(
+            "resume_failed", req.rid, trace_id=req.trace_id,
+            tokens=toks, error=reason, t_read=req.t_read,
+            tenant=req.tenant))
+
+    def _take_resume(self, req: GenRequest,
+                     events: List[GenEvent]) -> None:
+        """Normalize a reclaimed request's resume prefix, downgrading to
+        restart-from-0 when it cannot be replayed: bare-state models
+        rebuild no cache at prefill (continuing would NOT be a prefix of
+        an uninterrupted run), and a malformed or out-of-vocab prefix
+        would poison the decode state."""
+        rt = req.resume_tokens
+        if not rt:
+            req.resume_tokens = None
+            return
+        try:
+            toks = [int(t) for t in rt]
+        except (TypeError, ValueError):
+            self._downgrade_resume(req, "malformed resume prefix", events)
+            return
+        if not self._cache_model:
+            self._downgrade_resume(
+                req, "bare-state model cannot replay decode state",
+                events)
+            return
+        if self._vocab and toks and (min(toks) < 0
+                                     or max(toks) >= self._vocab):
+            self._downgrade_resume(
+                req, "resume token id out of vocab range", events)
+            return
+        cap = self._req_budget(req) - 1
+        if cap < 1:
+            self._downgrade_resume(req, "token budget already consumed",
+                                   events)
+            return
+        # a prefix at/over budget should have finished at the old owner;
+        # keep budget-1 so the resumed slot still decodes >= 1 token
+        req.resume_tokens = toks[:cap]
+
+    def _seed_resume(self, info: _Slot) -> None:
+        """Pre-seed a just-admitted slot with its resume prefix: the
+        terminal token list stays the full generation (partials remain a
+        prefix of it), while `last_stream`/`ckpt_mark` start past the
+        prefix so streaming cadence and checkpoint cadence resume where
+        the dead owner left off.  `step()`'s boundary accounting reports
+        only post-admission deltas, so the engine meters delta tokens
+        only — no double-billing across the resume epoch."""
+        rt = info.req.resume_tokens
+        if not rt:
+            return
+        info.generated = [int(t) for t in rt]
+        info.last_stream = len(info.generated)
+        info.ckpt_mark = len(info.generated)
+        self.resumed += 1
 
     def _validate(self, req: GenRequest) -> Optional[str]:
         p = np.asarray(req.prompt)
@@ -708,13 +838,12 @@ class ContinuousBatcher:
 
         A failing batch falls back to singleton admission so a poisoned
         request that slipped past validation quarantines ALONE."""
-        import jax
         n = len(members)
         bb = self._batch_bucket(n)
         padded = np.zeros((bb, pb), np.int32)
         lengths = np.ones((bb,), np.int32)
         for j, (req, _) in enumerate(members):
-            prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+            prompt = self._concat_prompt(req)
             padded[j, :prompt.size] = prompt
             lengths[j] = prompt.size
         for j in range(n, bb):
@@ -729,7 +858,10 @@ class ContinuousBatcher:
             self._count_exec(("prefill", bb, pb, lane.bucket))
             if self._is_pair(res):
                 sub, logits0 = res
-                toks0 = np.asarray(jax.numpy.argmax(logits0, axis=-1))
+                # host-side argmax (matches the paged path): an eager
+                # jnp.argmax would XLA-compile once per batch bucket —
+                # a steady-state compile the admission path must not pay
+                toks0 = np.asarray(logits0).argmax(axis=-1)
             else:
                 sub, toks0 = res, None
             ins = self._compiled(("insert", bb, lane.bucket), insert,
@@ -763,6 +895,7 @@ class ContinuousBatcher:
                 lane.free.append(slot)
                 continue
             info = _Slot(req, budget=self._budget_for(req, lane))
+            self._seed_resume(info)
             lane.slots[slot] = info
             self.admitted += 1
             admitted += 1
@@ -789,10 +922,13 @@ class ContinuousBatcher:
         runs dry.  Returns ``(k_shared, shared_ids, private_ids, plen)``
         or None (pool exhausted: the caller requeues and a typed
         ``kv_pool_exhausted`` flight-recorder event explains the stall)."""
-        prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+        prompt = self._concat_prompt(req)
         plen = int(prompt.size)
         bl = self.gen.block_len
-        need = (plen + self._budget_for(req, lane) + bl - 1) // bl
+        # a resume's prefix tokens count against the budget, so blocks
+        # for (concat - prefix) + budget == original prompt + budget
+        need = (plen - len(req.resume_tokens or ())
+                + self._budget_for(req, lane) + bl - 1) // bl
         need = min(need, lane.ntab)
         ksh, shared = 0, []
         if self._prefix is not None:
@@ -856,6 +992,11 @@ class ContinuousBatcher:
                     error=f"ValueError: {err}", t_read=req.t_read,
                     tenant=req.tenant))
                 continue
+            if req.resume_tokens:
+                self._take_resume(req, events)
+            if self._pick_lane(req) is None and req.resume_tokens:
+                self._downgrade_resume(
+                    req, "resume prefix exceeds lane capacity", events)
             if self._pick_lane(req) is None:
                 self.quarantined += 1
                 events.append(GenEvent(
@@ -924,7 +1065,7 @@ class ContinuousBatcher:
             plens = np.zeros((bb,), np.int32)
         for j, (req, slot, resv) in enumerate(members):
             ksh, shared_ids, priv, plen = resv
-            prompt = np.asarray(req.prompt).astype(np.int32).reshape(-1)
+            prompt = self._concat_prompt(req)
             table = list(shared_ids) + list(priv)
             if shared is not None:
                 suffix = prompt[ksh * bl:]
@@ -992,17 +1133,18 @@ class ContinuousBatcher:
             lane.pos[slot] = plen
             lane.blocks[slot] = table
             info = _Slot(req, budget=self._budget_for(req, lane))
+            self._seed_resume(info)
             lane.slots[slot] = info
             self.admitted += 1
             admitted += 1
             if self._prefix is not None and ksh == 0:
                 # park the prompt's FULL blocks for future sharers (the
                 # partial tail block keeps being written by decode, so
-                # it can never be shared)
+                # it can never be shared); a resume registers the CONCAT
+                # prefix — that is what its resident pages actually hold
                 full = plen // bl
                 if full:
-                    prompt = np.asarray(req.prompt).astype(np.int32) \
-                        .reshape(-1)
+                    prompt = self._concat_prompt(req)
                     self._prefix.register(prompt[:full * bl],
                                           table[:full])
             info.t_first = time.monotonic()
@@ -1040,7 +1182,15 @@ class ContinuousBatcher:
                     error=f"ValueError: {err}", t_read=req.t_read,
                     tenant=req.tenant))
                 continue
+            if req.resume_tokens:
+                self._take_resume(req, events)
             lane = self._pick_lane(req)
+            if lane is None and req.resume_tokens:
+                # the concat prefix pushed the prefill bucket past every
+                # lane: a VALID request must not quarantine — restart it
+                self._downgrade_resume(
+                    req, "resume prefix exceeds lane capacity", events)
+                lane = self._pick_lane(req)
             if lane is None:
                 self.quarantined += 1
                 events.append(GenEvent(
@@ -1061,8 +1211,14 @@ class ContinuousBatcher:
             return 0
         groups: Dict[tuple, list] = {}
         for req, lane, slot in grabbed:
-            prompt_len = int(np.asarray(req.prompt).reshape(-1).size)
+            prompt_len = int(np.asarray(req.prompt).reshape(-1).size) \
+                + len(req.resume_tokens or ())
             pb = self._prefill_bucket(prompt_len)
+            if pb is None and req.resume_tokens:
+                self._downgrade_resume(
+                    req, "no prefill bucket holds resume prefix", events)
+                prompt_len = int(np.asarray(req.prompt).reshape(-1).size)
+                pb = self._prefill_bucket(prompt_len)
             if pb is None:
                 # defensive: __post_init__ extends the ladder to cover
                 # max_prompt_len, so this is unreachable from config —
@@ -1219,7 +1375,53 @@ class ContinuousBatcher:
             # copy: the device block is read-only, and the next boundary's
             # admission writes freshly-claimed slots into this row
             lane.tokens = np.array(block[-1])
+        if self.gen.checkpoint_interval > 0 and self._cache_model:
+            self._collect_checkpoints()
         return events
+
+    def _collect_checkpoints(self) -> None:
+        """Queue resume-state snapshots for slots that crossed the
+        checkpoint interval since their last mark.  Host-side list work
+        only — the engine drains `pending_checkpoints` and spools them
+        OFF this thread, so the decode hot path never waits on disk.
+        Bare-state models are skipped entirely: their decode state cannot
+        be rebuilt by prefill, so a snapshot could never be resumed."""
+        interval = self.gen.checkpoint_interval
+        now = time.monotonic()
+        for lane in self._lanes:
+            for info in lane.slots:
+                if info is None:
+                    continue
+                n = len(info.generated)
+                if n - info.ckpt_mark < interval:
+                    continue
+                req = info.req
+                prompt = np.asarray(req.prompt).reshape(-1)
+                self.pending_checkpoints.append({
+                    "rid": req.rid,
+                    "epoch": req.epoch,
+                    "prompt": [int(t) for t in prompt],
+                    "tokens": list(info.generated),
+                    "n": n,
+                    "tenant": req.tenant,
+                    "trace_id": req.trace_id,
+                    "deadline_ns": req.deadline_ns,
+                    "max_tokens": req.max_tokens,
+                    # greedy argmax decode: the "RNG stream" is the
+                    # degenerate deterministic one — recorded so a future
+                    # sampling decode can refuse to resume across a
+                    # sampler change instead of silently diverging
+                    "sampler": "greedy",
+                    "ts": now,
+                })
+                info.ckpt_mark = n
+                self.checkpoints += 1
+
+    def drain_checkpoints(self) -> List[Dict]:
+        """Hand the queued snapshots to the engine (scheduler thread
+        only, like `step`)."""
+        out, self.pending_checkpoints = self.pending_checkpoints, []
+        return out
 
     @property
     def idle(self) -> bool:
@@ -1396,6 +1598,10 @@ class ContinuousBatcher:
             else:
                 lanes_b += self._leaf_bytes(
                     jax.tree_util.tree_leaves(lane.state))
+        # snapshot spool bytes (PR 20): host/disk-side, but pinned BY the
+        # generation plane — the engine mirrors the spool size here so
+        # the ledger's aux component owns continuity state too
+        aux_b += int(self.snapshot_bytes)
         return {"lanes": lanes_b, "paged_pool": pool_b,
                 "scales": scales_b, "aux": aux_b,
                 "total": lanes_b + pool_b + scales_b + aux_b}
@@ -1427,6 +1633,11 @@ class ContinuousBatcher:
              "quarantined": self.quarantined,
              "shed": self.shed,
              "compiles": self.compiles,
+             "resumed": self.resumed,
+             "resume_failed": self.resume_failed,
+             "checkpoints": self.checkpoints,
+             "snapshot_bytes": self.snapshot_bytes,
+             "can_resume": bool(self._cache_model),
              "lanes": [{"bucket": lane.bucket,
                         "max_active": lane.max_active,
                         "active": lane.active}

@@ -572,6 +572,12 @@ def _run_foreground(config_path: str, pidfile: str,
     # on-demand profiling (PR 15): traces land next to the deployment's
     # other artifacts, shared across the replicas of one base pidfile
     serving.profile_dir = _profiles_dir(base)
+    # generation continuity (PR 20): checkpoints spool next to THIS
+    # replica's pidfile (per-replica ownership, like span/event spools) —
+    # the engine writes it directly at step boundaries, because the
+    # manager's 1 s drain cadence is far too slow for crash durability
+    from analytics_zoo_tpu.serving import tracecollect as _tc
+    serving.snapshot_path = _tc.gensnap_path(pidfile)
     health_path = _health_path(pidfile)
     if knobs_path is None:
         knobs_path = _knobs_path(pidfile)

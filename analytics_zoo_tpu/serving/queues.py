@@ -181,6 +181,36 @@ class BaseQueue:
         directory listing, InProc one lock).  Missing keys map to None."""
         return {key: self.get_result(key) for key in keys}
 
+    # -- streamed partials (PR 20 generation continuity) ---------------------
+    def put_partial(self, key: str, value: Dict) -> bool:
+        """Write a STREAMED partial result — refuses to overwrite a terminal.
+        A dead owner's partial may race the resumed terminal onto the same
+        key from two processes; terminals must win, so a partial write is
+        check-then-write (atomic where the backend can make it so, see
+        `InProcQueue`; File/Redis accept the tiny window because the loser
+        there is still a *newer* partial of the same lineage, never a
+        terminal being shadowed — partial writers stop at finish).  Returns
+        False when a terminal already occupies the key."""
+        prior = self.get_result(key)
+        if isinstance(prior, dict) and not prior.get("partial"):
+            return False
+        self.put_result(key, value)
+        return True
+
+    # -- lease annotations (PR 20 generation continuity) ---------------------
+    def annotate(self, rid: str, meta: Dict) -> None:
+        """Attach small JSON metadata to an in-flight record's lease lineage
+        (the snapshot-spool pointer + generation epoch).  Annotations ride
+        the queue — NOT the record — so a reclaim on a different replica can
+        find the dead owner's resume state by rid alone.  They survive the
+        claim itself (a reclaim re-annotates) and are dropped at ``ack``.
+        The default is a no-op so custom backends without resume support
+        stay correct."""
+
+    def annotation(self, rid: str) -> Optional[Dict]:
+        """The current annotation for ``rid``, or None."""
+        return None
+
     def result_count(self) -> int:
         raise NotImplementedError
 
@@ -384,6 +414,10 @@ class InProcQueue(BaseQueue):
         # consumer, deliveries}.  read_batch moves records here instead of
         # destroying them; ack() removes; reclaim() re-delivers expired ones.
         self._pending: Dict[str, Dict] = {}
+        # lease annotations (PR 20): rid -> resume-state pointer.  Engines
+        # under test share ONE InProcQueue instance, so this dict IS the
+        # cross-"replica" channel the File/Redis backends get from disk.
+        self._annotations: Dict[str, Dict] = {}
         self._lock = threading.Lock()
         self.max_depth = max_depth
 
@@ -453,6 +487,7 @@ class InProcQueue(BaseQueue):
         with self._lock:
             for rid in rids:
                 self._pending.pop(rid, None)
+                self._annotations.pop(rid, None)
 
     def reclaim(self, min_idle_s, max_items=64):
         now = time.monotonic()
@@ -498,6 +533,25 @@ class InProcQueue(BaseQueue):
         with self._lock:
             for key, value in pairs:
                 self._results[key] = value
+
+    def put_partial(self, key, value):
+        # check-then-write inside ONE critical section: a partial can
+        # never shadow a terminal even with racing writer threads
+        with self._lock:
+            prior = self._results.get(key)
+            if isinstance(prior, dict) and not prior.get("partial"):
+                return False
+            self._results[key] = value
+            return True
+
+    def annotate(self, rid, meta):
+        with self._lock:
+            self._annotations[rid] = dict(meta)
+
+    def annotation(self, rid):
+        with self._lock:
+            ann = self._annotations.get(rid)
+            return dict(ann) if ann is not None else None
 
     def get_result(self, key):
         with self._lock:
@@ -704,6 +758,9 @@ class FileQueue(BaseQueue):
         return out
 
     def ack(self, rids):
+        # the ann dir only exists once some engine annotated (PR 20), so
+        # non-generation deployments pay zero extra stats per ack
+        drop_ann = os.path.isdir(self._ann_dir())
         for rid in rids:
             with self._claims_lock:
                 path = self._claims.pop(rid, None)
@@ -712,6 +769,11 @@ class FileQueue(BaseQueue):
                     os.remove(path)
                 except FileNotFoundError:
                     pass                   # reclaimed past our lease
+            if drop_ann:
+                try:
+                    os.remove(self._ann_path(rid))
+                except FileNotFoundError:
+                    pass
 
     def reclaim(self, min_idle_s, max_items=64):
         now_ns = time.time_ns()
@@ -743,6 +805,32 @@ class FileQueue(BaseQueue):
     def pending_count(self):
         return sum(1 for f in os.listdir(self.claim_dir)
                    if f.endswith(self._STREAM_EXTS))
+
+    # -- lease annotations (PR 20): <root>/ann/<rid>.json, created lazily
+    # so non-generation deployments never grow the extra directory
+    def _ann_dir(self):
+        return os.path.join(self.root, "ann")
+
+    def _ann_path(self, rid):
+        safe = re.sub(r"[^A-Za-z0-9_-]", "-", str(rid))
+        return os.path.join(self._ann_dir(), f"{safe}.json")
+
+    def annotate(self, rid, meta):
+        os.makedirs(self._ann_dir(), exist_ok=True)
+        path = self._ann_path(rid)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.rename(tmp, path)
+
+    def annotation(self, rid):
+        try:
+            with open(self._ann_path(rid)) as f:
+                return json.load(f)
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        except json.JSONDecodeError:
+            return None                    # torn write: resume falls back
 
     def put_result(self, key, value):
         tmp = os.path.join(self.result_dir, f".{key}.tmp")
@@ -916,6 +1004,9 @@ class RedisQueue(BaseQueue):
         # instead of repeatedly failing through the shared read breaker
         # (which would blind XREADGROUP too)
         self._reclaim_unsupported = False
+        # set by annotate() (PR 20): gates annotation cleanup in ack so
+        # engines that never checkpoint pay no extra HDELs
+        self._ann_used = False
         self.max_depth = max_depth
         from analytics_zoo_tpu.common.resilience import (CircuitBreaker,
                                                          RetryPolicy)
@@ -1114,6 +1205,13 @@ class RedisQueue(BaseQueue):
                 eid = self._claimed.pop(rid, None)
                 if eid is not None:
                     eids.append(eid)
+        if self._ann_used and rids:
+            # annotation cleanup (PR 20) only once this handle annotated,
+            # so non-generation deployments pay zero extra round-trips
+            try:
+                self.r.hdel(self._ann_table(), *list(rids))
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
         if not eids:
             return
         # XACK releases the claim; XDEL drops the served entry from the
@@ -1183,6 +1281,24 @@ class RedisQueue(BaseQueue):
         except Exception:  # noqa: BLE001 — old server/library: floor wins
             pass
         return 0
+
+    # -- lease annotations (PR 20): one hash next to the result table
+    def _ann_table(self):
+        return f"{self.stream}:ann"
+
+    def annotate(self, rid, meta):
+        self._ann_used = True
+        self.r.hset(self._ann_table(), rid, json.dumps(meta))
+
+    def annotation(self, rid):
+        try:
+            v = self._guarded_read(self.r.hget, self._ann_table(), rid)
+        except _ReadUnavailable:
+            return None                    # resume falls back to restart
+        try:
+            return json.loads(v) if v else None
+        except (json.JSONDecodeError, TypeError):
+            return None
 
     def put_result(self, key, value):
         self.r.hset(self.table, key, json.dumps(value))

@@ -89,6 +89,21 @@ def find_usage_spools(pidfile: str) -> List[str]:
     return out
 
 
+def gensnap_path(pidfile: str) -> str:
+    """The generation-snapshot spool (PR 20) next to the span/event/usage
+    spools: one jsonl file of checkpointed decode state per replica, so a
+    surviving replica can resume a dead owner's in-flight generations."""
+    return pidfile + ".gensnap.jsonl"
+
+
+def find_snapshot_spools(pidfile: str) -> List[str]:
+    """Every generation-snapshot spool of a deployment (rotated
+    generations included)."""
+    out = sorted(set(glob.glob(pidfile + "*.gensnap.jsonl")
+                     + glob.glob(pidfile + "*.gensnap.jsonl.1")))
+    return out
+
+
 def _append_records(path: str, records: List[Dict], kind: str,
                     source: Optional[str], max_bytes: int) -> int:
     """The one spool writer (spans AND events): a clock record
@@ -142,6 +157,63 @@ def append_events(path: str, events: Iterable[Dict],
     ``merge_spools`` normalizes both onto one wall timeline and `manager
     trace` / `incident_view` agree about when everything happened."""
     return _append_records(path, list(events), "event", source, max_bytes)
+
+
+def append_snapshots(path: str, records: Iterable[Dict],
+                     source: Optional[str] = None,
+                     max_bytes: int = SPOOL_MAX_BYTES) -> int:
+    """Append one batch of generation checkpoints (PR 20) — the SAME
+    rotation + drain-time clock contract as the other spools.  The
+    ``gensnap`` kind is unknown to ``merge_spools``, so snapshots never
+    pollute trace timelines; they are read back only by
+    ``load_snapshots`` on the resume path."""
+    return _append_records(path, list(records), "gensnap", source,
+                           max_bytes)
+
+
+def snapshot_checksum(rec: Dict) -> int:
+    """Integrity stamp over the fields a resume actually replays: the
+    identity, epoch, prompt and generated tokens.  Stored in the record
+    at checkpoint time and re-derived at resume time — a truncated or
+    corrupted snapshot fails loudly instead of resuming garbage."""
+    import zlib
+    body = json.dumps([str(rec.get("rid")), int(rec.get("epoch") or 0),
+                       [int(t) for t in rec.get("prompt") or []],
+                       [int(t) for t in rec.get("tokens") or []]])
+    return zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+
+
+def load_snapshots(paths: Iterable[str]) -> List[Dict]:
+    """Every generation checkpoint of the given spools, each stamped
+    with ``ts_wall`` via the nearest preceding clock record of its file
+    (the ``load_usage`` contract; a record with no clock keeps its raw
+    ``ts`` and gains ``clock_skewed: true``)."""
+    out: List[Dict] = []
+    for path in paths:
+        offset: Optional[float] = None
+        for rec in load_spool(path):
+            kind = rec.get("kind")
+            if kind == "clock":
+                try:
+                    offset = float(rec["wall"]) - float(rec["mono"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+                continue
+            if kind != "gensnap":
+                continue
+            rec = {k: v for k, v in rec.items() if k != "kind"}
+            try:
+                ts = float(rec.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if offset is not None:
+                rec["ts_wall"] = ts + offset
+            else:
+                rec["ts_wall"] = ts
+                rec["clock_skewed"] = True
+            out.append(rec)
+    out.sort(key=lambda r: r.get("ts_wall", 0.0))
+    return out
 
 
 def append_usage(path: str, records: Iterable[Dict],

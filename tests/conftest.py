@@ -115,6 +115,13 @@ def pytest_configure(config):
         "tenant/model-labelled series, usage journal, per-tenant SLO "
         "views); the acceptance test forks a real 2-replica deployment "
         "behind the LB, so they carry a default 300 s SIGALRM budget")
+    config.addinivalue_line(
+        "markers",
+        "resume: generation-continuity tests (PR 20: checkpointed decode "
+        "state, crash-resumable generations); the chaos acceptance "
+        "SIGKILLs a live replica mid-decode and waits for the survivor's "
+        "reclaim + token-exact resume, so they carry a default 300 s "
+        "SIGALRM budget")
 
 
 # replica-failover tests fork full serving processes (jax import + model
@@ -135,6 +142,7 @@ ROLLOUT_DEFAULT_TIMEOUT_S = 300.0
 OVERLOAD_DEFAULT_TIMEOUT_S = 300.0
 KVCACHE_DEFAULT_TIMEOUT_S = 300.0
 METERING_DEFAULT_TIMEOUT_S = 300.0
+RESUME_DEFAULT_TIMEOUT_S = 300.0
 
 
 @pytest.hookimpl(wrapper=True)
@@ -176,6 +184,8 @@ def pytest_runtest_call(item):
             seconds = KVCACHE_DEFAULT_TIMEOUT_S
         elif item.get_closest_marker("metering") is not None:
             seconds = METERING_DEFAULT_TIMEOUT_S
+        elif item.get_closest_marker("resume") is not None:
+            seconds = RESUME_DEFAULT_TIMEOUT_S
         else:
             return (yield)
     else:

@@ -1,0 +1,97 @@
+"""Generation-serving replica subprocess for the PR 20 resume chaos
+bench (`tools/serving_bench.py --generate --chaos-resume`) and tests:
+one ClusterServing engine with the continuous batcher over a shared
+FileQueue spool, checkpointing armed, a ``decode_crash_after_n_tokens``
+fault gated in — the process dies (os._exit(3)) mid-decode once it has
+produced N tokens, with its resume state already durable in the
+snapshot spool (the engine checkpoints BEFORE the crash check at each
+step boundary).
+
+Usage:
+    python gen_replica_worker.py QUEUE_DIR SNAPSHOT_SPOOL
+        [--crash-after N] [--lease S] [--slots N] [--max-tokens N]
+        [--checkpoint-interval N] [--stream-interval N] [--quantum N]
+        [--vocab N] [--ready-file PATH]
+
+Runs until SIGTERM — or the armed crash, which is the point.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("queue_dir")
+    ap.add_argument("snapshot_spool")
+    ap.add_argument("--crash-after", type=int, default=0,
+                    help="arm decode_crash_after_n_tokens at N total "
+                         "generated tokens (0 = never crash)")
+    ap.add_argument("--lease", type=float, default=1.0)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--max-prompt-len", type=int, default=16)
+    ap.add_argument("--checkpoint-interval", type=int, default=4)
+    ap.add_argument("--stream-interval", type=int, default=4)
+    ap.add_argument("--quantum", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=48)
+    ap.add_argument("--ready-file", default=None,
+                    help="touched once the engine is started and warm — "
+                         "the parent enqueues only after this appears")
+    args = ap.parse_args()
+
+    import jax
+
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.textmodels import TransformerLM
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    # the same deterministic weights every process in the A/B builds
+    # (PRNGKey(1)), so victim / survivor / golden agree token for token
+    m = TransformerLM(vocab_size=args.vocab, hidden=32, n_head=4,
+                      n_layers=2, max_len=64)
+    im = InferenceModel().do_load_model(m, m.build(jax.random.PRNGKey(1)),
+                                        {})
+    faults = None
+    if args.crash_after > 0:
+        faults = {"decode_crash_after_n_tokens":
+                  {"version": "*", "n": args.crash_after}}
+    serving = ClusterServing(
+        im, FileQueue(args.queue_dir),
+        ServingParams(
+            max_batch=args.slots, max_wait_ms=2.0,
+            lease_s=args.lease, reclaim_interval_s=args.lease / 4,
+            model_version="v1", faults=faults,
+            generation={"max_active_slots": args.slots,
+                        "max_tokens": args.max_tokens,
+                        "max_prompt_len": args.max_prompt_len,
+                        "stream_interval": args.stream_interval,
+                        "decode_quantum": args.quantum,
+                        "checkpoint_interval": args.checkpoint_interval,
+                        "resume": True}))
+    serving.snapshot_path = args.snapshot_spool
+    serving._batcher.warm()
+    serving.start()
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(str(os.getpid()))
+
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    while not stop["flag"]:
+        time.sleep(0.05)
+    serving.shutdown(drain_s=2.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,10 +30,18 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-try:  # cross-process CPU collectives
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-except Exception:
-    pass
+if __name__ == "__main__":
+    # cross-process CPU collectives — ONLY when run as a real worker.
+    # This module is also IMPORTED (for make_data) by pytest, and this
+    # jaxlib's make_gloo_tcp_collectives requires a live
+    # DistributedRuntimeClient: requesting gloo in the importing pytest
+    # process aborts ITS backend init whenever test_multihost is the
+    # first jax user (the PR 15 single-process gloo crash, resurfacing
+    # through the import path — test-order-dependent, hence the flake).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

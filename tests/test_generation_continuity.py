@@ -1,0 +1,651 @@
+"""Generation continuity (PR 20): checkpointed decode state, crash-
+resumable generations, mid-decode chaos coverage.
+
+The tentpole contract under test: the continuous batcher snapshots each
+active slot's resume state at step boundaries (durable spool on the
+tracecollect writer contract, pointer riding the queue lease
+annotation), and a surviving replica's reclaim admits a dead owner's
+generation as a RESUME — prefill over ``prompt + generated_so_far``,
+greedy decode continuing token-exactly from the checkpoint, budget and
+billing counting only the delta.  Every failure on that path falls back
+LOUDLY to restart-from-0 (``gen_resume_failed``) and meters the waste.
+
+Satellites: partial results can never shadow a terminal (all three
+queue backends), usage conservation with mixed fresh/resumed slots,
+the decode_crash/snapshot_corrupt fault points, and the slow 2-replica
+LB SIGKILL chaos acceptance."""
+
+import base64
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from analytics_zoo_tpu.inference.inference_model import InferenceModel
+from analytics_zoo_tpu.models.textmodels import TransformerLM
+from analytics_zoo_tpu.serving import tracecollect
+from analytics_zoo_tpu.serving.client import OutputQueue
+from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+from analytics_zoo_tpu.serving.faults import FaultInjector
+from analytics_zoo_tpu.serving.generate import (ContinuousBatcher,
+                                                GenerationParams, GenRequest)
+from analytics_zoo_tpu.serving.queues import FileQueue, InProcQueue, RedisQueue
+
+from test_serving_availability import FakeRedis
+from test_serving_generate import EchoLM, _drive, _finals
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.resume
+
+# the canonical continuity deployment shape shared by the unit tests:
+# budget-only stopping (deterministic lengths), a checkpoint cadence
+# finer than the budget, resume on
+GEN = {"max_active_slots": 4, "max_tokens": 24, "eos_id": None,
+       "max_prompt_len": 16, "stream_interval": 4, "decode_quantum": 4,
+       "checkpoint_interval": 4, "resume": True}
+PROMPT = [5, 1, 8, 3]
+
+
+def _tlm_im():
+    m = TransformerLM(vocab_size=48, hidden=32, n_head=4, n_layers=2,
+                      max_len=64)
+    return InferenceModel().do_load_model(
+        m, m.build(jax.random.PRNGKey(1)), {})
+
+
+def _echo_im(vocab=64):
+    m = EchoLM(vocab=vocab)
+    return InferenceModel().do_load_model(
+        m, m.build(jax.random.PRNGKey(0)), {})
+
+
+def _mk_queue(kind, tmp_path):
+    if kind == "inproc":
+        return InProcQueue()
+    if kind == "file":
+        return FileQueue(str(tmp_path / "q"))
+    return RedisQueue(client=FakeRedis())
+
+
+def _enqueue(queue, rid, tokens, gen=None, tenant=None, trace_id=None):
+    arr = np.ascontiguousarray(np.asarray(tokens, "<f4"))
+    rec = {"uri": rid, "b64": base64.b64encode(arr).decode("ascii"),
+           "dtype": "<f4", "shape": list(arr.shape)}
+    if gen is not None:
+        rec["gen"] = gen
+    if tenant is not None:
+        rec["tenant"] = tenant
+    if trace_id is not None:
+        rec["trace_id"] = trace_id
+    queue.xadd(rec)
+
+
+def _golden(im=None, gen=None, prompt=PROMPT):
+    """The uninterrupted greedy rollout the resumes must reproduce."""
+    b = ContinuousBatcher(im or _tlm_im(), GenerationParams(**(gen or GEN)))
+    b.submit(GenRequest("g", np.asarray(prompt, np.float32)))
+    return _finals(_drive(b))["g"].tokens
+
+
+def _craft_dead_owner(root, queue, rid, prompt, tokens, *, epoch=0,
+                      partial_n=None, spool=None, annotate=True,
+                      corrupt=False, max_tokens=24):
+    """Leave the queue + spool exactly as a replica that died mid-decode
+    would: the record claimed by consumer "dead" (never acked), the
+    streamed partial in the result store, a checkpoint in a snapshot
+    spool, and the lease annotation pointing at it."""
+    queue.consumer = "dead"
+    claimed = queue.read_batch(8, timeout_s=1.0)
+    assert rid in [r for r, _ in claimed], claimed
+    n = len(tokens)
+    partial_n = n if partial_n is None else partial_n
+    if partial_n:
+        assert queue.put_partial(rid, {"partial": True,
+                                       "tokens": tokens[:partial_n],
+                                       "n": partial_n})
+    if spool is None:
+        spool = os.path.join(str(root), "dead.gensnap.jsonl")
+    snap = {"rid": rid, "epoch": epoch,
+            "prompt": [int(t) for t in prompt],
+            "tokens": [int(t) for t in tokens], "n": n, "tenant": None,
+            "trace_id": None, "deadline_ns": None,
+            "max_tokens": max_tokens, "sampler": "greedy",
+            "ts": time.monotonic()}
+    snap["crc"] = tracecollect.snapshot_checksum(snap) ^ (
+        0x5A5A5A5A if corrupt else 0)
+    tracecollect.append_snapshots(spool, [snap], source="dead")
+    if annotate:
+        queue.annotate(rid, {"spool": spool, "epoch": 0,
+                             "replica": "dead"})
+    return spool
+
+
+def _survivor(queue, root, **gen_overrides):
+    gen = dict(GEN, **gen_overrides)
+    s = ClusterServing(_tlm_im(), queue,
+                       ServingParams(max_batch=8, max_wait_ms=2.0,
+                                     generation=gen, lease_s=0.2,
+                                     reclaim_interval_s=0.05))
+    s.snapshot_path = os.path.join(str(root), "survivor.gensnap.jsonl")
+    return s
+
+
+# -- satellite 1: partials can never shadow terminals --------------------------
+
+@pytest.mark.parametrize("kind", ["inproc", "file", "redis"])
+def test_put_partial_never_shadows_terminal(kind, tmp_path):
+    """The failover race the PR closes: the dead owner's last streamed
+    partial may land AFTER the resuming owner's terminal write (slow
+    disk, retrying writer).  put_partial refuses to overwrite a
+    non-partial value on every backend, so the client can never read a
+    stale prefix where a terminal already stood."""
+    q = _mk_queue(kind, tmp_path)
+    # partials stack: a newer partial replaces an older one
+    assert q.put_partial("r", {"partial": True, "tokens": [1], "n": 1})
+    assert q.put_partial("r", {"partial": True, "tokens": [1, 2], "n": 2})
+    assert q.get_result("r")["n"] == 2
+    # the terminal lands (ordinary put_result overwrites anything)...
+    q.put_result("r", {"value": {"tokens": [1, 2, 3]}, "n": 3})
+    # ...and a straggling partial from the dead owner bounces
+    assert not q.put_partial("r", {"partial": True, "tokens": [1], "n": 1})
+    assert q.get_result("r")["value"]["tokens"] == [1, 2, 3]
+    # a fresh key accepts a first partial as before
+    assert q.put_partial("s", {"partial": True, "tokens": [7], "n": 1})
+
+
+@pytest.mark.parametrize("kind", ["inproc", "file", "redis"])
+def test_annotation_rides_the_lease(kind, tmp_path):
+    """Lease annotations live in the QUEUE (not the record) so a reclaim
+    on a different replica can find the dead owner's spool by rid; they
+    clear at ack so a re-enqueued rid never sees a stale pointer."""
+    q = _mk_queue(kind, tmp_path)
+    assert q.annotation("r0") is None
+    q.xadd({"uri": "r0", "data": [1.0]})
+    q.read_batch(4, timeout_s=0.5)
+    q.annotate("r0", {"spool": "/tmp/x.jsonl", "epoch": 2, "replica": "a"})
+    ann = q.annotation("r0")
+    assert ann == {"spool": "/tmp/x.jsonl", "epoch": 2, "replica": "a"}
+    q.ack(["r0"])
+    assert q.annotation("r0") is None
+
+
+# -- tentpole: checkpoint collection at step boundaries ------------------------
+
+def test_checkpoints_collected_on_interval():
+    """Every active slot snapshots each time it accrues
+    checkpoint_interval tokens — monotone in n, full resume identity on
+    every record, drained off the hot path in batches."""
+    b = ContinuousBatcher(_tlm_im(), GenerationParams(**GEN))
+    b.submit(GenRequest("a", np.asarray(PROMPT, np.float32),
+                        tenant="acme", trace_id="t-1"))
+    snaps = []
+    for _ in range(200):
+        b.step()
+        snaps.extend(b.drain_checkpoints())
+        assert b.pending_checkpoints == []      # drain leaves nothing
+        if b.idle:
+            break
+    assert b.idle and snaps
+    assert b.checkpoints == len(snaps)
+    ns = [s["n"] for s in snaps]
+    assert ns == sorted(ns) and len(set(ns)) == len(ns)
+    # cadence: consecutive snapshots are >= interval tokens apart
+    assert all(b - a >= GEN["checkpoint_interval"]
+               for a, b in zip(ns, ns[1:]))
+    for s in snaps:
+        assert s["rid"] == "a" and s["epoch"] == 0
+        assert s["prompt"] == PROMPT and len(s["tokens"]) == s["n"]
+        assert s["tenant"] == "acme" and s["trace_id"] == "t-1"
+        assert s["sampler"] == "greedy"
+    assert b.stats()["checkpoints"] == len(snaps)
+    assert b.stats()["can_resume"] is True
+
+
+def test_bare_state_model_never_checkpoints():
+    """EchoLM has no KV cache to rebuild: checkpointing is skipped
+    outright (can_resume False) instead of spooling state a resume could
+    not replay."""
+    b = ContinuousBatcher(_echo_im(), GenerationParams(
+        **dict(GEN, eos_id=None)))
+    b.submit(GenRequest("a", np.array([5], np.float32)))
+    _drive(b)
+    assert b.drain_checkpoints() == []
+    assert b.checkpoints == 0
+    assert b.stats()["can_resume"] is False
+
+
+# -- tentpole: token-exact resume ----------------------------------------------
+
+@pytest.mark.parametrize("k", [4, 9, 17])
+def test_resume_is_token_exact_at_any_checkpoint_depth(k):
+    """Greedy resume from a depth-k checkpoint reproduces the
+    uninterrupted rollout EXACTLY: the prefill over prompt + prefix
+    rebuilds the same KV state the dead owner held, and every streamed
+    partial along the way is a prefix of the terminal."""
+    golden = _golden()
+    b = ContinuousBatcher(_tlm_im(), GenerationParams(**GEN))
+    b.submit(GenRequest("r", np.asarray(PROMPT, np.float32),
+                        resume_tokens=golden[:k], epoch=1))
+    events = _drive(b)
+    final = _finals(events)["r"]
+    assert final.tokens == golden
+    assert final.finish_reason == "length"      # budget counts from 0
+    for ev in events:
+        if ev.kind == "partial":
+            assert ev.tokens == golden[:len(ev.tokens)]
+            assert len(ev.tokens) > k           # never re-streams the past
+    assert b.resumed == 1 and b.resume_failed == 0
+    # the resumed epoch stamps the NEXT generation of checkpoints, so a
+    # second crash resumes from the second owner's state, never the
+    # first's deeper-but-stale spool
+    assert all(s["epoch"] == 1 for s in b.drain_checkpoints())
+
+
+def test_resume_downgrades_loudly_not_silently():
+    """Every unusable resume prefix falls back to restart-from-0 with a
+    resume_failed event naming the reason — never a crash, never a
+    silent wrong-token resume."""
+    golden = _golden()
+    # bare-state model: no cache to rebuild
+    b = ContinuousBatcher(_echo_im(), GenerationParams(
+        **dict(GEN, eos_id=None, max_tokens=6)))
+    b.submit(GenRequest("a", np.array([5], np.float32),
+                        resume_tokens=[6, 7]))
+    events = _drive(b)
+    fails = [e for e in events if e.kind == "resume_failed"]
+    assert len(fails) == 1 and "bare-state" in fails[0].error
+    assert fails[0].tokens == [6, 7]            # the wasted prefix
+    assert _finals(events)["a"].tokens == [6, 7, 8, 9, 10, 11]
+    assert b.resume_failed == 1 and b.resumed == 0
+    # cache model, but a prefix with an out-of-vocab token (truncated /
+    # foreign snapshot that still passed its crc)
+    b = ContinuousBatcher(_tlm_im(), GenerationParams(**GEN))
+    b.submit(GenRequest("b", np.asarray(PROMPT, np.float32),
+                        resume_tokens=[golden[0], 4800]))
+    events = _drive(b)
+    fails = [e for e in events if e.kind == "resume_failed"]
+    assert len(fails) == 1 and "vocab" in fails[0].error
+    assert _finals(events)["b"].tokens == golden
+    assert b.resume_failed == 1
+
+
+# -- tentpole: engine failover (crafted dead owner) ----------------------------
+
+def test_engine_reclaims_and_resumes_dead_owners_generation(tmp_path):
+    """The full failover: a dead replica's claimed generation record —
+    streamed partial, checkpoint spool, lease annotation — is reclaimed
+    by a survivor which resumes at the exact token position.  Terminal
+    == uninterrupted golden, gen_resume in the flight recorder, delta-
+    only billing, and the stale partial is gone from the result."""
+    golden = _golden()
+    k = 9
+    q_dead = FileQueue(str(tmp_path / "shared"))
+    _enqueue(q_dead, "r", PROMPT, tenant="acme", trace_id="t-chaos")
+    _craft_dead_owner(tmp_path, q_dead, "r", PROMPT, golden[:k])
+    _enqueue(FileQueue(str(tmp_path / "shared")), "fresh", PROMPT,
+             tenant="zeta")
+    time.sleep(0.3)                          # the dead claim goes stale
+    s = _survivor(FileQueue(str(tmp_path / "shared")), tmp_path)
+    s.start()
+    try:
+        res = OutputQueue(FileQueue(str(tmp_path / "shared"))).query_many(
+            ["r", "fresh"], timeout_s=60.0)
+    finally:
+        s.shutdown(drain_s=2.0)
+    # token-exact, and the terminal replaced the dead owner's partial
+    assert res["r"]["value"]["tokens"] == golden
+    assert not res["r"].get("partial")
+    assert res["fresh"]["value"]["tokens"] == golden
+    # the flight recorder is process-global: filter to THIS engine's
+    # events or earlier tests' engines leak into the count
+    ev = [e for e in s.recorder.events() if e.get("event") == "gen_resume"
+          and e.get("replica") == s.replica_id]
+    assert len(ev) == 1
+    assert ev[0]["rid"] == "r" and ev[0]["resumed_tokens"] == k
+    assert ev[0]["from_replica"] == "dead" and ev[0]["wasted"] == 0
+    assert ev[0]["trace_id"] == "t-chaos"
+    assert s._batcher.stats()["resumed"] == 1
+    snap = s.registry.snapshot()
+    assert snap["serving_generations_resumed_total"]["values"][0][
+        "value"] == 1.0
+    assert snap["serving_resume_wasted_tokens_total"]["values"][0][
+        "value"] == 0.0
+    # satellite 2: conservation — the resumed tenant is charged ONLY the
+    # delta past the checkpoint; the fresh tenant pays the full roll.
+    # (The prefill-emitted token is folded outside the boundary delta
+    # for fresh and resumed alike.)
+    tenants = s.meter.snapshot()["tenants"]
+    assert tenants["acme"]["tokens"] == len(golden) - k - 1
+    assert tenants["zeta"]["tokens"] == len(golden) - 1
+    # journal deltas never negative across the resume epoch
+    for rec in s.meter.drain():
+        for f in ("records", "tokens", "device_s", "bytes", "sheds"):
+            assert rec[f] >= 0, rec
+    # snapshot spool bytes surface in the ledger aux + health doc
+    g = s.health()["generation"]
+    assert g["resumed"] == 1 and g["snapshot_bytes"] > 0
+
+
+def test_engine_resume_failures_restart_from_zero(tmp_path):
+    """Every broken recovery path — corrupted checkpoint, missing
+    annotation, epoch mismatch — restarts from 0 with a
+    gen_resume_failed event naming the reason and the waste metered;
+    the client still gets the exact golden terminal."""
+    golden = _golden()
+    k = 9
+    root = FileQueue(str(tmp_path / "shared"))
+    for rid in ("corrupt", "noann", "stale"):
+        _enqueue(root, rid, PROMPT)
+    q_dead = FileQueue(str(tmp_path / "shared"))
+    q_dead.consumer = "dead"
+    claimed = q_dead.read_batch(8, timeout_s=1.0)
+    assert len(claimed) == 3
+    partial = {"partial": True, "tokens": golden[:k], "n": k}
+    for rid in ("corrupt", "noann", "stale"):
+        assert q_dead.put_partial(rid, dict(partial))
+    spool = str(tmp_path / "dead.gensnap.jsonl")
+
+    def snap(rid, epoch, corrupt=False):
+        s = {"rid": rid, "epoch": epoch, "prompt": PROMPT,
+             "tokens": golden[:k], "n": k, "max_tokens": 24,
+             "sampler": "greedy", "ts": time.monotonic()}
+        s["crc"] = tracecollect.snapshot_checksum(s) ^ (
+            0xDEAD if corrupt else 0)
+        return s
+
+    tracecollect.append_snapshots(
+        spool, [snap("corrupt", 0, corrupt=True), snap("stale", 3)],
+        source="dead")
+    q_dead.annotate("corrupt", {"spool": spool, "epoch": 0,
+                                "replica": "dead"})
+    q_dead.annotate("stale", {"spool": spool, "epoch": 0,
+                              "replica": "dead"})   # snapshot is epoch 3
+    time.sleep(0.3)
+    s = _survivor(FileQueue(str(tmp_path / "shared")), tmp_path)
+    s.start()
+    try:
+        res = OutputQueue(FileQueue(str(tmp_path / "shared"))).query_many(
+            ["corrupt", "noann", "stale"], timeout_s=90.0)
+    finally:
+        s.shutdown(drain_s=2.0)
+    for rid in ("corrupt", "noann", "stale"):
+        assert res[rid]["value"]["tokens"] == golden, rid
+    fails = {e["rid"]: e for e in s.recorder.events()
+             if e.get("event") == "gen_resume_failed"
+             and e.get("replica") == s.replica_id}
+    assert fails["corrupt"]["reason"] == "checksum-mismatch"
+    assert fails["noann"]["reason"] == "no-annotation"
+    assert fails["stale"]["reason"] == "no-snapshot"
+    assert all(e["wasted"] == k for e in fails.values())
+    assert s._batcher.stats()["resumed"] == 0
+    snap_m = s.registry.snapshot()
+    assert snap_m["serving_resume_wasted_tokens_total"]["values"][0][
+        "value"] == 3.0 * k
+
+
+# -- fault points ---------------------------------------------------------------
+
+def test_decode_crash_fault_is_exactly_once(tmp_path):
+    """decode_crash_after_n_tokens: fires only past n generated tokens,
+    and the `once` marker is an atomic cross-process claim — the
+    supervisor's respawn (and every sibling) skips the fault, so chaos
+    gets ONE kill instead of a crash loop."""
+    marker = str(tmp_path / "crash.marker")
+    spec = {"decode_crash_after_n_tokens":
+            {"version": "*", "n": 10, "once": marker}}
+    fi = FaultInjector(spec, "v1")
+    assert fi.decode_crash_active and fi.any_active
+    assert "decode_crash_after_n_tokens" in fi.describe()
+    assert not fi.take_decode_crash(9)           # below the threshold
+    assert not os.path.exists(marker)
+    assert fi.take_decode_crash(10)              # fires, claims marker
+    assert os.path.exists(marker)
+    # the respawned process (fresh injector, same config) sees the claim
+    assert not FaultInjector(spec, "v1").take_decode_crash(999)
+    # version gating: unarmed for a non-matching selector
+    gated = FaultInjector({"decode_crash_after_n_tokens":
+                           {"version": "v2", "n": 1}}, "v1")
+    assert not gated.decode_crash_active
+
+
+def test_snapshot_corrupt_fault_breaks_resume_loudly(tmp_path):
+    """snapshot_corrupt: the victim's checkpoints carry a broken crc, so
+    the survivor detects the corruption and restarts from 0 instead of
+    resuming garbage — the integrity check is load-bearing."""
+    q = FileQueue(str(tmp_path / "shared"))
+    _enqueue(q, "r", PROMPT)
+    # victim: checkpoint-writing engine with the corrupt fault armed
+    victim = ClusterServing(
+        _tlm_im(), FileQueue(str(tmp_path / "shared")),
+        ServingParams(max_batch=8, max_wait_ms=2.0, generation=dict(GEN),
+                      faults={"snapshot_corrupt": {"version": "*"}}))
+    assert victim._faults.snapshot_corrupt_active
+    victim.snapshot_path = str(tmp_path / "victim.gensnap.jsonl")
+    victim.start()
+    try:
+        golden = OutputQueue(q).query("r", timeout_s=60.0)["value"]["tokens"]
+    finally:
+        victim.shutdown(drain_s=2.0)
+    snaps = tracecollect.load_snapshots([victim.snapshot_path])
+    assert snaps        # checkpoints were written...
+    for s in snaps:     # ...every one fails its integrity stamp
+        assert int(s["crc"]) != tracecollect.snapshot_checksum(s)
+    # a survivor pointed at the corrupt spool restarts from 0 (fresh
+    # queue root: the victim's graceful shutdown drained its own)
+    q2 = FileQueue(str(tmp_path / "shared2"))
+    _enqueue(q2, "r2", PROMPT)
+    q_dead = FileQueue(str(tmp_path / "shared2"))
+    q_dead.consumer = "dead"
+    assert [r for r, _ in q_dead.read_batch(8, timeout_s=1.0)] == ["r2"]
+    corrupt = max(snaps, key=lambda s: s["n"])
+    resnap = dict(corrupt, rid="r2")
+    tracecollect.append_snapshots(str(tmp_path / "dead.gensnap.jsonl"),
+                                  [resnap], source="dead")
+    q_dead.annotate("r2", {"spool": str(tmp_path / "dead.gensnap.jsonl"),
+                           "epoch": 0, "replica": "dead"})
+    time.sleep(0.3)
+    s = _survivor(FileQueue(str(tmp_path / "shared2")), tmp_path)
+    s.start()
+    try:
+        res = OutputQueue(FileQueue(str(tmp_path / "shared2"))).query(
+            "r2", timeout_s=60.0)
+    finally:
+        s.shutdown(drain_s=2.0)
+    assert res["value"]["tokens"] == golden
+    fails = [e for e in s.recorder.events()
+             if e.get("event") == "gen_resume_failed"
+             and e.get("replica") == s.replica_id]
+    assert [e["reason"] for e in fails] == ["checksum-mismatch"]
+
+
+# -- slow chaos acceptance ------------------------------------------------------
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_json(url, data=None, headers=None, timeout=10):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+TLM_TOPOLOGY = """\
+import jax
+from analytics_zoo_tpu.models.textmodels import TransformerLM
+
+
+class ServableLM(TransformerLM):
+    # the zoo loader surface (init_weights/load_weights) on the bare
+    # decode-API Layer, so config.yaml can serve it by topology + npz
+    def init_weights(self):
+        self._params = self.build(jax.random.PRNGKey(1))
+        self._state = {}
+        return self._params
+
+    def load_weights(self, path):
+        from analytics_zoo_tpu.utils.serialization import load_pytree
+        tree = load_pytree(path, like={"params": self._params,
+                                       "state": self._state})
+        self._params, self._state = tree["params"], tree["state"]
+        return self
+
+
+def build_model():
+    return ServableLM(vocab_size=48, hidden=32, n_head=4, n_layers=2,
+                      max_len=64)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_two_replica_lb_sigkill_mid_decode_resumes_token_exact(tmp_path):
+    """ISSUE 20 acceptance: 2 real replicas behind the LB, one
+    generation in flight; the owner is killed MID-DECODE by the armed
+    decode_crash fault (exactly once, marker-gated).  The survivor /
+    respawn reclaims the lease, follows the annotation to the dead
+    owner's spool, and finishes the generation TOKEN-EXACTLY vs the
+    uninterrupted golden.  Zero client failures; one trace_id spans
+    both owners; the merged event timeline shows the victim's
+    gen_checkpoint and the resumer's gen_resume."""
+    from analytics_zoo_tpu.utils.serialization import save_pytree
+
+    # weights + topology: both replicas load the same npz the golden
+    # rollout below uses
+    m = TransformerLM(vocab_size=48, hidden=32, n_head=4, n_layers=2,
+                      max_len=64)
+    params = m.build(jax.random.PRNGKey(1))
+    weights = tmp_path / "model.npz"
+    save_pytree(str(weights), {"params": params, "state": {}})
+    topo = tmp_path / "topology.py"
+    topo.write_text(TLM_TOPOLOGY)
+    golden = _golden(InferenceModel().do_load_model(m, params, {}))
+    crash_n = 10
+
+    qdir = tmp_path / "queue"
+    port, lb_port = _free_port(), _free_port()
+    marker = tmp_path / "crash.marker"
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"model:\n  path: {weights}\n  type: zoo\n  topology: {topo}\n"
+        f"data:\n  src: file:{qdir}\n"
+        "params:\n"
+        "  batch_size: 4\n"
+        f"  http_port: {port}\n"
+        "  drain_s: 2\n"
+        "  lease_s: 2\n"
+        "  reclaim_interval_s: 0.5\n"
+        "  compile_cache_dir: off\n"
+        "  generation:\n"
+        "    max_active_slots: 4\n"
+        "    max_tokens: 24\n"
+        "    max_prompt_len: 16\n"
+        "    stream_interval: 4\n"
+        "    decode_quantum: 4\n"
+        "    checkpoint_interval: 4\n"
+        "    resume: true\n"
+        "  faults:\n"
+        "    decode_crash_after_n_tokens:\n"
+        "      version: '*'\n"
+        f"      n: {crash_n}\n"
+        f"      once: {marker}\n")
+    pidfile = str(tmp_path / "cs.pid")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    mgr = [sys.executable, "-m", "analytics_zoo_tpu.serving.manager"]
+    log = str(tmp_path / "supervisor.log")
+    log_f = open(log, "w")
+    proc = subprocess.Popen(
+        mgr + ["start", "-c", str(cfg), "--pidfile", pidfile,
+               "--replicas", "2", "--lb-port", str(lb_port),
+               "--foreground", "--no-prewarm"],
+        cwd=str(tmp_path), env=env, stdout=log_f, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 180
+        ready = set()
+        while len(ready) < 2 and time.monotonic() < deadline:
+            assert proc.poll() is None, open(log).read()[-4000:]
+            for i in range(2):
+                try:
+                    code, _ = _http_json(
+                        f"http://127.0.0.1:{port + i}/readyz", timeout=2)
+                    if code == 200:
+                        ready.add(i)
+                except Exception:  # noqa: BLE001 — still booting
+                    pass
+            time.sleep(0.3)
+        assert ready == {0, 1}, open(log).read()[-4000:]
+
+        # one in-flight generation with a stable trace identity, pushed
+        # straight onto the shared spool (the record carries tenant +
+        # trace_id; the kill targets whichever replica claims it)
+        client_q = FileQueue(str(qdir))
+        _enqueue(client_q, "gen-0", PROMPT, tenant="acme",
+                 trace_id="trace-chaos-1")
+        # the client's view through the front door: ONE long poll, no
+        # retries — zero client failures means this returns the terminal
+        code, res = _http_json(
+            f"http://127.0.0.1:{lb_port}/v1/result/gen-0?timeout_s=120",
+            timeout=150)
+        assert code == 200, res
+        assert "error" not in res, res
+        assert res["value"]["tokens"] == golden
+        assert res["value"]["length"] == len(golden)
+        # the fault really fired: the once-marker was claimed
+        assert os.path.exists(str(marker))
+        time.sleep(1.5)          # one drain interval past the terminal
+    finally:
+        subprocess.run(mgr + ["stop", "--pidfile", pidfile],
+                       cwd=str(tmp_path), env=env, capture_output=True)
+        try:
+            proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log_f.close()
+    # forensics survive the deployment.  The victim dies UNDRAINED (its
+    # last in-memory span/event batch goes down with the process), so
+    # the both-owners proof comes from the snapshot spools, which the
+    # engine writes synchronously at the step boundary — that durability
+    # ordering is exactly what the resume depended on.
+    spools = tracecollect.find_snapshot_spools(pidfile)
+    assert spools
+    snaps = [s for s in tracecollect.load_snapshots(spools)
+             if s.get("rid") == "gen-0"]
+    assert snaps
+    assert all(s.get("trace_id") == "trace-chaos-1" for s in snaps)
+    owners = {s.get("replica_id") for s in snaps}
+    assert len(owners) >= 2, owners          # victim AND resumer wrote
+    epochs = {s.get("epoch") for s in snaps}
+    assert epochs == {0, 1}                   # one generation epoch hop
+    # the survivor's side of the timeline drained normally: gen_resume
+    # (with the victim's identity) and its own post-resume checkpoints
+    merged = tracecollect.merge_spools(
+        tracecollect.find_spools(pidfile)
+        + tracecollect.find_event_spools(pidfile))
+    resumes = [e for e in merged if e.get("event") == "gen_resume"
+               and e.get("rid") == "gen-0"]
+    assert len(resumes) == 1, [e.get("event") for e in merged][-40:]
+    assert resumes[0]["resumed_tokens"] >= 1
+    assert resumes[0]["trace_id"] == "trace-chaos-1"
+    assert resumes[0]["from_replica"] is not None
+    assert [e for e in merged if e.get("event") == "gen_checkpoint"]
+    # the one trace reaches the survivor's decode spans too
+    spans = [s for s in merged if s.get("trace_id") == "trace-chaos-1"
+             and s.get("stage") == "decode"]
+    assert spans

@@ -1190,6 +1190,295 @@ def _run_generate_paged(args):
     }
 
 
+# -- generation-continuity chaos A/B (PR 20) ----------------------------------
+
+def _resume_tlm():
+    """The fixed TransformerLM every process in the chaos-resume A/B
+    builds (PRNGKey(1), same shape as tests/gen_replica_worker.py), so
+    victim / survivor / golden agree token for token under greedy."""
+    import jax
+    from analytics_zoo_tpu.inference.inference_model import InferenceModel
+    from analytics_zoo_tpu.models.textmodels import TransformerLM
+    m = TransformerLM(vocab_size=48, hidden=32, n_head=4, n_layers=2,
+                      max_len=64)
+    return InferenceModel().do_load_model(m, m.build(jax.random.PRNGKey(1)),
+                                          {})
+
+
+def _resume_requests(args):
+    """Uniform-budget generation workload for the resume A/B: budgets
+    must all exceed the per-slot crash depth so every request is still
+    in flight when the victim dies — the regime the A/B measures."""
+    g = np.random.default_rng(0)
+    reqs = []
+    for i in range(args.resume_requests):
+        L = int(g.integers(2, args.resume_prompt_max + 1))
+        prompt = g.integers(1, 48, L).astype(np.float32)
+        reqs.append((f"gen-{i}", prompt, args.resume_max_tokens))
+    return reqs
+
+
+def _resume_gen_dict(args, resume_on):
+    return {"max_active_slots": args.resume_slots,
+            "max_tokens": args.resume_max_tokens,
+            "max_prompt_len": args.resume_prompt_max,
+            "stream_interval": args.resume_stream_interval,
+            "decode_quantum": args.resume_quantum,
+            "checkpoint_interval": args.resume_checkpoint_interval,
+            "resume": bool(resume_on)}
+
+
+def _run_chaos_resume_arm(args, reqs, golden, resume_on, lap, workdir):
+    """One arm-run: spawn a real victim replica subprocess over a fresh
+    FileQueue spool with `decode_crash_after_n_tokens` armed, enqueue the
+    workload, wait for the mid-decode os._exit(3), then bring up an
+    in-process survivor (resume on or off per arm) and collect every
+    terminal.  The survivor's `serving_resume_wasted_tokens_total` is the
+    arm's recomputed-work figure: restart meters every streamed token the
+    dead owner produced, resume only the tail past the last checkpoint."""
+    import subprocess
+    from analytics_zoo_tpu.inference import aot
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import FileQueue
+
+    tag = f"{'on' if resume_on else 'off'}{lap}"
+    root = os.path.join(workdir, f"arm-{tag}")
+    os.makedirs(root)
+    qdir = os.path.join(root, "queue")
+    vspool = os.path.join(root, "victim.gensnap.jsonl")
+    ready = os.path.join(root, "victim.ready")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "gen_replica_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, worker, qdir, vspool,
+         "--crash-after", str(args.resume_crash_after),
+         "--lease", str(args.resume_lease_s),
+         "--slots", str(args.resume_slots),
+         "--max-tokens", str(args.resume_max_tokens),
+         "--max-prompt-len", str(args.resume_prompt_max),
+         "--checkpoint-interval", str(args.resume_checkpoint_interval),
+         "--stream-interval", str(args.resume_stream_interval),
+         "--quantum", str(args.resume_quantum),
+         "--vocab", "48", "--ready-file", ready],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"chaos-resume victim died during boot "
+                    f"(rc={proc.returncode})")
+            if time.monotonic() > deadline:
+                raise RuntimeError("chaos-resume victim never became ready")
+            time.sleep(0.1)
+
+        client = FileQueue(qdir)
+        t_enq: Dict[str, float] = {}
+        for rid, prompt, budget in reqs:
+            _enqueue_gen(client, f"{tag}-{rid}", prompt, budget)
+            t_enq[f"{tag}-{rid}"] = time.perf_counter()
+
+        # the armed fault fires once the victim's slots have produced
+        # crash_after tokens total: every request is mid-flight (budgets
+        # exceed the per-slot depth), resume state durable in its spool
+        rc = proc.wait(timeout=180.0)
+        assert rc == 3, f"victim exited {rc}, expected the fault's " \
+                        f"os._exit(3)"
+    except BaseException:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30.0)
+        raise
+
+    # survivor: warmed BEFORE start so the measured recovery performs
+    # zero XLA compiles — resume admission replays prefill over
+    # prompt+prefix, which lands in the warmed pow-2 bucket ladder
+    survivor = ClusterServing(
+        _resume_tlm(), FileQueue(qdir),
+        ServingParams(max_batch=args.resume_slots, max_wait_ms=2.0,
+                      lease_s=args.resume_lease_s,
+                      reclaim_interval_s=args.resume_lease_s / 4,
+                      model_version="v1",
+                      generation=_resume_gen_dict(args, resume_on)))
+    survivor.snapshot_path = os.path.join(root, "survivor.gensnap.jsonl")
+    survivor._batcher.warm()
+    c0 = aot.COMPILE_STATS.snapshot()
+    survivor.start()
+    try:
+        pending = list(t_enq)
+        t_done: Dict[str, float] = {}
+        results: Dict[str, Dict] = {}
+        deadline = time.monotonic() + 300.0
+        oq_queue = client
+        while pending and time.monotonic() < deadline:
+            res = oq_queue.get_results(pending)
+            now = time.perf_counter()
+            for u, r in res.items():
+                if r is None or r.get("partial"):
+                    continue
+                results[u] = r
+                t_done[u] = now
+            pending = [u for u in pending if u not in results]
+            if pending:
+                time.sleep(0.1)
+        dropped = list(pending)
+        assert not dropped, \
+            f"chaos-resume arm {tag}: {len(dropped)} record(s) never " \
+            f"resolved: {dropped[:4]}"
+
+        # token parity: BOTH arms must converge to the uninterrupted
+        # golden — resume is only a win if it is also correct
+        for rid, _, _ in reqs:
+            got = results[f"{tag}-{rid}"]["value"]["tokens"]
+            assert got == golden[rid], \
+                f"{tag}-{rid}: tokens diverged from golden"
+
+        c1 = aot.COMPILE_STATS.snapshot()
+        steady = int(c1["compile_requests"] - c0["compile_requests"])
+        assert steady == 0, \
+            f"chaos-resume arm {tag} performed {steady} XLA compile(s) " \
+            f"after warm"
+        reg = survivor.registry.snapshot()
+
+        def _counter(name):
+            doc = reg.get(name) or {}
+            return int(sum(v.get("value") or 0
+                           for v in (doc.get("values") or [])))
+
+        stats = survivor._batcher.stats()
+        ttlts = sorted(t_done[u] - t_enq[u] for u in t_done)
+
+        def _pct(q):
+            return round(1e3 * ttlts[min(len(ttlts) - 1,
+                                         int(q * len(ttlts)))], 1)
+
+        return {
+            "wasted_tokens": _counter("serving_resume_wasted_tokens_total"),
+            "resumed": _counter("serving_generations_resumed_total"),
+            "resume_failed": stats.get("resume_failed", 0),
+            "checkpoints": stats.get("checkpoints", 0),
+            "ttlt_p50_ms": _pct(0.50),
+            "ttlt_p99_ms": _pct(0.99),
+            "records_dropped": 0,
+            "steady_compile_requests": steady,
+            "victim_exit": rc,
+        }
+    finally:
+        survivor.shutdown(drain_s=2.0)
+
+
+def _run_chaos_resume(args):
+    """PR 20 generation-continuity chaos A/B (`--generate
+    --chaos-resume`).
+
+    Both arms SIGKILL-equivalent (os._exit via an armed
+    `decode_crash_after_n_tokens` fault) a REAL victim replica
+    subprocess mid-decode with every request in flight, then recover on
+    a survivor engine.  The resume arm's survivor follows each lease
+    annotation to the victim's durable snapshot spool and continues
+    decoding token-exact from the deepest checkpoint; the restart arm
+    (generation.resume off) recomputes every generation from token 0.
+    Arms interleave per lap (cpu-shares drift: back-to-back phases would
+    compare different machines) and both must match the uninterrupted
+    golden token for token, drop zero records and perform zero
+    steady-state compiles; the headline figure is wasted (recomputed)
+    tokens — resume must recover at least half of the restart arm's
+    waste."""
+    import shutil
+    import tempfile
+    from analytics_zoo_tpu.serving.client import OutputQueue
+    from analytics_zoo_tpu.serving.engine import ClusterServing, ServingParams
+    from analytics_zoo_tpu.serving.queues import InProcQueue
+
+    reqs = _resume_requests(args)
+    # per-slot crash depth: every slot must still be mid-decode when the
+    # fault fires, else the "crashed mid-generation" premise is void
+    per_slot = args.resume_crash_after / max(
+        1, min(args.resume_slots, len(reqs)))
+    assert per_slot < args.resume_max_tokens, \
+        "resume_crash_after too deep: victims would finish before crashing"
+
+    # ---- golden: one uninterrupted run of the identical workload ----------
+    queue = InProcQueue()
+    gs = ClusterServing(
+        _resume_tlm(), queue,
+        ServingParams(max_batch=args.resume_slots, max_wait_ms=2.0,
+                      generation=_resume_gen_dict(args, True)))
+    gs.start()
+    for rid, prompt, budget in reqs:
+        _enqueue_gen(queue, rid, prompt, budget)
+    res = OutputQueue(queue).query_many([r[0] for r in reqs],
+                                        timeout_s=300.0)
+    gs.shutdown(drain_s=2.0)
+    golden = {}
+    for rid, _, budget in reqs:
+        r = res[rid]
+        assert r and not r.get("partial"), f"golden run lost {rid}"
+        golden[rid] = r["value"]["tokens"]
+        assert len(golden[rid]) == budget
+
+    # ---- interleaved chaos laps -------------------------------------------
+    workdir = tempfile.mkdtemp(prefix="chaos_resume_")
+    resume_laps, restart_laps = [], []
+    try:
+        for lap in range(max(1, args.resume_laps)):
+            resume_laps.append(
+                _run_chaos_resume_arm(args, reqs, golden, True, lap,
+                                      workdir))
+            restart_laps.append(
+                _run_chaos_resume_arm(args, reqs, golden, False, lap,
+                                      workdir))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    def _med(laps, key):
+        xs = sorted(lap[key] for lap in laps)
+        return xs[len(xs) // 2]
+
+    def _arm_doc(laps):
+        return {
+            "wasted_tokens": sum(lap["wasted_tokens"] for lap in laps),
+            "resumed": sum(lap["resumed"] for lap in laps),
+            "resume_failed": sum(lap["resume_failed"] for lap in laps),
+            "checkpoints": sum(lap["checkpoints"] for lap in laps),
+            "ttlt_p50_ms": _med(laps, "ttlt_p50_ms"),
+            "ttlt_p99_ms": _med(laps, "ttlt_p99_ms"),
+            "records_dropped": sum(lap["records_dropped"] for lap in laps),
+            "steady_compile_requests": sum(
+                lap["steady_compile_requests"] for lap in laps),
+            "laps": laps,
+        }
+
+    resume_doc = _arm_doc(resume_laps)
+    restart_doc = _arm_doc(restart_laps)
+    assert resume_doc["resumed"] > 0, \
+        "resume arm never resumed a generation — the chaos premise failed"
+    # the acceptance bar: checkpointed resume recovers at least half of
+    # the restart arm's recomputed work (in practice nearly all of it —
+    # the checkpoint cadence trails the stream cadence by < one interval)
+    assert resume_doc["wasted_tokens"] * 2 <= restart_doc["wasted_tokens"], \
+        f"resume arm wasted {resume_doc['wasted_tokens']} tokens vs " \
+        f"restart {restart_doc['wasted_tokens']}: recovered < 50%"
+    saved = restart_doc["wasted_tokens"] - resume_doc["wasted_tokens"]
+    return {
+        "mode": "chaos-resume",
+        "requests": len(reqs),
+        "slots": args.resume_slots,
+        "max_tokens": args.resume_max_tokens,
+        "crash_after": args.resume_crash_after,
+        "checkpoint_interval": args.resume_checkpoint_interval,
+        "laps": max(1, args.resume_laps),
+        "resume": resume_doc,
+        "restart": restart_doc,
+        "wasted_tokens_recovered": saved,
+        "wasted_tokens_recovered_pct": round(
+            100.0 * saved / max(restart_doc["wasted_tokens"], 1), 1),
+    }
+
+
 # -- elastic-serving load-swing A/B (PR 10) -----------------------------------
 
 def _swing_model(max_batch):
@@ -2301,6 +2590,45 @@ def main(argv=None):
                          "monolithic arm is >= 2x")
     ap.add_argument("--gen-block-len", type=int, default=16,
                     help="paged A/B: tokens per KV pool block (pow-2)")
+    ap.add_argument("--chaos-resume", action="store_true",
+                    help="PR 20 generation-continuity chaos A/B (with "
+                         "--generate): a real victim replica subprocess "
+                         "crashes mid-decode via an armed decode_crash_"
+                         "after_n_tokens fault with every request in "
+                         "flight; a survivor recovers with checkpointed "
+                         "resume (on arm) vs restart-from-0 (off arm), "
+                         "interleaved laps.  Both arms must match the "
+                         "uninterrupted golden token for token, drop "
+                         "zero records and perform zero steady-state "
+                         "compiles; asserts resume recovers >= 50% of "
+                         "the restart arm's wasted (recomputed) tokens")
+    ap.add_argument("--resume-requests", type=int, default=8,
+                    help="chaos-resume: request count per lap")
+    ap.add_argument("--resume-slots", type=int, default=4,
+                    help="chaos-resume: decode slots per replica")
+    ap.add_argument("--resume-max-tokens", type=int, default=32,
+                    help="chaos-resume: uniform per-request budget (must "
+                         "exceed the per-slot crash depth)")
+    ap.add_argument("--resume-prompt-max", type=int, default=12,
+                    help="chaos-resume: prompts sampled in [2, MAX]")
+    ap.add_argument("--resume-crash-after", type=int, default=40,
+                    help="chaos-resume: the victim os._exit(3)s once its "
+                         "slots have produced N tokens total")
+    ap.add_argument("--resume-checkpoint-interval", type=int, default=4,
+                    help="chaos-resume: tokens between durable decode-"
+                         "state checkpoints")
+    ap.add_argument("--resume-stream-interval", type=int, default=4,
+                    help="chaos-resume: tokens between partial flushes "
+                         "(the restart arm's measured waste is the "
+                         "streamed progress it recomputes)")
+    ap.add_argument("--resume-quantum", type=int, default=4,
+                    help="chaos-resume: decode_quantum")
+    ap.add_argument("--resume-lease-s", type=float, default=1.0,
+                    help="chaos-resume: queue lease — the survivor "
+                         "reclaims the victim's claims after this")
+    ap.add_argument("--resume-laps", type=int, default=2,
+                    help="chaos-resume: interleaved resume/restart lap "
+                         "pairs (wasted tokens summed, TTLT medians)")
     ap.add_argument("--queue", choices=("inproc", "file"), default="inproc",
                     help="queue backend: inproc (zero-cost round-trips) or "
                          "file (cross-process spool — round-trips cost "
@@ -2415,6 +2743,38 @@ def main(argv=None):
         out = _run_cold_start(args)
         print(json.dumps({k: v for k, v in out.items()
                           if k not in ("cold", "warm")}))
+        if args.json_path:
+            doc = {"bench": "serving_bench", "ts": time.time(),
+                   "config": {k: v for k, v in vars(args).items()
+                              if k != "json_path"},
+                   "results": [out]}
+            tmp = args.json_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, args.json_path)
+        return out
+
+    if args.generate and args.chaos_resume:
+        # PR 20 generation-continuity chaos A/B: builds its own fixed
+        # TransformerLM (shared with the victim subprocess so every
+        # process agrees token for token), so --model is ignored
+        if args.smoke:
+            # tier-1 smoke: one lap, fewer requests, shallower crash —
+            # checks the crash/reclaim/resume machinery end to end, not
+            # this container's speed
+            args.resume_requests = min(args.resume_requests, 4)
+            args.resume_max_tokens = min(args.resume_max_tokens, 20)
+            args.resume_crash_after = min(args.resume_crash_after, 24)
+            args.resume_laps = 1
+        out = _run_chaos_resume(args)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("resume", "restart")}
+                         | {"resume": {k: v for k, v in
+                                       out["resume"].items()
+                                       if k != "laps"},
+                            "restart": {k: v for k, v in
+                                        out["restart"].items()
+                                        if k != "laps"}}))
         if args.json_path:
             doc = {"bench": "serving_bench", "ts": time.time(),
                    "config": {k: v for k, v in vars(args).items()
